@@ -1,0 +1,36 @@
+"""edl_tpu — a TPU-native elastic deep-learning framework.
+
+A from-scratch rebuild of the capabilities of PaddlePaddle EDL
+(reference: qizheng09/edl — a Kubernetes TrainingJob controller +
+cluster autoscaler for elastic distributed training), redesigned
+TPU-first around JAX/XLA:
+
+- The controller/autoscaler plane (reference ``pkg/controller.go``,
+  ``pkg/autoscaler.go``, ``pkg/cluster.go``) schedules TrainingJobs
+  against TPU pod-slice resources instead of ``nvidia.com/gpu``.
+- The parameter-server gradient sync (reference ``pkg/jobparser.go:74-112``,
+  external PaddlePaddle pserver processes) is eliminated entirely:
+  gradient sync is a resizable allreduce over ICI inside a ``jit``-ed
+  data-parallel step on a ``jax.sharding.Mesh``.
+- Fault tolerance / elasticity (reference: external master + etcd,
+  ``pkg/jobparser.go:174-191``) is native: a coordinator tracks trainer
+  membership generations; on join/leave the runtime re-shards the
+  device mesh and resumes from asynchronous host-DRAM checkpoints
+  without restarting the job.
+
+Package map:
+
+- ``edl_tpu.resource``   — L0 TrainingJob API types + validation
+- ``edl_tpu.cluster``    — L1 cluster abstraction (TPU slice inventory)
+- ``edl_tpu.parser``     — L2 spec -> pod/job manifest translation
+- ``edl_tpu.autoscaler`` — L3 fixed-point dry-run scaling algorithm
+- ``edl_tpu.controller`` — L4 watch loop + wired job lifecycle
+- ``edl_tpu.runtime``    — trainer runtime: mesh, elastic step loop
+- ``edl_tpu.checkpoint`` — async host-DRAM checkpoints w/ resharding
+- ``edl_tpu.parallel``   — dp/fsdp/tp/pp/sp/ep mesh + collectives
+- ``edl_tpu.models``     — fit_a_line, MNIST, ResNet-50, Transformer
+- ``edl_tpu.ops``        — pallas kernels (ring attention, fused ops)
+- ``edl_tpu.cli``        — edl submit / list / kill / scale / local-run
+"""
+
+__version__ = "0.1.0"

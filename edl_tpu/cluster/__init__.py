@@ -1,0 +1,14 @@
+from edl_tpu.cluster.resources import ClusterResource, Nodes
+from edl_tpu.cluster.tpu_topology import (
+    topology_chips,
+    legal_topologies,
+    SliceTopology,
+)
+
+__all__ = [
+    "ClusterResource",
+    "Nodes",
+    "topology_chips",
+    "legal_topologies",
+    "SliceTopology",
+]

@@ -1,0 +1,71 @@
+"""Cluster resource value types.
+
+Mirrors the reference's ``ClusterResource``/``Nodes`` structs
+(``pkg/cluster.go:32-61``) with the GPU axis replaced by TPU chips.
+These are plain mutable value types on purpose: the autoscaler's dry-run
+simulates scaling decisions by mutating a *copy* of the inventory
+(ref ``pkg/autoscaler.go:201-291``), and tests fabricate cluster state
+as literals exactly like the reference's test suite does
+(``pkg/autoscaler_internal_test.go:104-123``).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class Nodes:
+    """Per-node idle resources (ref Nodes, pkg/cluster.go:58-61).
+
+    ``tpu_free`` is new: free TPU chips per node pool, so slice
+    assignability can be checked per pool (a v5e slice must come from
+    one pool's contiguous capacity; we model pools at chip granularity)."""
+
+    cpu_idle_milli: Dict[str, int] = field(default_factory=dict)
+    memory_free_mega: Dict[str, int] = field(default_factory=dict)
+    tpu_free: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class ClusterResource:
+    """Cluster-wide totals/requests/limits (ref ClusterResource,
+    pkg/cluster.go:32-54), with ``gpu_*`` -> ``tpu_*`` in chips."""
+
+    node_count: int = 0
+
+    tpu_total: int = 0
+    tpu_request: int = 0
+    tpu_limit: int = 0
+
+    cpu_total_milli: int = 0
+    cpu_request_milli: int = 0
+    cpu_limit_milli: int = 0
+
+    memory_total_mega: int = 0
+    memory_request_mega: int = 0
+    memory_limit_mega: int = 0
+
+    nodes: Nodes = field(default_factory=Nodes)
+
+    def deepcopy(self) -> "ClusterResource":
+        return copy.deepcopy(self)
+
+    # -- derived load fractions (used by the dry run's maxLoadDesired
+    #    checks, ref pkg/autoscaler.go:259-278) -----------------------------
+    def cpu_load(self) -> float:
+        if self.cpu_total_milli <= 0:
+            return 1.0
+        return self.cpu_request_milli / self.cpu_total_milli
+
+    def memory_load(self) -> float:
+        if self.memory_total_mega <= 0:
+            return 1.0
+        return self.memory_request_mega / self.memory_total_mega
+
+    def tpu_load(self) -> float:
+        if self.tpu_total <= 0:
+            return 1.0
+        return self.tpu_limit / self.tpu_total

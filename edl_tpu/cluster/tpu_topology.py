@@ -1,0 +1,72 @@
+"""TPU slice topology model.
+
+The reference counts GPUs as per-node scalar quantities
+(``pkg/cluster.go:224-234`` sums ``alpha.kubernetes.io/nvidia-gpu``).
+TPUs are not interchangeable scalars: a trainer replica owns a whole
+*slice* (chips wired by ICI in a fixed shape), and a data-parallel world
+grows and shrinks in units of slices.  This module is the vocabulary the
+inventory (L1) and the autoscaler's slice-quantized deltas (L3) share.
+
+Chips-per-slice for the supported v5e topologies mirror the real
+offerings (1, 4, 8, 16, 32, 64 chips; 2D ICI meshes up to 8x8).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    name: str
+    chips: int
+    ici_mesh: Tuple[int, int]  # 2D ICI mesh shape (v5e is a 2D torus)
+    hosts: int  # host machines per slice (v5e: 8 chips/host)
+
+
+def _mk(name: str, mesh: Tuple[int, int]) -> SliceTopology:
+    chips = mesh[0] * mesh[1]
+    return SliceTopology(name=name, chips=chips, ici_mesh=mesh, hosts=max(1, chips // 8))
+
+
+#: Legal v5e slice topologies (by name as it appears in TrainerSpec).
+_TOPOLOGIES: Dict[str, SliceTopology] = {
+    t.name: t
+    for t in [
+        _mk("v5e-1", (1, 1)),
+        _mk("v5e-4", (2, 2)),
+        _mk("v5e-8", (2, 4)),
+        _mk("v5e-16", (4, 4)),
+        _mk("v5e-32", (4, 8)),
+        _mk("v5e-64", (8, 8)),
+        # CPU-host "topology" for tests / non-TPU jobs.
+        SliceTopology(name="cpu", chips=0, ici_mesh=(0, 0), hosts=1),
+    ]
+}
+
+
+def get_topology(name: str) -> SliceTopology:
+    try:
+        return _TOPOLOGIES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown TPU slice topology {name!r}; legal: {sorted(_TOPOLOGIES)}"
+        ) from None
+
+
+def topology_chips(name: str) -> int:
+    return get_topology(name).chips
+
+
+def legal_topologies() -> List[str]:
+    return sorted(_TOPOLOGIES, key=lambda n: _TOPOLOGIES[n].chips)
+
+
+def largest_topology_fitting(chips: int) -> SliceTopology:
+    """Largest legal slice with at most ``chips`` chips."""
+    best = _TOPOLOGIES["cpu"]
+    for t in _TOPOLOGIES.values():
+        if 0 < t.chips <= chips and t.chips > best.chips:
+            best = t
+    return best

@@ -1,0 +1,29 @@
+from edl_tpu.resource.training_job import (
+    GROUP,
+    VERSION,
+    KIND,
+    TPU_RESOURCE_KEY,
+    JobState,
+    ResourceSpec,
+    TrainerSpec,
+    CoordinatorSpec,
+    TrainingJobSpec,
+    TrainingJobStatus,
+    TrainingJob,
+    ValidationError,
+)
+
+__all__ = [
+    "GROUP",
+    "VERSION",
+    "KIND",
+    "TPU_RESOURCE_KEY",
+    "JobState",
+    "ResourceSpec",
+    "TrainerSpec",
+    "CoordinatorSpec",
+    "TrainingJobSpec",
+    "TrainingJobStatus",
+    "TrainingJob",
+    "ValidationError",
+]

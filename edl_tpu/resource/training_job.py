@@ -1,0 +1,458 @@
+"""L0 resource model: the TrainingJob API surface.
+
+TPU-native equivalent of the reference CRD types in
+``pkg/resource/training_job.go``:
+
+- ``TrainingJob{TypeMeta, ObjectMeta, Spec, Status}``    (ref ``:101-106``)
+- ``TrainingJobSpec`` image/port/fault_tolerant/passes   (ref ``:110-124``)
+- ``TrainerSpec{Entrypoint, Workspace, Min, Max, Res}``  (ref ``:128-134``)
+- ``MasterSpec`` -> ``CoordinatorSpec``                  (ref ``:146-149``)
+- status states Created/Running/Failed/Succeed           (ref ``:162-167``)
+- helpers ``Elastic()`` / ``GPU()`` / ``NeedGPU()``      (ref ``:179-197``)
+
+Deliberate departures (TPU-first redesign, not translation):
+
+- **No PserverSpec.** The reference's parameter-server ReplicaSet
+  (ref ``:138-142``, ``pkg/jobparser.go:74-112``) exists only to sync
+  gradients over TCP; on TPU that is an XLA allreduce over ICI inside
+  the jitted train step, so there is no pserver process to declare.
+- **TPU chips, not nvidia-gpu.** Device accounting keys on
+  ``google.com/tpu`` (the reference used the long-deprecated
+  ``alpha.kubernetes.io/nvidia-gpu``, ref ``:74,185`` — a quirk
+  SURVEY.md says to fix, not replicate).
+- **Slice topology.** A trainer replica is one TPU slice, not one GPU
+  pod; the spec names the per-replica topology (e.g. ``"v5e-4"``) so
+  scaling deltas are quantized to whole slices.
+- **Status is real.** The reference defines ``TrainingJobStatus`` but
+  never writes it (SURVEY.md §5.5); our controller maintains it as a
+  state machine Created -> Running -> (Scaling <->) -> Succeed/Failed.
+
+API group: ``edl.tpu.dev/v1`` (analog of ``paddlepaddle.org/v1``,
+ref ``:208-228``).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import time
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, List, Mapping, Optional
+
+from edl_tpu.utils.quantity import (
+    parse_cpu_milli,
+    parse_memory_mega,
+    parse_count,
+)
+
+GROUP = "edl.tpu.dev"
+VERSION = "v1"
+KIND = "TrainingJob"
+PLURAL = "trainingjobs"
+
+#: Device resource key used for inventory + limits.
+TPU_RESOURCE_KEY = "google.com/tpu"
+
+#: Defaults mirroring DefaultJobParser.Validate (ref pkg/jobparser.go:47-71).
+DEFAULT_PORT = 7164
+DEFAULT_IMAGE = "edl-tpu/trainer:latest"
+DEFAULT_PASSES = 1
+
+
+class ValidationError(ValueError):
+    """Raised when a TrainingJob spec is invalid (ref pkg/jobparser.go:66-68)."""
+
+
+class JobState(str, enum.Enum):
+    """Job lifecycle states (ref pkg/resource/training_job.go:162-167, plus
+    SCALING which the reference lacked because it never wrote status)."""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    SCALING = "Scaling"
+    SUCCEED = "Succeed"
+    FAILED = "Failed"
+
+
+@dataclass
+class ResourceSpec:
+    """Requests/limits as k8s-style quantity strings.
+
+    Normalized accessors mirror the reference's per-job accessors
+    (ref pkg/autoscaler.go:39-52)."""
+
+    requests: Dict[str, Any] = field(default_factory=dict)
+    limits: Dict[str, Any] = field(default_factory=dict)
+
+    # -- normalized views ---------------------------------------------------
+    def cpu_request_milli(self) -> int:
+        return parse_cpu_milli(self.requests.get("cpu", 0))
+
+    def cpu_limit_milli(self) -> int:
+        return parse_cpu_milli(self.limits.get("cpu", 0))
+
+    def mem_request_mega(self) -> int:
+        return parse_memory_mega(self.requests.get("memory", 0))
+
+    def mem_limit_mega(self) -> int:
+        return parse_memory_mega(self.limits.get("memory", 0))
+
+    def tpu_limit(self) -> int:
+        """TPU chips per replica (ref analog: TrainerGPULimit,
+        pkg/autoscaler.go:39-42, reading the device limit)."""
+        return parse_count(self.limits.get(TPU_RESOURCE_KEY, 0))
+
+    def normalized(self) -> Dict[str, Dict[str, int]]:
+        return {
+            "requests": {
+                "cpu_milli": self.cpu_request_milli(),
+                "memory_mega": self.mem_request_mega(),
+            },
+            "limits": {
+                "cpu_milli": self.cpu_limit_milli(),
+                "memory_mega": self.mem_limit_mega(),
+                "tpu": self.tpu_limit(),
+            },
+        }
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> "ResourceSpec":
+        d = d or {}
+        return ResourceSpec(
+            requests=dict(d.get("requests", {}) or {}),
+            limits=dict(d.get("limits", {}) or {}),
+        )
+
+
+@dataclass
+class TrainerSpec:
+    """Elastic trainer group (ref TrainerSpec, pkg/resource/training_job.go:128-134).
+
+    ``min_instance``/``max_instance`` count *trainer replicas*; each
+    replica owns one TPU slice of ``slice_topology``."""
+
+    entrypoint: str = ""
+    workspace: str = ""
+    min_instance: int = 1
+    max_instance: int = 1
+    #: Per-replica TPU slice topology, e.g. "v5e-1", "v5e-4", "v5e-8".
+    slice_topology: str = "v5e-4"
+    resources: ResourceSpec = field(default_factory=ResourceSpec)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainerSpec":
+        d = d or {}
+        return TrainerSpec(
+            entrypoint=d.get("entrypoint", ""),
+            workspace=d.get("workspace", ""),
+            min_instance=int(d.get("min_instance", d.get("minInstance", 1))),
+            max_instance=int(d.get("max_instance", d.get("maxInstance", 1))),
+            slice_topology=d.get("slice_topology", d.get("sliceTopology", "v5e-4")),
+            resources=ResourceSpec.from_dict(d.get("resources")),
+        )
+
+
+@dataclass
+class CoordinatorSpec:
+    """Elastic coordinator (replaces the reference's master ReplicaSet +
+    etcd v3.2.1 sidecar, ref MasterSpec pkg/resource/training_job.go:146-149
+    and pkg/jobparser.go:174-232).  One lightweight process that tracks
+    membership generations, assigns data shards, and indexes checkpoints;
+    backed by the JAX coordination service instead of etcd.  It listens
+    on ``TrainingJobSpec.port`` — the job's single port."""
+
+    resources: ResourceSpec = field(default_factory=ResourceSpec)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> "CoordinatorSpec":
+        d = d or {}
+        return CoordinatorSpec(
+            resources=ResourceSpec.from_dict(d.get("resources")),
+        )
+
+
+@dataclass
+class TrainingJobSpec:
+    """ref TrainingJobSpec (pkg/resource/training_job.go:110-124).
+
+    Dropped fields, by design: ``ports_num`` / ``ports_num_for_sparse``
+    (pserver TCP port ranges, ref ``:114-115`` — no pserver exists here;
+    the only port is the coordinator's) and per-pod ``volumes`` (carried
+    opaquely in ``volumes`` for manifest passthrough)."""
+
+    image: str = ""
+    port: int = 0
+    fault_tolerant: bool = False
+    passes: int = 0
+    trainer: TrainerSpec = field(default_factory=TrainerSpec)
+    coordinator: CoordinatorSpec = field(default_factory=CoordinatorSpec)
+    volumes: List[Dict[str, Any]] = field(default_factory=list)
+    #: Runtime knobs the reference kept outside the CRD (in user code).
+    #: Fixed global batch under elasticity (SURVEY.md §7.4): per-replica
+    #: batch = global_batch_size / world_size at every generation.
+    global_batch_size: int = 0
+    checkpoint_interval_steps: int = 100
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainingJobSpec":
+        d = d or {}
+        return TrainingJobSpec(
+            image=d.get("image", ""),
+            port=int(d.get("port", 0)),
+            fault_tolerant=bool(d.get("fault_tolerant", d.get("faultTolerant", False))),
+            passes=int(d.get("passes", 0)),
+            trainer=TrainerSpec.from_dict(d.get("trainer")),
+            coordinator=CoordinatorSpec.from_dict(
+                d.get("coordinator", d.get("master"))
+            ),
+            volumes=list(d.get("volumes", []) or []),
+            global_batch_size=int(d.get("global_batch_size", d.get("globalBatchSize", 0))),
+            checkpoint_interval_steps=int(
+                d.get("checkpoint_interval_steps", d.get("checkpointIntervalSteps", 100))
+            ),
+        )
+
+
+@dataclass
+class TrainingJobStatus:
+    """ref TrainingJobStatus (pkg/resource/training_job.go:153-167).
+    The reference never writes it (SURVEY.md §5.5); ours is maintained by
+    the controller."""
+
+    state: JobState = JobState.CREATED
+    parallelism: int = 0
+    generation: int = 0
+    running: int = 0
+    pending: int = 0
+    message: str = ""
+    #: wall-clock seconds the job spent with all pods pending (for the
+    #: pending-time p50 north-star metric).
+    submitted_at: float = 0.0
+    started_at: float = 0.0
+
+    def pending_seconds(self) -> float:
+        if self.submitted_at <= 0:
+            return 0.0
+        end = self.started_at if self.started_at > 0 else time.time()
+        return max(0.0, end - self.submitted_at)
+
+
+@dataclass
+class TrainingJob:
+    """ref TrainingJob (pkg/resource/training_job.go:101-106)."""
+
+    name: str = ""
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
+    status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
+
+    # -- helpers (ref pkg/resource/training_job.go:179-197) -----------------
+    def elastic(self) -> bool:
+        """min < max (ref Elastic(), ``:179-181``)."""
+        return self.spec.trainer.min_instance < self.spec.trainer.max_instance
+
+    def tpu_per_trainer(self) -> int:
+        """TPU chips each trainer replica consumes (ref GPU(), ``:184-190``,
+        reading the nvidia limit).  Falls back to the slice topology's
+        chip count when resources.limits omits the key."""
+        n = self.spec.trainer.resources.tpu_limit()
+        if n:
+            return n
+        from edl_tpu.cluster.tpu_topology import topology_chips
+
+        return topology_chips(self.spec.trainer.slice_topology)
+
+    def need_tpu(self) -> bool:
+        """ref NeedGPU() (``:193-197``)."""
+        return self.tpu_per_trainer() > 0
+
+    def fullname(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def trainer_job_name(self) -> str:
+        """Name of the actuated trainer workload: ``<job>-trainer``
+        (ref pkg/cluster.go:92-94)."""
+        return f"{self.name}-trainer"
+
+    def coordinator_name(self) -> str:
+        return f"{self.name}-coordinator"
+
+    # -- validation + defaulting (ref DefaultJobParser.Validate,
+    #    pkg/jobparser.go:47-71) --------------------------------------------
+    def validate(self) -> "TrainingJob":
+        """Fill defaults and reject invalid specs.  Returns self.
+
+        Mirrors ref semantics: default port/image/passes; reject
+        elastic-without-fault-tolerant (ref ``:66-68``).  Adds TPU
+        constraints the reference could not have: instance bounds sane,
+        topology legal."""
+        s = self.spec
+        if not self.name:
+            raise ValidationError("job name must be set")
+        if s.port <= 0:
+            s.port = DEFAULT_PORT
+        if not s.image:
+            s.image = DEFAULT_IMAGE
+        if s.passes <= 0:
+            s.passes = DEFAULT_PASSES
+        t = s.trainer
+        if t.min_instance <= 0:
+            raise ValidationError("trainer.min_instance must be >= 1")
+        if t.max_instance < t.min_instance:
+            raise ValidationError(
+                "trainer.max_instance must be >= trainer.min_instance"
+            )
+        if self.elastic() and not s.fault_tolerant:
+            # ref pkg/jobparser.go:66-68: elastic requires fault tolerance
+            # (a shrinkable job must checkpoint + re-mesh).
+            raise ValidationError(
+                "max_instance > min_instance requires fault_tolerant: true"
+            )
+        from edl_tpu.cluster.tpu_topology import topology_chips
+
+        try:
+            topology_chips(t.slice_topology)
+        except ValueError as e:
+            raise ValidationError(str(e)) from None
+        for res in (t.resources, s.coordinator.resources):
+            for bucket in (res.requests, res.limits):
+                for key, q in bucket.items():
+                    try:
+                        if key == "cpu":
+                            v = parse_cpu_milli(q)
+                        elif key == "memory":
+                            v = parse_memory_mega(q)
+                        else:
+                            v = parse_count(q)
+                    except ValueError as e:
+                        raise ValidationError(str(e)) from None
+                    if v < 0:
+                        raise ValidationError(
+                            f"resource quantity must be >= 0: {key}={q!r}"
+                        )
+        if s.global_batch_size < 0:
+            raise ValidationError("global_batch_size must be >= 0")
+        if s.global_batch_size:
+            # Fixed-global-batch elasticity (SURVEY.md §7.4): per-replica
+            # batch = global_batch_size / world_size, so the runtime only
+            # resizes to world sizes that divide the global batch (see
+            # legal_world_sizes()).  The endpoints must themselves be legal
+            # or the job could neither start at min nor reach max.
+            if s.global_batch_size % t.min_instance != 0:
+                raise ValidationError(
+                    "global_batch_size must be divisible by trainer.min_instance"
+                )
+            if s.global_batch_size % t.max_instance != 0:
+                raise ValidationError(
+                    "global_batch_size must be divisible by trainer.max_instance"
+                )
+        return self
+
+    def legal_world_sizes(self) -> List[int]:
+        """World sizes the elastic runtime may resize to: every w in
+        [min_instance, max_instance] with an integral per-replica batch.
+        With no global_batch_size set, every size in range is legal."""
+        t = self.spec.trainer
+        sizes = range(t.min_instance, t.max_instance + 1)
+        gbs = self.spec.global_batch_size
+        if not gbs:
+            return list(sizes)
+        return [w for w in sizes if gbs % w == 0]
+
+    # -- (de)serialization --------------------------------------------------
+    def to_manifest(self) -> Dict[str, Any]:
+        """Render as a k8s custom-resource manifest dict."""
+        spec = asdict(self.spec)
+        status = asdict(self.status)
+        status["state"] = self.status.state.value
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": KIND,
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": dict(self.labels),
+            },
+            "spec": spec,
+            "status": status,
+        }
+
+    @staticmethod
+    def from_manifest(d: Mapping[str, Any]) -> "TrainingJob":
+        api_version = d.get("apiVersion", f"{GROUP}/{VERSION}")
+        if api_version != f"{GROUP}/{VERSION}":
+            raise ValidationError(f"unsupported apiVersion: {api_version}")
+        if d.get("kind", KIND) != KIND:
+            raise ValidationError(f"unsupported kind: {d.get('kind')}")
+        meta = d.get("metadata", {}) or {}
+        job = TrainingJob(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            labels=dict(meta.get("labels", {}) or {}),
+            spec=TrainingJobSpec.from_dict(d.get("spec")),
+        )
+        st = d.get("status") or {}
+        if st:
+            job.status = TrainingJobStatus(
+                state=JobState(st.get("state", "Created")),
+                parallelism=int(st.get("parallelism", 0)),
+                generation=int(st.get("generation", 0)),
+                running=int(st.get("running", 0)),
+                pending=int(st.get("pending", 0)),
+                message=st.get("message", ""),
+                submitted_at=float(st.get("submitted_at", 0.0)),
+                started_at=float(st.get("started_at", 0.0)),
+            )
+        return job
+
+    @staticmethod
+    def from_yaml(text: str) -> "TrainingJob":
+        import yaml
+
+        return TrainingJob.from_manifest(yaml.safe_load(text))
+
+    def deepcopy(self) -> "TrainingJob":
+        """ref zz_generated.deepcopy.go DeepCopyObject — trivially
+        ``copy.deepcopy`` in Python; kept as a named method so call
+        sites document intent."""
+        return copy.deepcopy(self)
+
+
+def crd_manifest() -> Dict[str, Any]:
+    """CustomResourceDefinition manifest registering TrainingJob
+    (ref RegisterResource, pkg/resource/training_job.go:208-228 — the
+    reference registers a client-side scheme; on modern k8s the CRD
+    itself is an object we can emit)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": f"{KIND}List",
+                "plural": PLURAL,
+                "singular": "trainingjob",
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+                                "status": {"type": "object", "x-kubernetes-preserve-unknown-fields": True},
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }

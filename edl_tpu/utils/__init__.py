@@ -1,0 +1,17 @@
+from edl_tpu.utils.quantity import (
+    parse_cpu_milli,
+    parse_memory_mega,
+    parse_quantity_bytes,
+    format_cpu_milli,
+    format_memory_mega,
+    add_resource_list,
+)
+
+__all__ = [
+    "parse_cpu_milli",
+    "parse_memory_mega",
+    "parse_quantity_bytes",
+    "format_cpu_milli",
+    "format_memory_mega",
+    "add_resource_list",
+]

@@ -1,0 +1,29 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax import.
+
+Mirrors the reference's test philosophy (SURVEY.md §4): fabricate
+multi-node state without a cluster.  For the trainer half, the
+"fabricated cluster" is 8 virtual CPU devices, enough for dp*tp*pp
+meshes and elastic resize tests (1 -> 2 -> 4 -> 8 trainers).
+"""
+
+import os
+
+# Must run before jax initializes any backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]

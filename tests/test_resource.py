@@ -1,0 +1,240 @@
+"""L0 resource-model tests.
+
+Mirrors + extends the reference suite ``pkg/resource/training_job_test.go``
+(NeedGPU flips on device limit ``:27-37``; Elastic iff min<max ``:39-46``)
+and the validation semantics of ``pkg/jobparser.go:47-71``.
+"""
+
+import pytest
+
+from edl_tpu.resource import (
+    JobState,
+    ResourceSpec,
+    TrainerSpec,
+    TrainingJob,
+    TrainingJobSpec,
+    ValidationError,
+    TPU_RESOURCE_KEY,
+)
+from edl_tpu.resource.training_job import DEFAULT_IMAGE, DEFAULT_PORT, crd_manifest
+from edl_tpu.utils.quantity import (
+    add_resource_list,
+    parse_cpu_milli,
+    parse_memory_mega,
+    parse_count,
+)
+
+
+def make_job(name="j1", min_instance=1, max_instance=1, fault_tolerant=False, **kw):
+    return TrainingJob(
+        name=name,
+        spec=TrainingJobSpec(
+            fault_tolerant=fault_tolerant,
+            trainer=TrainerSpec(
+                min_instance=min_instance, max_instance=max_instance, **kw
+            ),
+        ),
+    )
+
+
+# ---- quantities (ref pkg/utils_test.go + autoscaler unit conversion) ------
+
+
+def test_parse_cpu_milli():
+    assert parse_cpu_milli("250m") == 250
+    assert parse_cpu_milli("2") == 2000
+    assert parse_cpu_milli("1.5") == 1500
+    assert parse_cpu_milli(1) == 1000
+    assert parse_cpu_milli("") == 0
+    assert parse_cpu_milli(None) == 0
+
+
+def test_parse_memory_mega():
+    assert parse_memory_mega("1Gi") == 1024
+    assert parse_memory_mega("64Mi") == 64
+    assert parse_memory_mega("500M") == 500_000_000 // 2**20
+    assert parse_memory_mega("2G") == 2_000_000_000 // 2**20
+    assert parse_memory_mega(0) == 0
+
+
+def test_parse_count():
+    assert parse_count("4") == 4
+    assert parse_count(8) == 8
+    assert parse_count("") == 0
+    with pytest.raises(ValueError):
+        parse_count("4.5")
+    with pytest.raises(ValueError):
+        parse_count("1Gi")
+
+
+def test_add_resource_list():
+    # ref pkg/utils_test.go:25-48 — sums, inserts keys absent in a.
+    a = {"cpu_milli": 1000, "memory_mega": 512}
+    b = {"cpu_milli": 500, "tpu": 4}
+    add_resource_list(a, b)
+    assert a == {"cpu_milli": 1500, "memory_mega": 512, "tpu": 4}
+
+
+# ---- helpers (ref training_job_test.go) -----------------------------------
+
+
+def test_elastic_iff_min_lt_max():
+    # ref :39-46
+    assert not make_job(min_instance=2, max_instance=2).elastic()
+    assert make_job(min_instance=1, max_instance=2, fault_tolerant=True).elastic()
+    assert not make_job(min_instance=3, max_instance=1).elastic()
+
+
+def test_need_tpu_flips_on_limit():
+    # ref :27-37 (NeedGPU flips on the nvidia limit)
+    j = make_job(slice_topology="cpu")
+    assert not j.need_tpu()
+    j.spec.trainer.resources = ResourceSpec(limits={TPU_RESOURCE_KEY: "4"})
+    assert j.need_tpu()
+    assert j.tpu_per_trainer() == 4
+
+
+def test_tpu_per_trainer_falls_back_to_topology():
+    j = make_job(slice_topology="v5e-8")
+    assert j.tpu_per_trainer() == 8
+
+
+# ---- validation (ref pkg/jobparser.go:47-71) ------------------------------
+
+
+def test_validate_fills_defaults():
+    j = make_job().validate()
+    assert j.spec.port == DEFAULT_PORT
+    assert j.spec.image == DEFAULT_IMAGE
+    assert j.spec.passes == 1
+
+
+def test_validate_rejects_elastic_without_fault_tolerant():
+    # ref :66-68
+    j = make_job(min_instance=1, max_instance=4, fault_tolerant=False)
+    with pytest.raises(ValidationError):
+        j.validate()
+    make_job(min_instance=1, max_instance=4, fault_tolerant=True).validate()
+
+
+def test_validate_rejects_bad_bounds():
+    with pytest.raises(ValidationError):
+        make_job(min_instance=0).validate()
+    with pytest.raises(ValidationError):
+        make_job(min_instance=3, max_instance=1).validate()
+    with pytest.raises(ValidationError):
+        TrainingJob(name="").validate()
+
+
+def test_validate_rejects_unknown_topology():
+    with pytest.raises(ValueError):
+        make_job(slice_topology="v9-banana").validate()
+
+
+def test_validate_global_batch_divisibility():
+    j = make_job(min_instance=1, max_instance=4, fault_tolerant=True)
+    j.spec.global_batch_size = 6  # not divisible by max_instance=4
+    with pytest.raises(ValidationError):
+        j.validate()
+    j.spec.global_batch_size = 8
+    j.validate()
+    # world size 3 has a non-integral per-replica batch -> excluded from
+    # the legal resize targets, not a crash at an intermediate generation.
+    assert j.legal_world_sizes() == [1, 2, 4]
+    j.spec.global_batch_size = 0
+    assert j.legal_world_sizes() == [1, 2, 3, 4]
+
+
+def test_validate_rejects_negative_resources():
+    j = make_job()
+    j.spec.trainer.resources = ResourceSpec(limits={TPU_RESOURCE_KEY: "-4"})
+    with pytest.raises(ValidationError):
+        j.validate()
+    j.spec.trainer.resources = ResourceSpec(requests={"cpu": "-500m"})
+    with pytest.raises(ValidationError):
+        j.validate()
+
+
+def test_validate_unknown_topology_is_validation_error():
+    # validate() must raise ValidationError (not bare ValueError) for every
+    # invalid-spec path so submit paths can catch one exception type.
+    with pytest.raises(ValidationError):
+        make_job(slice_topology="v5e-12").validate()
+
+
+# ---- (de)serialization ----------------------------------------------------
+
+
+def test_manifest_roundtrip():
+    j = make_job(
+        name="mnist", min_instance=1, max_instance=4, fault_tolerant=True,
+        slice_topology="v5e-4",
+    )
+    j.spec.global_batch_size = 128
+    j.validate()
+    j.status.state = JobState.RUNNING
+    j.status.parallelism = 2
+    m = j.to_manifest()
+    assert m["apiVersion"] == "edl.tpu.dev/v1"
+    assert m["kind"] == "TrainingJob"
+    j2 = TrainingJob.from_manifest(m)
+    assert j2.name == "mnist"
+    assert j2.spec.trainer.max_instance == 4
+    assert j2.status.state == JobState.RUNNING
+    assert j2.status.parallelism == 2
+    assert j2.elastic()
+
+
+def test_from_yaml():
+    text = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata:
+  name: resnet50
+  namespace: ml
+spec:
+  fault_tolerant: true
+  global_batch_size: 4096
+  trainer:
+    entrypoint: "python -m edl_tpu.models.resnet"
+    min_instance: 1
+    max_instance: 16
+    slice_topology: v5e-4
+    resources:
+      requests: {cpu: "4", memory: 8Gi}
+      limits: {"google.com/tpu": "4"}
+"""
+    j = TrainingJob.from_yaml(text).validate()
+    assert j.fullname() == "ml/resnet50"
+    assert j.trainer_job_name() == "resnet50-trainer"
+    assert j.tpu_per_trainer() == 4
+    assert j.spec.trainer.resources.cpu_request_milli() == 4000
+    assert j.spec.trainer.resources.mem_request_mega() == 8192
+
+
+def test_deepcopy_is_independent():
+    j = make_job()
+    j2 = j.deepcopy()
+    j2.spec.trainer.min_instance = 99
+    assert j.spec.trainer.min_instance == 1
+
+
+def test_crd_manifest_shape():
+    m = crd_manifest()
+    assert m["metadata"]["name"] == "trainingjobs.edl.tpu.dev"
+    assert m["spec"]["versions"][0]["subresources"] == {"status": {}}
+
+
+def test_topologies():
+    from edl_tpu.cluster.tpu_topology import (
+        topology_chips,
+        legal_topologies,
+        largest_topology_fitting,
+    )
+
+    assert topology_chips("v5e-4") == 4
+    assert topology_chips("v5e-64") == 64
+    assert topology_chips("cpu") == 0
+    assert "v5e-8" in legal_topologies()
+    assert largest_topology_fitting(40).chips == 32
+    assert largest_topology_fitting(3).chips == 1

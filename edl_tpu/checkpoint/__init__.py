@@ -1,0 +1,3 @@
+from edl_tpu.checkpoint.hostdram import HostDRAMStore, HostCheckpoint
+
+__all__ = ["HostDRAMStore", "HostCheckpoint"]

@@ -1,0 +1,275 @@
+"""Asynchronous host-DRAM checkpointing with resharding restore.
+
+The reference delegates checkpoint/resume wholly to the external
+PaddlePaddle master (SURVEY.md §5.4 — nothing in-repo but a design-doc
+link, ``README.md:18-21``).  For the TPU rebuild this subsystem is the
+heart of the <60s-resize north star (BASELINE.md): a recent checkpoint
+must *always* be warm in host DRAM so a membership change never waits
+on storage, and restore must place every leaf onto a mesh of a
+different size/shape than the one it was saved from.
+
+Design:
+
+- ``save_async`` enqueues device->host copies without blocking the step
+  loop: ``copy_to_host_async()`` on every leaf (pure DMA issue), then a
+  background thread materializes numpy arrays and publishes the
+  checkpoint atomically.
+- The store keeps the last ``keep`` checkpoints in DRAM, plus optional
+  disk spill (numpy ``.npz`` + a json manifest) for durability across
+  host loss — the elastic fast path never touches disk.
+- ``restore`` takes a target ``Mesh`` + sharding pytree and
+  ``jax.device_put``s each leaf; XLA handles any source->target layout
+  change, which is exactly "re-shard optimizer state across a changed
+  mesh" (SURVEY.md §7.4) when param shardings are mesh-dependent.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _flatten_with_names(tree) -> List[tuple]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        out.append((name, leaf))
+    return out
+
+
+@dataclass
+class HostCheckpoint:
+    """One materialized checkpoint: host numpy leaves + tree structure."""
+
+    step: int
+    generation: int
+    leaves: List[np.ndarray]
+    treedef: Any
+    created_at: float = field(default_factory=lambda: 0.0)
+    save_seconds: float = 0.0
+
+    def unflatten(self):
+        return jax.tree_util.tree_unflatten(self.treedef, self.leaves)
+
+    def nbytes(self) -> int:
+        return sum(x.nbytes for x in self.leaves)
+
+
+class HostDRAMStore:
+    """Always-warm checkpoint store in host DRAM.
+
+    Thread model: each ``save_async`` runs on its own daemon thread (the
+    device->host DMA is issued on the caller thread, so saves never
+    block the step loop).  Saves of a step already stored or in flight
+    are deduped — e.g. an interval save and a resize flush landing on
+    the same step — and disk spills use unique tmp names with an atomic
+    rename, so concurrent saves can never corrupt or race each other.
+    """
+
+    def __init__(self, keep: int = 2, spill_dir: Optional[str] = None):
+        self.keep = keep
+        self.spill_dir = spill_dir
+        self._lock = threading.Lock()
+        self._checkpoints: Dict[int, HostCheckpoint] = {}  # step -> ckpt
+        self._pending: List[threading.Thread] = []
+        self._inflight_steps: set = set()
+        self._save_errors: List[BaseException] = []
+        self._tmp_counter = 0
+
+    # -- save ---------------------------------------------------------------
+    def save_async(self, state, generation: int = 0) -> threading.Thread:
+        """Snapshot ``state`` (a pytree of jax Arrays) into host DRAM.
+
+        Returns the worker thread (join it, or call ``wait()``, to
+        ensure completion).  The device buffers are captured by
+        reference and DMA'd; the step loop may immediately donate/mutate
+        its own handle because XLA arrays are immutable."""
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        step_val = _extract_step(state)
+
+        with self._lock:
+            if step_val in self._checkpoints or step_val in self._inflight_steps:
+                th = threading.Thread(target=lambda: None, daemon=True)
+                th.start()
+                return th
+            self._inflight_steps.add(step_val)
+
+        # Device-side snapshot first: the step loop donates its state
+        # buffers into the next step (``Trainer`` uses donate_argnums to
+        # keep HBM footprint flat), so the original leaves may be
+        # invalidated while the host copy is still in flight.  jnp.copy
+        # dispatches asynchronously; the snapshot buffers are owned here
+        # and immune to donation.
+        import jax.numpy as jnp
+
+        leaves = [
+            jnp.copy(l) if isinstance(l, jax.Array) else l for l in leaves
+        ]
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:  # non-addressable or already host
+                    pass
+
+        def work():
+            try:
+                host_leaves = [np.asarray(jax.device_get(l)) for l in leaves]
+                ckpt = HostCheckpoint(
+                    step=step_val,
+                    generation=generation,
+                    leaves=host_leaves,
+                    treedef=treedef,
+                    created_at=time.time(),
+                    save_seconds=time.perf_counter() - t0,
+                )
+                with self._lock:
+                    self._checkpoints[step_val] = ckpt
+                    extra = sorted(self._checkpoints)[: -self.keep]
+                    for s in extra:
+                        del self._checkpoints[s]
+                if self.spill_dir:
+                    self._spill(ckpt)
+            except BaseException as e:  # pragma: no cover - defensive
+                with self._lock:
+                    self._save_errors.append(e)
+            finally:
+                with self._lock:
+                    self._inflight_steps.discard(step_val)
+
+        th = threading.Thread(target=work, daemon=True, name=f"ckpt-save-{step_val}")
+        with self._lock:
+            self._pending.append(th)
+        th.start()
+        return th
+
+    def wait(self):
+        """Block until all in-flight saves have landed; re-raise errors."""
+        with self._lock:
+            pending = list(self._pending)
+            self._pending.clear()
+        for th in pending:
+            th.join()
+        with self._lock:
+            if self._save_errors:
+                err = self._save_errors[0]
+                self._save_errors.clear()
+                raise RuntimeError("async checkpoint save failed") from err
+
+    # -- query --------------------------------------------------------------
+    def latest(self) -> Optional[HostCheckpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            return self._checkpoints[max(self._checkpoints)]
+
+    def get(self, step: int) -> Optional[HostCheckpoint]:
+        with self._lock:
+            return self._checkpoints.get(step)
+
+    def steps(self) -> List[int]:
+        with self._lock:
+            return sorted(self._checkpoints)
+
+    # -- restore ------------------------------------------------------------
+    def restore(
+        self,
+        ckpt: HostCheckpoint,
+        mesh: Mesh,
+        sharding_tree: Any = None,
+    ):
+        """Place a checkpoint onto ``mesh``.
+
+        ``sharding_tree``: a pytree of NamedSharding (or a single one)
+        congruent with the state; default replicates everything — the
+        correct layout for pure-DP TrainState.  This is the re-sharding
+        moment: the checkpoint may have been written from any previous
+        mesh."""
+        state_host = ckpt.unflatten()
+        if sharding_tree is None:
+            sharding_tree = NamedSharding(mesh, P())
+        if isinstance(sharding_tree, (NamedSharding,)):
+            single = sharding_tree
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, single), state_host
+            )
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s), state_host, sharding_tree
+        )
+
+    # -- disk spill (durability; not on the resize fast path) ---------------
+    def _spill(self, ckpt: HostCheckpoint):
+        os.makedirs(self.spill_dir, exist_ok=True)
+        with self._lock:
+            self._tmp_counter += 1
+            tag = f"{os.getpid()}-{self._tmp_counter}"
+        path = os.path.join(self.spill_dir, f"ckpt-{ckpt.step:012d}")
+        arrays = {f"leaf_{i}": a for i, a in enumerate(ckpt.leaves)}
+        tmp_npz = f"{path}.{tag}.tmp.npz"
+        np.savez(tmp_npz, **arrays)
+        os.replace(tmp_npz, path + ".npz")
+        manifest = {
+            "step": ckpt.step,
+            "generation": ckpt.generation,
+            "created_at": ckpt.created_at,
+            "n_leaves": len(ckpt.leaves),
+        }
+        tmp_json = f"{path}.{tag}.tmp.json"
+        with open(tmp_json, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp_json, path + ".json")
+
+    def load_from_disk(self, template_state, step: Optional[int] = None) -> HostCheckpoint:
+        """Rehydrate a spilled checkpoint.  ``template_state`` supplies
+        the treedef (the caller knows the model; leaves are positional)."""
+        if not self.spill_dir:
+            raise ValueError("store has no spill_dir")
+        names = sorted(
+            f
+            for f in os.listdir(self.spill_dir)
+            if f.endswith(".json") and ".tmp." not in f
+        )
+        if not names:
+            raise FileNotFoundError(f"no checkpoints in {self.spill_dir}")
+        if step is None:
+            name = names[-1]
+        else:
+            name = f"ckpt-{step:012d}.json"
+            if name not in names:
+                raise FileNotFoundError(f"no checkpoint for step {step}")
+        with open(os.path.join(self.spill_dir, name)) as f:
+            manifest = json.load(f)
+        with np.load(os.path.join(self.spill_dir, name[: -len(".json")] + ".npz")) as z:
+            leaves = [z[f"leaf_{i}"] for i in range(manifest["n_leaves"])]
+        _, treedef = jax.tree_util.tree_flatten(template_state)
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"template has {treedef.num_leaves} leaves, checkpoint has {len(leaves)}"
+            )
+        ckpt = HostCheckpoint(
+            step=manifest["step"],
+            generation=manifest["generation"],
+            leaves=leaves,
+            treedef=treedef,
+            created_at=manifest["created_at"],
+        )
+        with self._lock:
+            self._checkpoints[ckpt.step] = ckpt
+        return ckpt
+
+
+def _extract_step(state) -> int:
+    step = getattr(state, "step", None)
+    if step is None:
+        return 0
+    return int(jax.device_get(step))

@@ -1,0 +1,13 @@
+"""Model zoo: benchmark workloads from BASELINE.md configs 1-5.
+
+Importing this package registers all built-in models with
+``edl_tpu.models.base.get_model``.
+"""
+
+from edl_tpu.models.base import ModelDef, get_model, register_model, registered_models
+
+# Built-ins register on import.
+import edl_tpu.models.fit_a_line  # noqa: F401
+import edl_tpu.models.mnist  # noqa: F401
+
+__all__ = ["ModelDef", "get_model", "register_model", "registered_models"]

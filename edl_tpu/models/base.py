@@ -1,0 +1,73 @@
+"""Common model interface for the trainer runtime.
+
+The reference framework never sees the model — user code arrives as an
+opaque ``Entrypoint`` + ``TRAINER_PACKAGE`` workspace executed by
+``paddle_k8s`` (``pkg/jobparser.go:288-291``).  Our runtime is the
+training half too, so it defines a minimal functional contract a model
+must satisfy to be trained elastically: pure ``init``/``loss`` functions
+(jit-traceable, shape-static) plus a synthetic-batch generator used by
+tests and benchmarks (real input pipelines plug in at the data-iterator
+layer, not here).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+Batch = Dict[str, Any]
+Params = Any
+
+
+@dataclass(frozen=True)
+class ModelDef:
+    """A trainable model as pure functions.
+
+    - ``init_params(rng)``            -> params pytree
+    - ``loss_fn(params, batch, rng)`` -> (scalar loss, aux metrics dict)
+    - ``synth_batch(rng, n)``         -> host-side numpy batch of size n
+    - ``param_partition(params)``     -> optional PartitionSpec pytree for
+      model-sharded (tp/fsdp) training; None means replicate.
+    """
+
+    name: str
+    init_params: Callable[[jax.Array], Params]
+    loss_fn: Callable[[Params, Batch, jax.Array], Tuple[jax.Array, Dict[str, jax.Array]]]
+    synth_batch: Callable[[Any, int], Batch]
+    param_partition: Optional[Callable[[Params], Any]] = None
+    #: approximate FLOPs per example (fwd+bwd) for MFU accounting; 0 = unknown
+    flops_per_example: int = 0
+
+
+_REGISTRY: Dict[str, Callable[..., ModelDef]] = {}
+
+
+def register_model(name: str):
+    def deco(factory: Callable[..., ModelDef]):
+        _REGISTRY[name] = factory
+        return factory
+
+    return deco
+
+
+def get_model(name: str, **kwargs) -> ModelDef:
+    """Build a registered model by name (used by the CLI/launcher to turn
+    a TrainingJob entrypoint into a runnable model)."""
+    # Import built-ins lazily so registration happens on first lookup.
+    import edl_tpu.models  # noqa: F401
+
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+    return factory(**kwargs)
+
+
+def registered_models():
+    import edl_tpu.models  # noqa: F401
+
+    return sorted(_REGISTRY)

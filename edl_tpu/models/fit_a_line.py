@@ -1,0 +1,52 @@
+"""fit_a_line: linear regression on the UCI-housing-shaped problem.
+
+Benchmark config 1 (BASELINE.md): "fit_a_line linear-regression
+TrainingJob, min=max=1 trainer".  The reference ran this as an external
+PaddlePaddle program; here it is the smallest ModelDef exercising the
+full elastic runtime.  Synthetic data is drawn from a fixed ground-truth
+affine map so loss has a known floor near the noise variance.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models.base import ModelDef, register_model
+
+FEATURES = 13  # UCI housing feature count
+
+
+@register_model("fit_a_line")
+def fit_a_line(features: int = FEATURES, noise: float = 0.01) -> ModelDef:
+    rng_w = np.random.RandomState(0)
+    true_w = rng_w.randn(features).astype(np.float32)
+    true_b = np.float32(0.5)
+
+    def init_params(rng: jax.Array):
+        kw, _ = jax.random.split(rng)
+        return {
+            "w": jax.random.normal(kw, (features,), jnp.float32) * 0.01,
+            "b": jnp.zeros((), jnp.float32),
+        }
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        pred = batch["x"] @ params["w"] + params["b"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"mse": loss}
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        x = rng.randn(n, features).astype(np.float32)
+        y = x @ true_w + true_b + noise * rng.randn(n).astype(np.float32)
+        return {"x": x, "y": y.astype(np.float32)}
+
+    return ModelDef(
+        name="fit_a_line",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        flops_per_example=6 * features,  # fwd 2F + bwd 4F
+    )

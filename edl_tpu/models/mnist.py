@@ -1,0 +1,86 @@
+"""MNIST ConvNet — benchmark config 2 (BASELINE.md): "MNIST ConvNet,
+elastic min=1 max=4 trainers (scale-up under idle cluster)".
+
+A small flax.linen CNN.  Input pipeline note: this environment has no
+egress, so the default data source is a deterministic synthetic
+MNIST-shaped distribution (digit-dependent Gaussian blobs — linearly
+separable enough that loss visibly falls, which is what the elastic
+loss-continuity tests need); a real MNIST ``.npz`` can be supplied to
+the data iterator instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models.base import ModelDef, register_model
+
+NUM_CLASSES = 10
+
+
+class ConvNet(nn.Module):
+    """LeNet-ish ConvNet, bfloat16 compute / float32 params."""
+
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, 28, 28, 1] float32
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(256, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(NUM_CLASSES, dtype=jnp.float32)(x)
+        return x
+
+
+@register_model("mnist")
+def mnist() -> ModelDef:
+    module = ConvNet()
+    sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+
+    def init_params(rng: jax.Array):
+        return module.init(rng, sample)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = module.apply({"params": params}, batch["image"])
+        labels = jax.nn.one_hot(batch["label"], NUM_CLASSES)
+        loss = jnp.mean(
+            -jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1)
+        )
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"loss": loss, "accuracy": acc}
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        label = rng.randint(0, NUM_CLASSES, size=(n,))
+        # Digit-dependent blob: mean brightness pattern per class.
+        base = np.zeros((n, 28, 28, 1), np.float32)
+        for c in range(NUM_CLASSES):
+            idx = label == c
+            if not idx.any():
+                continue
+            patt = np.zeros((28, 28, 1), np.float32)
+            patt[2 + 2 * c : 6 + 2 * c, 4:24, 0] = 1.0
+            base[idx] = patt
+        img = base + 0.3 * rng.randn(n, 28, 28, 1).astype(np.float32)
+        return {"image": img, "label": label.astype(np.int32)}
+
+    # rough: conv1 25*32*24^2*2, conv2 25*32*64*8^2*2, dense 1024*256*2 + 256*10*2
+    flops_fwd = 2 * (25 * 32 * 24 * 24 + 25 * 32 * 64 * 8 * 8 + 1024 * 256 + 256 * 10)
+    return ModelDef(
+        name="mnist",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        flops_per_example=3 * flops_fwd,
+    )

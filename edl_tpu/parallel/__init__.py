@@ -1,0 +1,27 @@
+from edl_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_FSDP,
+    AXIS_TP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_EP,
+    MeshSpec,
+    build_mesh,
+    dp_mesh,
+    batch_sharding,
+    replicated_sharding,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_TP",
+    "AXIS_PP",
+    "AXIS_SP",
+    "AXIS_EP",
+    "MeshSpec",
+    "build_mesh",
+    "dp_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+]

@@ -1,0 +1,134 @@
+"""Device mesh construction and sharding vocabulary.
+
+This replaces the reference system's *entire* communication topology.
+The reference synced gradients through a parameter-server ReplicaSet
+over TCP (``pkg/jobparser.go:74-112``; ports plumbing ``:237-263``) and
+discovered peers via env vars + etcd (``:265-313``).  On TPU none of
+that exists: trainers form a ``jax.sharding.Mesh`` over the slice's ICI
+links, gradient sync is the allreduce XLA inserts for batch-sharded
+computation, and "resizing the pserver pool" becomes "rebuilding the
+mesh at a new world size".
+
+Axis names (the framework-wide sharding vocabulary):
+
+- ``dp``   data parallelism — batch dimension; the *elastic* axis.
+- ``fsdp`` parameter sharding over the dp axis (ZeRO-style).
+- ``tp``   tensor parallelism — hidden/heads dimensions.
+- ``pp``   pipeline parallelism — layer stages.
+- ``sp``   sequence/context parallelism — sequence dimension
+           (ring attention); shares devices with ``tp`` by default.
+- ``ep``   expert parallelism — MoE experts.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+#: Canonical axis order: pipeline outermost (lowest-bandwidth cuts),
+#: then data, then tensor innermost (highest-bandwidth, most-frequent
+#: collectives ride the fastest ICI links).
+CANONICAL_ORDER = (AXIS_PP, AXIS_DP, AXIS_FSDP, AXIS_EP, AXIS_TP, AXIS_SP)
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape: axis name -> size.  Axes of size 1 are kept
+    so PartitionSpecs referring to them stay valid at every scale."""
+
+    axes: Tuple[Tuple[str, int], ...]
+
+    @staticmethod
+    def create(**sizes: int) -> "MeshSpec":
+        unknown = set(sizes) - set(CANONICAL_ORDER)
+        if unknown:
+            raise ValueError(f"unknown mesh axes: {sorted(unknown)}")
+        ordered = tuple(
+            (name, int(sizes.get(name, 1)))
+            for name in CANONICAL_ORDER
+            if name in sizes
+        )
+        for name, size in ordered:
+            if size < 1:
+                raise ValueError(f"axis {name} must have size >= 1, got {size}")
+        return MeshSpec(axes=ordered)
+
+    @property
+    def names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _ in self.axes)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(s for _, s in self.axes)
+
+    def size(self) -> int:
+        out = 1
+        for _, s in self.axes:
+            out *= s
+        return out
+
+    def axis_size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        return 1
+
+
+def build_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all local devices).
+
+    The device list's first ``spec.size()`` entries are used; this is
+    the primitive elasticity builds on — a world of size ``w`` is "the
+    first ``w * chips_per_trainer`` devices of the current membership
+    generation" (ordering agreed through the coordinator, replacing the
+    reference's etcd registry, ref ``pkg/jobparser.go:174-191``)."""
+    if devices is None:
+        devices = jax.devices()
+    n = spec.size()
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {dict(spec.axes)} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n], dtype=object).reshape(spec.shape)
+    return Mesh(arr, axis_names=spec.names)
+
+
+def dp_mesh(
+    num_trainers: int, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Pure data-parallel mesh — the reference's one parallelism strategy
+    (SURVEY.md §2.3), elastic over ``dp``."""
+    return build_mesh(MeshSpec.create(dp=num_trainers), devices)
+
+
+def batch_sharding(mesh: Mesh, *, extra_axes: Sequence[Optional[str]] = ()) -> NamedSharding:
+    """Sharding for a batch-major array: leading dim split over every
+    data-ish mesh axis present (dp and fsdp), remaining dims per
+    ``extra_axes``."""
+    data_axes = tuple(a for a in (AXIS_DP, AXIS_FSDP) if a in mesh.axis_names)
+    lead = data_axes if len(data_axes) > 1 else (data_axes[0] if data_axes else None)
+    return NamedSharding(mesh, P(lead, *extra_axes))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def mesh_debug_string(mesh: Mesh) -> str:
+    return (
+        f"Mesh(shape={dict(zip(mesh.axis_names, mesh.devices.shape))}, "
+        f"devices={mesh.devices.size})"
+    )

@@ -325,12 +325,22 @@ class TrainingJob:
                             v = parse_memory_mega(q)
                         else:
                             v = parse_count(q)
-                    except ValueError as e:
-                        raise ValidationError(str(e)) from None
+                    except (ValueError, TypeError) as e:
+                        raise ValidationError(
+                            f"invalid quantity {key}={q!r}: {e}"
+                        ) from None
                     if v < 0:
                         raise ValidationError(
                             f"resource quantity must be >= 0: {key}={q!r}"
                         )
+        declared_tpu = t.resources.tpu_limit()
+        topo_chips = topology_chips(t.slice_topology)
+        if declared_tpu and declared_tpu != topo_chips:
+            raise ValidationError(
+                f"limits['{TPU_RESOURCE_KEY}']={declared_tpu} contradicts "
+                f"slice_topology {t.slice_topology!r} ({topo_chips} chips); "
+                "omit the limit or make them agree"
+            )
         if s.global_batch_size < 0:
             raise ValidationError("global_batch_size must be >= 0")
         if s.global_batch_size:
@@ -386,24 +396,29 @@ class TrainingJob:
         if d.get("kind", KIND) != KIND:
             raise ValidationError(f"unsupported kind: {d.get('kind')}")
         meta = d.get("metadata", {}) or {}
-        job = TrainingJob(
-            name=meta.get("name", ""),
-            namespace=meta.get("namespace", "default"),
-            labels=dict(meta.get("labels", {}) or {}),
-            spec=TrainingJobSpec.from_dict(d.get("spec")),
-        )
-        st = d.get("status") or {}
-        if st:
-            job.status = TrainingJobStatus(
-                state=JobState(st.get("state", "Created")),
-                parallelism=int(st.get("parallelism", 0)),
-                generation=int(st.get("generation", 0)),
-                running=int(st.get("running", 0)),
-                pending=int(st.get("pending", 0)),
-                message=st.get("message", ""),
-                submitted_at=float(st.get("submitted_at", 0.0)),
-                started_at=float(st.get("started_at", 0.0)),
+        try:
+            job = TrainingJob(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace", "default"),
+                labels=dict(meta.get("labels", {}) or {}),
+                spec=TrainingJobSpec.from_dict(d.get("spec")),
             )
+            st = d.get("status") or {}
+            if st:
+                job.status = TrainingJobStatus(
+                    state=JobState(st.get("state", "Created")),
+                    parallelism=int(st.get("parallelism", 0)),
+                    generation=int(st.get("generation", 0)),
+                    running=int(st.get("running", 0)),
+                    pending=int(st.get("pending", 0)),
+                    message=st.get("message", ""),
+                    submitted_at=float(st.get("submitted_at", 0.0)),
+                    started_at=float(st.get("started_at", 0.0)),
+                )
+        except ValidationError:
+            raise
+        except (ValueError, TypeError) as e:
+            raise ValidationError(f"malformed TrainingJob manifest: {e}") from None
         return job
 
     @staticmethod

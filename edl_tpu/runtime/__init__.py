@@ -1,0 +1,4 @@
+from edl_tpu.runtime.train import TrainState, Trainer
+from edl_tpu.runtime.data import ShardedDataIterator
+
+__all__ = ["TrainState", "Trainer", "ShardedDataIterator"]

@@ -1,0 +1,234 @@
+"""The elastic coordinator — native replacement for master + etcd.
+
+In the reference system, elasticity's *mechanism* lives outside the
+repo: a PaddlePaddle master process with an etcd v3.2.1 sidecar
+(``pkg/jobparser.go:174-232``) tracks trainer membership and
+re-dispatches the data-shard tasks of dead trainers; trainers discover
+it via env plumbing (``pkg/jobparser.go:265-313``).  SURVEY.md §5.3
+calls this "the heart" of the rebuild.
+
+Our coordinator is deliberately tiny because the TPU design needs far
+less: data sharding is a pure function of (seed, step) (see
+``runtime/data.py``) so there are no tasks to re-dispatch, and gradient
+sync needs no server pool.  What remains is *membership truth*:
+
+- which trainers are alive (heartbeats with a deadline)
+- the **generation number** — bumped on every membership/target change
+- the agreed target world size (written by the autoscaler's actuation,
+  the analog of the reference's Parallelism PUT, ``pkg/autoscaler.go:
+  339-376``)
+- the checkpoint index (latest durable step), so joiners know where to
+  resume from
+
+Trainers poll ``plan()`` between steps; when the plan's generation
+differs from theirs they enter the resize barrier (checkpoint, rebuild
+mesh, restore — ``runtime/elastic.py``).
+
+``LocalCoordinator`` is the in-process implementation used by the
+single-host runtime, tests, and the local CLI mode.  A service version
+speaks the same interface over HTTP (``edl_tpu.runtime.coord_service``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class ElasticPlan:
+    """What every trainer must agree on to form a world."""
+
+    generation: int
+    world_size: int
+    #: member trainer ids in rank order (rank = index)
+    members: tuple
+    #: step to restore from when joining this generation (-1: fresh init)
+    restore_step: int = -1
+
+
+@dataclass
+class _Member:
+    trainer_id: str
+    last_heartbeat: float
+    joined_generation: int
+    acked_generation: int = -1
+
+
+class LocalCoordinator:
+    """Thread-safe in-process coordinator.
+
+    Heartbeat liveness replaces etcd leases: a member that misses
+    ``heartbeat_timeout`` seconds is evicted and the generation bumps
+    (failure detection the reference delegated, SURVEY.md §5.3)."""
+
+    def __init__(
+        self,
+        target_world: int = 1,
+        max_world: int = 0,
+        heartbeat_timeout: float = 10.0,
+        legal_sizes: Optional[List[int]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        """``legal_sizes``: world sizes the runtime may form (from
+        ``TrainingJob.legal_world_sizes()`` — divisors of the global
+        batch within [min,max], SURVEY.md §7.4).  The plan quantizes
+        down to the largest legal size <= min(members, target); with no
+        legal size small enough the plan's world_size is 0 and trainers
+        hold at the barrier until membership recovers."""
+        self._lock = threading.Condition()
+        self._members: Dict[str, _Member] = {}
+        self._generation = 0
+        self._target_world = target_world
+        self._max_world = max_world or target_world
+        self._heartbeat_timeout = heartbeat_timeout
+        self._legal_sizes = sorted(set(legal_sizes)) if legal_sizes else None
+        self._clock = clock
+        self._latest_checkpoint_step = -1
+        self._plan: Optional[ElasticPlan] = None
+        self._resize_log: List[dict] = []
+
+    # -- membership (trainer-facing) ----------------------------------------
+    def register(self, trainer_id: str) -> ElasticPlan:
+        """Join the job.  Bumps the generation; returns the new plan."""
+        with self._lock:
+            now = self._clock()
+            self._members[trainer_id] = _Member(
+                trainer_id=trainer_id,
+                last_heartbeat=now,
+                joined_generation=self._generation + 1,
+            )
+            self._rebuild_plan("join")
+            return self._plan
+
+    def deregister(self, trainer_id: str):
+        """Graceful leave (scale-down actuation or shutdown)."""
+        with self._lock:
+            if self._members.pop(trainer_id, None) is not None:
+                self._rebuild_plan("leave")
+
+    def heartbeat(self, trainer_id: str):
+        with self._lock:
+            m = self._members.get(trainer_id)
+            if m is None:
+                raise KeyError(f"unknown trainer {trainer_id}")
+            m.last_heartbeat = self._clock()
+
+    def ack_generation(self, trainer_id: str, generation: int):
+        """Trainer reports it has re-meshed into ``generation``."""
+        with self._lock:
+            m = self._members.get(trainer_id)
+            if m is not None:
+                m.acked_generation = generation
+                self._lock.notify_all()
+
+    # -- control (autoscaler/controller-facing) -----------------------------
+    def set_target_world(self, n: int):
+        """The actuation analog of the reference's Parallelism PUT
+        (``pkg/autoscaler.go:339-376``): declare the desired trainer
+        count; the plan shrinks immediately (members beyond the target
+        drop out of rank order) or grows as new trainers register."""
+        if n < 1:
+            raise ValueError("target world must be >= 1")
+        with self._lock:
+            if n == self._target_world:
+                return
+            self._target_world = n
+            self._rebuild_plan("retarget")
+
+    def evict_dead(self) -> List[str]:
+        """Evict members that missed their heartbeat deadline.  Returns
+        evicted ids.  Called periodically by whoever hosts the
+        coordinator (controller loop or the service's timer)."""
+        with self._lock:
+            now = self._clock()
+            dead = [
+                tid
+                for tid, m in self._members.items()
+                if now - m.last_heartbeat > self._heartbeat_timeout
+            ]
+            for tid in dead:
+                del self._members[tid]
+            if dead:
+                self._rebuild_plan("evict")
+            return dead
+
+    def report_checkpoint(self, step: int):
+        with self._lock:
+            if step > self._latest_checkpoint_step:
+                self._latest_checkpoint_step = step
+
+    # -- queries ------------------------------------------------------------
+    def plan(self) -> Optional[ElasticPlan]:
+        with self._lock:
+            return self._plan
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return list(self._members)
+
+    def latest_checkpoint_step(self) -> int:
+        with self._lock:
+            return self._latest_checkpoint_step
+
+    def resize_log(self) -> List[dict]:
+        with self._lock:
+            return list(self._resize_log)
+
+    def wait_all_acked(self, generation: int, timeout: float = 60.0) -> bool:
+        """Block until every planned member acked ``generation`` (the
+        resize barrier's coordinator side)."""
+        deadline = self._clock() + timeout
+        with self._lock:
+            while True:
+                plan = self._plan
+                if plan is not None and plan.generation >= generation:
+                    acked = all(
+                        self._members[tid].acked_generation >= plan.generation
+                        for tid in plan.members
+                        if tid in self._members
+                    )
+                    if acked:
+                        return True
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(timeout=min(remaining, 0.5))
+
+    # -- internals ----------------------------------------------------------
+    def _rebuild_plan(self, reason: str):
+        """Recompute the plan after any membership/target change.  Caller
+        holds the lock."""
+        self._generation += 1
+        # Rank order: stable by join time (dict preserves insertion);
+        # members beyond the target world wait in standby (they keep
+        # heartbeating and join when the target grows — the analog of
+        # pending pods the kube Job controller will fold in).
+        alive = list(self._members)
+        world = min(len(alive), self._target_world)
+        if self._legal_sizes is not None:
+            fitting = [s for s in self._legal_sizes if s <= world]
+            world = fitting[-1] if fitting else 0
+        active = tuple(alive[:world])
+        self._plan = ElasticPlan(
+            generation=self._generation,
+            world_size=len(active),
+            members=active,
+            restore_step=self._latest_checkpoint_step,
+        )
+        self._resize_log.append(
+            {
+                "t": self._clock(),
+                "generation": self._generation,
+                "reason": reason,
+                "world_size": len(active),
+                "members": active,
+            }
+        )
+        self._lock.notify_all()

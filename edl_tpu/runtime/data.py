@@ -1,0 +1,126 @@
+"""Deterministic sharded data iteration.
+
+In the reference system, data sharding under elasticity is the
+fault-tolerant master's job: it hands out data-shard *tasks* via etcd so
+dead trainers' shards get re-dispatched (SURVEY.md §5.3; the master is
+external, ``pkg/jobparser.go:194-232``).  The TPU-native design needs no
+task queue: make the global batch for step ``k`` a **pure function of
+(seed, step)**, and give each trainer the ``rank``-th contiguous slice.
+Then any membership change is automatically consistent — a new world
+size just re-slices the same deterministic global batch stream, and
+resume-after-restore replays from the checkpointed step with identical
+data.  (This is the fixed-global-batch policy of SURVEY.md §7.4: LR and
+batch semantics are invariant to world size.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ShardedDataIterator:
+    """Index-based deterministic iterator over an in-memory dataset.
+
+    ``dataset`` is a dict of host numpy arrays sharing a leading
+    dimension.  Epoch shuffles are derived from ``seed`` and the epoch
+    number only, so two trainers (or the same trainer before and after a
+    resize) agree on every batch without communicating.
+    """
+
+    def __init__(
+        self,
+        dataset: Dict[str, np.ndarray],
+        global_batch_size: int,
+        seed: int = 0,
+    ):
+        if not dataset:
+            raise ValueError("dataset must be non-empty")
+        sizes = {k: len(v) for k, v in dataset.items()}
+        if len(set(sizes.values())) != 1:
+            raise ValueError(f"dataset arrays disagree on length: {sizes}")
+        self.dataset = dataset
+        self.n = next(iter(sizes.values()))
+        if global_batch_size <= 0:
+            raise ValueError("global_batch_size must be positive")
+        if global_batch_size > self.n:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} exceeds dataset size {self.n}"
+            )
+        self.global_batch_size = global_batch_size
+        self.seed = seed
+        self.batches_per_epoch = self.n // global_batch_size
+
+    # -- determinism core ---------------------------------------------------
+    def global_indices(self, step: int) -> np.ndarray:
+        """Dataset indices of step ``step``'s global batch (pure function)."""
+        if step < 0:
+            raise ValueError("step must be >= 0")
+        epoch, within = divmod(step, self.batches_per_epoch)
+        perm = np.random.RandomState(
+            np.uint32(self.seed * 1_000_003 + epoch)
+        ).permutation(self.n)
+        lo = within * self.global_batch_size
+        return perm[lo : lo + self.global_batch_size]
+
+    def host_batch(
+        self, step: int, world: int = 1, rank: int = 0
+    ) -> Dict[str, np.ndarray]:
+        """Rank-local slice of the global batch for ``step``.
+
+        The global batch is always the same for a given step; ``world``
+        only controls how it is sliced (ref contrast: pserver sharding
+        pinned counts at job start, ``pkg/jobparser.go:298``)."""
+        if not (0 <= rank < world):
+            raise ValueError(f"rank {rank} out of range for world {world}")
+        if self.global_batch_size % world != 0:
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by world {world}"
+            )
+        idx = self.global_indices(step)
+        per = self.global_batch_size // world
+        sl = idx[rank * per : (rank + 1) * per]
+        return {k: v[sl] for k, v in self.dataset.items()}
+
+    # -- device placement ---------------------------------------------------
+    def device_batch(self, step: int, mesh: Mesh, batch_axes=("dp",)) -> Dict[str, Any]:
+        """Global batch placed on ``mesh``, batch dim sharded over
+        ``batch_axes``.
+
+        Single-process path: materialize the global batch and let
+        ``jax.device_put`` scatter it.  Multi-process path: each process
+        materializes only its addressable shard and assembles the global
+        array via ``jax.make_array_from_process_local_data`` (the
+        multi-host analog of the reference's per-trainer data streams)."""
+        axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+        lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+
+        def spec_for(ndim: int) -> P:
+            return P(lead, *([None] * (ndim - 1)))
+
+        if jax.process_count() > 1:  # pragma: no cover - needs real multi-host
+            world = jax.process_count()
+            local = self.host_batch(step, world, jax.process_index())
+            out = {}
+            for k, v in local.items():
+                sharding = NamedSharding(mesh, spec_for(v.ndim))
+                gshape = (self.global_batch_size,) + v.shape[1:]
+                out[k] = jax.make_array_from_process_local_data(sharding, v, gshape)
+            return out
+        gb = {k: v[self.global_indices(step)] for k, v in self.dataset.items()}
+        return {
+            k: jax.device_put(v, NamedSharding(mesh, spec_for(v.ndim)))
+            for k, v in gb.items()
+        }
+
+
+def synthetic_dataset(
+    model_synth_batch, n_examples: int, seed: int = 0
+) -> Dict[str, np.ndarray]:
+    """Materialize a fixed synthetic dataset from a ModelDef's batch
+    generator (deterministic in ``seed``)."""
+    rng = np.random.RandomState(seed)
+    return model_synth_batch(rng, n_examples)

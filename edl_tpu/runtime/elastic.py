@@ -1,0 +1,226 @@
+"""The elastic training loop: re-mesh, restore, resume.
+
+This is the capability the whole reference system exists to enable but
+never itself implements (SURVEY.md §3.3: "everything downstream of the
+[Parallelism] PUT is delegated").  The contract EDL imposed on its
+external runtime — "I will add and remove trainer pods at any time; you
+must tolerate membership churn" — is discharged here natively:
+
+1. Between steps, the trainer compares its generation with the
+   coordinator's plan (poll — the analog of watching etcd).
+2. On a generation change it runs the **resize barrier**:
+   a. graceful resize: finish the in-flight step, synchronously flush a
+      fresh checkpoint to host DRAM (no lost steps);
+      failure recovery: skip the flush (state is gone), fall back to
+      the last async checkpoint and *replay* — deterministic data
+      (``runtime/data.py``) makes the replay bit-identical.
+   b. rebuild the device mesh at the new world size,
+   c. restore state onto the new mesh (resharding in ``checkpoint``),
+   d. ack the generation and resume stepping.
+3. Every ``checkpoint_interval`` steps it snapshots asynchronously —
+   the always-warm restore source that keeps resizes under the 60s
+   north-star budget (BASELINE.md).
+
+Compiled-step reuse: Trainers are cached per world size, so returning
+to a previously seen size pays zero recompilation — and
+``precompile()`` can warm every legal world size up front
+(SURVEY.md §7.4 "pre-compile per legal mesh size").
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import optax
+
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import ModelDef
+from edl_tpu.parallel.mesh import dp_mesh
+from edl_tpu.runtime.coordinator import ElasticPlan, LocalCoordinator
+from edl_tpu.runtime.data import ShardedDataIterator
+from edl_tpu.runtime.train import Trainer, TrainState
+
+
+@dataclass
+class ResizeEvent:
+    generation: int
+    world_size: int
+    seconds: float
+    restored_step: int
+    replayed_steps: int
+    graceful: bool
+
+
+@dataclass
+class StepRecord:
+    step: int
+    generation: int
+    world_size: int
+    loss: float
+    seconds: float
+
+
+class ElasticTrainer:
+    """Single-host elastic runtime driving the whole world.
+
+    In production each host runs one of these over its slice of the
+    processes; in local/test mode it drives all ``world_size`` simulated
+    trainers at once (one device == one trainer replica), which
+    exercises the identical re-mesh/restore path.
+    """
+
+    def __init__(
+        self,
+        model: ModelDef,
+        optimizer: optax.GradientTransformation,
+        data: ShardedDataIterator,
+        coordinator: LocalCoordinator,
+        store: Optional[HostDRAMStore] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        devices_per_trainer: int = 1,
+        checkpoint_interval: int = 50,
+        seed: int = 0,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.data = data
+        self.coordinator = coordinator
+        self.store = store if store is not None else HostDRAMStore()
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self.devices_per_trainer = devices_per_trainer
+        self.checkpoint_interval = checkpoint_interval
+        self.seed = seed
+
+        self.generation = -1
+        self.mesh = None
+        self.state: Optional[TrainState] = None
+        self._trainers: Dict[int, Trainer] = {}  # world_size -> compiled Trainer
+        self._last_completed_step = 0
+
+        self.resize_events: List[ResizeEvent] = []
+        self.history: List[StepRecord] = []
+
+    # -- trainer cache ------------------------------------------------------
+    def _trainer_for(self, world_size: int) -> Trainer:
+        tr = self._trainers.get(world_size)
+        if tr is None:
+            mesh = dp_mesh(world_size * self.devices_per_trainer, self.devices)
+            tr = Trainer(self.model, self.optimizer, mesh, seed=self.seed)
+            self._trainers[world_size] = tr
+        return tr
+
+    def precompile(self, world_sizes: Sequence[int]):
+        """Warm the compiled-step cache for every legal world size
+        (avoids JIT cost inside the resize window)."""
+        for w in world_sizes:
+            tr = self._trainer_for(w)
+            state = tr.init_state()
+            batch = self.data.device_batch(0, tr.mesh)
+            tr.lower_step(state, batch)
+
+    # -- fault injection (what the reference never had; SURVEY.md §5.3) -----
+    def inject_failure(self):
+        """Simulate losing the world's device state mid-run (e.g. a host
+        dies).  The next resize must fall back to the last *async*
+        checkpoint and replay."""
+        self.state = None
+
+    # -- resize barrier -----------------------------------------------------
+    def _resize(self, plan: ElasticPlan) -> None:
+        t0 = time.perf_counter()
+        graceful = self.state is not None
+
+        if graceful:
+            # Flush a fresh checkpoint so no steps are lost.
+            self.store.save_async(self.state, generation=plan.generation)
+            self.store.wait()
+            self.coordinator.report_checkpoint(int(self.state.step))
+
+        trainer = self._trainer_for(plan.world_size)
+        self.mesh = trainer.mesh
+
+        ckpt = self.store.latest()
+        if ckpt is None:
+            # Fresh job: initialize on the new mesh.
+            self.state = trainer.init_state()
+            restored_step = 0
+        else:
+            self.state = self.store.restore(ckpt, trainer.mesh)
+            restored_step = int(ckpt.step)
+        replayed = max(0, self._last_completed_step - restored_step)
+
+        self.generation = plan.generation
+        seconds = time.perf_counter() - t0
+        self.resize_events.append(
+            ResizeEvent(
+                generation=plan.generation,
+                world_size=plan.world_size,
+                seconds=seconds,
+                restored_step=restored_step,
+                replayed_steps=replayed,
+                graceful=graceful,
+            )
+        )
+        for tid in plan.members:
+            self.coordinator.ack_generation(tid, plan.generation)
+
+    def maybe_resize(self) -> bool:
+        plan = self.coordinator.plan()
+        if plan is None or plan.world_size < 1:
+            return False
+        if plan.generation == self.generation and self.state is not None:
+            return False
+        self._resize(plan)
+        return True
+
+    # -- the loop -----------------------------------------------------------
+    def run(
+        self,
+        num_steps: int,
+        on_step: Optional[Callable[[StepRecord], None]] = None,
+    ) -> List[StepRecord]:
+        """Run until the global step counter reaches ``num_steps``.
+
+        The step counter lives in TrainState and survives resizes, so
+        ``num_steps`` counts *completed global steps*, not loop
+        iterations (replayed steps after a failure re-run the same
+        step numbers)."""
+        while True:
+            self.maybe_resize()
+            if self.state is None:
+                raise RuntimeError("no plan with world_size >= 1 available")
+            step = int(self.state.step)
+            if step >= num_steps:
+                break
+            trainer = self._trainers[self._world_size()]
+            t0 = time.perf_counter()
+            batch = self.data.device_batch(step, trainer.mesh)
+            self.state, metrics = trainer.step(self.state, batch)
+            loss = float(metrics["loss"])
+            rec = StepRecord(
+                step=step,
+                generation=self.generation,
+                world_size=self._world_size(),
+                loss=loss,
+                seconds=time.perf_counter() - t0,
+            )
+            self.history.append(rec)
+            if on_step is not None:
+                on_step(rec)
+            done_step = step + 1
+            self._last_completed_step = max(self._last_completed_step, done_step)
+            if (
+                self.checkpoint_interval > 0
+                and done_step % self.checkpoint_interval == 0
+            ):
+                self.store.save_async(self.state, generation=self.generation)
+                self.coordinator.report_checkpoint(done_step)
+        return self.history
+
+    def _world_size(self) -> int:
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return sizes.get("dp", 1) // self.devices_per_trainer or 1

@@ -1,0 +1,141 @@
+"""The pjit data-parallel train step — the pserver replacement.
+
+In the reference system a training step's gradient sync crossed process
+boundaries: trainer -> pserver TCP push/pull, with pserver count pinned
+at job submission (``PADDLE_INIT_NUM_GRADIENT_SERVERS`` fixed to
+MinInstance, ``pkg/jobparser.go:298`` — sync SGD wasn't even
+elastic-aware, SURVEY.md §7.4).  Here the whole step — forward,
+backward, gradient allreduce over ICI, optimizer update — is ONE
+XLA-compiled program over a ``jax.sharding.Mesh``: batch sharded on the
+``dp`` axis, params replicated (or sharded via the model's partition
+rules), XLA inserting the collectives.  Elasticity = constructing a new
+``Trainer`` over a different-size mesh and restoring state onto it
+(see ``edl_tpu.runtime.elastic``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.models.base import ModelDef
+from edl_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP
+
+
+@struct.dataclass
+class TrainState:
+    """Minimal train state pytree: step counter, params, optimizer state.
+
+    Deliberately not flax's TrainState: checkpoint/restore (with
+    resharding) wants a plain pytree with no bound apply_fn."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+class Trainer:
+    """Compiles and runs the train step for one (model, optimizer, mesh).
+
+    One Trainer == one world-size generation.  On resize, the elastic
+    runtime builds a fresh Trainer over the new mesh and moves state
+    into it via the checkpoint store.
+    """
+
+    def __init__(
+        self,
+        model: ModelDef,
+        optimizer: optax.GradientTransformation,
+        mesh: Mesh,
+        seed: int = 0,
+        donate: bool = True,
+    ):
+        self.model = model
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.seed = seed
+        self._base_rng = jax.random.key(seed)
+
+        # Parameter shardings: model partition rules if provided, else
+        # fully replicated (pure DP).
+        self._param_spec_fn = model.param_partition
+
+        def init_fn(rng):
+            params = model.init_params(rng)
+            opt_state = optimizer.init(params)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
+            )
+
+        self._init_fn = init_fn
+
+        def train_step(state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+            step_rng = jax.random.fold_in(self._base_rng, state.step)
+
+            def loss_of(p):
+                loss, aux = model.loss_fn(p, batch, step_rng)
+                return loss, aux
+
+            (loss, aux), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                state.params
+            )
+            updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            )
+            metrics = dict(aux)
+            metrics["loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return new_state, metrics
+
+        donate_args = (0,) if donate else ()
+        self._step = jax.jit(train_step, donate_argnums=donate_args)
+        self._eval_loss = jax.jit(
+            lambda state, batch: model.loss_fn(
+                state.params, batch, jax.random.key(0)
+            )[0]
+        )
+
+    # -- shardings ----------------------------------------------------------
+    def state_sharding(self, state_shape=None) -> Any:
+        """NamedSharding pytree for TrainState on this mesh."""
+        if self._param_spec_fn is None:
+            return NamedSharding(self.mesh, P())
+        raise NotImplementedError(
+            "model-sharded states resolve per-leaf specs; see parallel.sharded"
+        )
+
+    def init_state(self) -> TrainState:
+        """Initialize state directly on the mesh, params replicated."""
+        rng = jax.random.key(self.seed)
+        out_sharding = NamedSharding(self.mesh, P())
+        with self.mesh:
+            init = jax.jit(self._init_fn, out_shardings=out_sharding)
+            return init(rng)
+
+    # -- stepping -----------------------------------------------------------
+    def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
+        with self.mesh:
+            return self._step(state, batch)
+
+    def eval_loss(self, state: TrainState, batch) -> jax.Array:
+        with self.mesh:
+            return self._eval_loss(state, batch)
+
+    def lower_step(self, state, batch):
+        """AOT lowering hook: pre-compile the step for this mesh size so a
+        resize pays no JIT cost on its first step (<60s resize budget,
+        BASELINE.md)."""
+        return self._step.lower(state, batch).compile()
+
+    @property
+    def world_size(self) -> int:
+        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        return sizes.get(AXIS_DP, 1) * sizes.get(AXIS_FSDP, 1)

@@ -8,7 +8,9 @@ meshes and elastic resize tests (1 -> 2 -> 4 -> 8 trainers).
 
 import os
 
-# Must run before jax initializes any backend.
+# Must run before jax initializes any backend.  NOTE: this environment's
+# sitecustomize imports jax at interpreter start (TPU plugin), so the
+# env var alone is too late — jax.config.update below is authoritative.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
@@ -16,6 +18,10 @@ if "--xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,209 @@
+"""Elastic protocol tests: coordinator membership/generations, graceful
+resize with loss continuity, failure recovery with deterministic replay.
+
+This is the capability the reference system imposes on its (external)
+runtime — "tolerate membership churn at any time" (SURVEY.md §0) — and
+the part SURVEY.md §7.4 calls the hard part: resize correctness with
+reproducible loss continuation.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models import get_model
+from edl_tpu.runtime import ShardedDataIterator
+from edl_tpu.runtime.coordinator import LocalCoordinator
+from edl_tpu.runtime.data import synthetic_dataset
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+
+def make_world(target_world=2, n_trainers=2, ckpt_interval=5, seed=0):
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=target_world, max_world=8)
+    for i in range(n_trainers):
+        coord.register(f"tr{i}")
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-2),
+        it,
+        coord,
+        checkpoint_interval=ckpt_interval,
+        seed=seed,
+    )
+    return et, coord
+
+
+# ---- coordinator unit tests ----------------------------------------------
+
+
+def test_coordinator_membership_and_generations():
+    c = LocalCoordinator(target_world=2)
+    p1 = c.register("a")
+    assert p1.world_size == 1 and p1.members == ("a",)
+    p2 = c.register("b")
+    assert p2.world_size == 2 and p2.members == ("a", "b")
+    assert p2.generation > p1.generation
+    # standby: target is 2, a third member waits in the wings
+    p3 = c.register("c")
+    assert p3.world_size == 2 and p3.members == ("a", "b")
+    # leave of an active member promotes the standby
+    c.deregister("a")
+    p4 = c.plan()
+    assert p4.members == ("b", "c")
+    assert p4.world_size == 2
+
+
+def test_coordinator_retarget():
+    c = LocalCoordinator(target_world=4)
+    for t in "abcd":
+        c.register(t)
+    g = c.plan().generation
+    c.set_target_world(2)
+    p = c.plan()
+    assert p.world_size == 2 and p.generation > g
+    c.set_target_world(2)  # no-op must not bump generation
+    assert c.plan().generation == p.generation
+    with pytest.raises(ValueError):
+        c.set_target_world(0)
+
+
+def test_coordinator_quantizes_to_legal_world_sizes():
+    """3 live members with legal sizes {1,2,4} must plan world=2, never 3
+    (global batch divisibility, SURVEY.md §7.4 slice quantization)."""
+    c = LocalCoordinator(target_world=4, legal_sizes=[1, 2, 4])
+    for t in "abc":
+        c.register(t)
+    p = c.plan()
+    assert p.world_size == 2 and p.members == ("a", "b")
+    c.register("d")
+    assert c.plan().world_size == 4
+    # No legal size fits 0 members... and with legal floor above members:
+    c2 = LocalCoordinator(target_world=4, legal_sizes=[4])
+    c2.register("x")
+    assert c2.plan().world_size == 0  # hold at barrier, don't crash
+
+
+def test_coordinator_heartbeat_eviction():
+    fake_now = [0.0]
+    c = LocalCoordinator(target_world=2, heartbeat_timeout=5.0, clock=lambda: fake_now[0])
+    c.register("a")
+    c.register("b")
+    fake_now[0] = 3.0
+    c.heartbeat("a")
+    fake_now[0] = 7.0  # b last beat at 0 -> dead; a beat at 3 -> alive
+    dead = c.evict_dead()
+    assert dead == ["b"]
+    assert c.plan().members == ("a",)
+    with pytest.raises(KeyError):
+        c.heartbeat("b")
+
+
+# ---- elastic training ------------------------------------------------------
+
+
+def test_elastic_run_fresh_start():
+    et, coord = make_world(target_world=2, n_trainers=2)
+    hist = et.run(10)
+    assert [r.step for r in hist] == list(range(10))
+    assert all(r.world_size == 2 for r in hist)
+    assert len(et.resize_events) == 1  # initial mesh formation
+    assert not et.resize_events[0].graceful  # fresh init, nothing restored
+
+
+def test_graceful_resize_loss_continuity():
+    """Scale 2 -> 4 mid-run; trajectory must be IDENTICAL to never
+    resizing (sync DP + fixed global batch + deterministic data)."""
+    # Uninterrupted reference run at world=2.
+    ref, _ = make_world(target_world=2, n_trainers=2)
+    ref_hist = ref.run(20)
+
+    et, coord = make_world(target_world=2, n_trainers=4)
+    et.run(10)
+    coord.set_target_world(4)  # the autoscaler's Parallelism PUT analog
+    hist = et.run(20)
+
+    assert hist[9].world_size == 2 and hist[10].world_size == 4
+    # No steps lost or duplicated at the graceful boundary.
+    assert [r.step for r in hist] == list(range(20))
+    np.testing.assert_allclose(
+        [r.loss for r in hist], [r.loss for r in ref_hist], rtol=1e-5
+    )
+    # Two resizes: initial formation + the growth.
+    assert len(et.resize_events) == 2
+    grow = et.resize_events[1]
+    assert grow.graceful and grow.world_size == 4 and grow.replayed_steps == 0
+
+
+def test_scale_down_and_back_up_reuses_compiled_trainer():
+    et, coord = make_world(target_world=4, n_trainers=4)
+    et.run(5)
+    coord.set_target_world(2)
+    et.run(10)
+    coord.set_target_world(4)
+    et.run(15)
+    assert [r.step for r in et.history] == list(range(15))
+    # Trainer cache: worlds 4 and 2 compiled once each.
+    assert sorted(et._trainers) == [2, 4]
+
+
+def test_failure_recovery_replays_deterministically():
+    """Kill the world mid-run; recovery restores the last async
+    checkpoint and replays — final trajectory identical to a run that
+    never failed."""
+    ref, _ = make_world(target_world=2, n_trainers=2, ckpt_interval=5)
+    ref_hist = ref.run(20)
+
+    et, coord = make_world(target_world=2, n_trainers=2, ckpt_interval=5)
+    et.run(13)  # last checkpoint at step 10
+    et.store.wait()
+    et.inject_failure()  # device state gone
+    # Failure detection: trainer 1 dies with the host; coordinator evicts
+    # it and re-plans (shrink to 1).
+    coord.deregister("tr1")
+    hist = et.run(20)
+
+    ev = et.resize_events[-1]
+    assert not ev.graceful
+    assert ev.restored_step == 10
+    assert ev.replayed_steps == 3  # steps 10,11,12 re-run
+    # Steps replay: history contains 10..12 twice, identical losses.
+    steps = [r.step for r in et.history]
+    assert steps == list(range(13)) + list(range(10, 20))
+    final = {r.step: r.loss for r in et.history[13:]}
+    ref_final = {r.step: r.loss for r in ref_hist if r.step >= 10}
+    for s in ref_final:
+        np.testing.assert_allclose(final[s], ref_final[s], rtol=1e-5)
+
+
+def test_precompile_makes_resize_cheap():
+    et, coord = make_world(target_world=2, n_trainers=4)
+    et.precompile([1, 2, 4])
+    assert sorted(et._trainers) == [1, 2, 4]
+    et.run(5)
+    coord.set_target_world(4)
+    et.run(8)
+    # The growth resize must not have compiled anything new.
+    assert sorted(et._trainers) == [1, 2, 4]
+    grow = et.resize_events[-1]
+    assert grow.seconds < 5.0  # no JIT in the window (CPU headroom-safe bound)
+
+
+def test_mnist_elastic_smoke():
+    """MNIST ConvNet elastic min=1 max=4 — benchmark config 2 shape."""
+    model = get_model("mnist")
+    ds = synthetic_dataset(model.synth_batch, 256, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=32, seed=0)
+    coord = LocalCoordinator(target_world=1, max_world=4)
+    coord.register("tr0")
+    et = ElasticTrainer(model, optax.adam(1e-3), it, coord, checkpoint_interval=4)
+    et.run(6)
+    for t in ("tr1", "tr2", "tr3"):
+        coord.register(t)
+    coord.set_target_world(4)
+    hist = et.run(12)
+    assert hist[-1].world_size == 4
+    assert np.isfinite(hist[-1].loss)

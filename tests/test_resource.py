@@ -155,6 +155,32 @@ def test_validate_rejects_negative_resources():
         j.validate()
 
 
+def test_validate_wraps_all_malformed_input_as_validation_error():
+    # Non-scalar quantity (easy YAML typo) must not escape as TypeError.
+    j = make_job()
+    j.spec.trainer.resources = ResourceSpec(requests={"cpu": {"oops": 1}})
+    with pytest.raises(ValidationError):
+        j.validate()
+    # Malformed manifest fields (null port, bogus status state).
+    with pytest.raises(ValidationError):
+        TrainingJob.from_manifest(
+            {"metadata": {"name": "x"}, "spec": {"port": None}}
+        )
+    with pytest.raises(ValidationError):
+        TrainingJob.from_manifest(
+            {"metadata": {"name": "x"}, "status": {"state": "Bogus"}}
+        )
+
+
+def test_validate_rejects_tpu_limit_topology_contradiction():
+    j = make_job(slice_topology="v5e-4")
+    j.spec.trainer.resources = ResourceSpec(limits={TPU_RESOURCE_KEY: "8"})
+    with pytest.raises(ValidationError):
+        j.validate()
+    j.spec.trainer.resources = ResourceSpec(limits={TPU_RESOURCE_KEY: "4"})
+    j.validate()
+
+
 def test_validate_unknown_topology_is_validation_error():
     # validate() must raise ValidationError (not bare ValueError) for every
     # invalid-spec path so submit paths can catch one exception type.

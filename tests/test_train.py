@@ -1,0 +1,127 @@
+"""Trainer runtime core tests: mesh, data determinism, DP train step.
+
+The reference has no trainer-side tests at all (training was external,
+SURVEY.md §3.5); these cover the new half on an 8-device virtual CPU
+mesh per SURVEY.md §4's recommendation.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from edl_tpu.models import get_model
+from edl_tpu.parallel import MeshSpec, build_mesh, dp_mesh
+from edl_tpu.runtime import ShardedDataIterator, Trainer
+from edl_tpu.runtime.data import synthetic_dataset
+
+
+def test_mesh_spec():
+    s = MeshSpec.create(dp=4, tp=2)
+    assert s.size() == 8
+    assert s.names == ("dp", "tp")  # canonical order
+    assert s.axis_size("dp") == 4
+    assert s.axis_size("pp") == 1
+    with pytest.raises(ValueError):
+        MeshSpec.create(bogus=2)
+    with pytest.raises(ValueError):
+        MeshSpec.create(dp=0)
+
+
+def test_build_mesh(devices8):
+    mesh = build_mesh(MeshSpec.create(dp=2, tp=2), devices8)
+    assert mesh.devices.shape == (2, 2)
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec.create(dp=16), devices8)
+
+
+def test_data_determinism():
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=3)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=7)
+
+    # Same step -> same global indices, independent of who asks.
+    assert np.array_equal(it.global_indices(5), it.global_indices(5))
+    # Distinct steps within an epoch are disjoint.
+    a, b = it.global_indices(0), it.global_indices(1)
+    assert not set(a) & set(b)
+    # A world of 2 slices the same global batch that a world of 1 sees.
+    full = it.host_batch(3, world=1, rank=0)
+    r0 = it.host_batch(3, world=2, rank=0)
+    r1 = it.host_batch(3, world=2, rank=1)
+    assert np.array_equal(np.concatenate([r0["x"], r1["x"]]), full["x"])
+    # Bad shapes are rejected.
+    with pytest.raises(ValueError):
+        it.host_batch(0, world=5, rank=0)  # 64 % 5 != 0
+    with pytest.raises(ValueError):
+        it.host_batch(0, world=2, rank=2)
+
+
+def test_dp_training_learns(devices8):
+    model = get_model("fit_a_line")
+    mesh = dp_mesh(4, devices8)
+    trainer = Trainer(model, optax.adam(1e-1), mesh, seed=0)
+    state = trainer.init_state()
+    ds = synthetic_dataset(model.synth_batch, 1024, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=256, seed=0)
+
+    first_loss = None
+    for step in range(60):
+        batch = it.device_batch(step, mesh)
+        state, metrics = trainer.step(state, batch)
+        if first_loss is None:
+            first_loss = float(metrics["loss"])
+    final_loss = float(metrics["loss"])
+    assert final_loss < first_loss * 0.05, (first_loss, final_loss)
+    assert int(state.step) == 60
+
+
+def test_dp_matches_single_device(devices8):
+    """Gradient sync over the mesh must be mathematically identical to
+    single-device training on the same global batch (the property the
+    reference's async pserver could NOT give; ours is exact sync DP)."""
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 256, seed=1)
+
+    losses = {}
+    for world in (1, 4):
+        mesh = dp_mesh(world, devices8)
+        trainer = Trainer(model, optax.sgd(1e-2), mesh, seed=0)
+        state = trainer.init_state()
+        it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+        trace = []
+        for step in range(5):
+            batch = it.device_batch(step, mesh)
+            state, m = trainer.step(state, batch)
+            trace.append(float(m["loss"]))
+        losses[world] = trace
+    np.testing.assert_allclose(losses[1], losses[4], rtol=1e-5)
+
+
+def test_mnist_smoke(devices8):
+    model = get_model("mnist")
+    mesh = dp_mesh(2, devices8)
+    trainer = Trainer(model, optax.adam(1e-3), mesh, seed=0)
+    state = trainer.init_state()
+    ds = synthetic_dataset(model.synth_batch, 256, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=32, seed=0)
+    for step in range(8):
+        batch = it.device_batch(step, mesh)
+        state, metrics = trainer.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    acc0 = float(metrics["accuracy"])
+    for step in range(8, 40):
+        batch = it.device_batch(step, mesh)
+        state, metrics = trainer.step(state, batch)
+    # synthetic blobs are nearly separable; the net should beat chance.
+    assert float(metrics["accuracy"]) > 0.3, (acc0, float(metrics["accuracy"]))
+
+
+def test_model_registry():
+    from edl_tpu.models import registered_models
+
+    assert "fit_a_line" in registered_models()
+    assert "mnist" in registered_models()
+    with pytest.raises(ValueError):
+        get_model("nope")

@@ -1,0 +1,125 @@
+"""Benchmark driver: prints ONE JSON line for the round record.
+
+Headline metric: **elastic resize latency** — seconds from "resize
+requested" to "stepping again on the new mesh" (checkpoint flush ->
+re-mesh -> restore -> first step).  This is the north-star number in
+BASELINE.md: the reference publishes no benchmarks (SURVEY.md §6), so
+the target is the <60s re-converge budget from BASELINE.json.
+``vs_baseline`` = 60 / measured_seconds: 1.0 is exactly on budget,
+>1 is that many times faster than budget.
+
+Runs on whatever accelerator jax finds (the driver provides one real
+TPU chip); world sizes cycle over the available devices the same way
+the elastic runtime does in production.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+
+RESIZE_BUDGET_S = 60.0
+
+
+def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
+    import jax
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    sizes = sorted({1, max(1, n_dev // 2), n_dev})
+
+    model = get_model(model_name)
+    data = ShardedDataIterator(
+        synthetic_dataset(model.synth_batch, 4096),
+        global_batch_size=max(64, 8 * n_dev),
+    )
+    coord = LocalCoordinator(target_world=1, max_world=n_dev)
+    for i in range(n_dev):
+        coord.register(f"t{i}")
+    et = ElasticTrainer(
+        model,
+        optax.sgd(0.05),
+        data,
+        coord,
+        devices=devices,
+        checkpoint_interval=5,
+    )
+    # Warm the compiled-step cache for every size so the measured window
+    # is the true resize path, not first-compile (production pre-compiles
+    # per legal mesh size; SURVEY.md §7.4).
+    et.precompile(sizes)
+    target = steps_per_phase
+    et.run(target)
+
+    resize_windows = []
+    step_times = []
+    # Cycle up then down through world sizes (e.g. 1 -> 4 -> 8 -> 4 -> 1).
+    # On a single chip every entry is 1: the resize is then forced via
+    # membership churn (leave+rejoin), which runs the identical barrier.
+    cycle = (sizes[1:] + sizes[:-1][::-1]) or [1, 1, 1]
+    prev_w = sizes[0]
+    for w in cycle:
+        if w == prev_w:
+            coord.deregister(f"t{w - 1}")
+            coord.register(f"t{w - 1}")
+        else:
+            coord.set_target_world(w)
+        prev_w = w
+        t0 = time.perf_counter()
+        et.maybe_resize()
+        target += steps_per_phase
+        et.run(target)
+        gen = et.generation
+        first = next(r for r in et.history if r.generation == gen)
+        # Window = resize barrier (event.seconds) + first post-resize step.
+        event = et.resize_events[-1]
+        assert event.generation == gen
+        resize_windows.append(event.seconds + first.seconds)
+        step_times.extend(r.seconds for r in et.history[-3:])
+        del t0
+
+    # Join any in-flight async checkpoint thread before teardown (a live
+    # device->host copy racing interpreter exit aborts the TPU runtime).
+    et.store.wait()
+
+    return {
+        "resize_s": statistics.median(resize_windows),
+        "resize_max_s": max(resize_windows),
+        "step_s": statistics.median(step_times),
+        "n_devices": n_dev,
+        "world_cycle": cycle,
+    }
+
+
+def main():
+    r = bench_resize()
+    value = round(r["resize_s"], 4)
+    print(
+        json.dumps(
+            {
+                "metric": "elastic_resize_latency",
+                "value": value,
+                "unit": "s",
+                "vs_baseline": round(RESIZE_BUDGET_S / max(value, 1e-9), 2),
+                "detail": {
+                    "resize_max_s": round(r["resize_max_s"], 4),
+                    "median_step_s": round(r["step_s"], 5),
+                    "n_devices": r["n_devices"],
+                    "world_cycle": r["world_cycle"],
+                    "budget_s": RESIZE_BUDGET_S,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,15 +37,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def _flatten_with_names(tree) -> List[tuple]:
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    out = []
-    for path, leaf in flat:
-        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        out.append((name, leaf))
-    return out
-
-
 @dataclass
 class HostCheckpoint:
     """One materialized checkpoint: host numpy leaves + tree structure."""
@@ -149,6 +140,9 @@ class HostDRAMStore:
 
         th = threading.Thread(target=work, daemon=True, name=f"ckpt-save-{step_val}")
         with self._lock:
+            # Prune finished workers so a long run between wait() calls
+            # doesn't retain one Thread object per interval save.
+            self._pending = [p for p in self._pending if p.is_alive()]
             self._pending.append(th)
         th.start()
         return th

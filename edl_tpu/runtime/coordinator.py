@@ -128,11 +128,13 @@ class LocalCoordinator:
     def set_target_world(self, n: int):
         """The actuation analog of the reference's Parallelism PUT
         (``pkg/autoscaler.go:339-376``): declare the desired trainer
-        count; the plan shrinks immediately (members beyond the target
-        drop out of rank order) or grows as new trainers register."""
+        count, clamped to ``max_world``; the plan shrinks immediately
+        (members beyond the target drop out of rank order) or grows as
+        new trainers register."""
         if n < 1:
             raise ValueError("target world must be >= 1")
         with self._lock:
+            n = min(n, self._max_world)
             if n == self._target_world:
                 return
             self._target_world = n
@@ -211,7 +213,7 @@ class LocalCoordinator:
         # heartbeating and join when the target grows — the analog of
         # pending pods the kube Job controller will fold in).
         alive = list(self._members)
-        world = min(len(alive), self._target_world)
+        world = min(len(alive), self._target_world, self._max_world)
         if self._legal_sizes is not None:
             fitting = [s for s in self._legal_sizes if s <= world]
             world = fitting[-1] if fitting else 0

@@ -61,7 +61,7 @@ class ShardedDataIterator:
             raise ValueError("step must be >= 0")
         epoch, within = divmod(step, self.batches_per_epoch)
         perm = np.random.RandomState(
-            np.uint32(self.seed * 1_000_003 + epoch)
+            (self.seed * 1_000_003 + epoch) % (2**32)
         ).permutation(self.n)
         lo = within * self.global_batch_size
         return perm[lo : lo + self.global_batch_size]

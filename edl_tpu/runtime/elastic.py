@@ -100,6 +100,10 @@ class ElasticTrainer:
         self.state: Optional[TrainState] = None
         self._trainers: Dict[int, Trainer] = {}  # world_size -> compiled Trainer
         self._last_completed_step = 0
+        self._holding = False
+        #: how long run() waits for a formable world before giving up
+        self.barrier_timeout: float = 300.0
+        self.barrier_poll_interval: float = 0.05
 
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
@@ -171,7 +175,13 @@ class ElasticTrainer:
     def maybe_resize(self) -> bool:
         plan = self.coordinator.plan()
         if plan is None or plan.world_size < 1:
+            # No formable world (e.g. legal_sizes can't fit the surviving
+            # membership).  Hold at the barrier — stepping on the stale
+            # mesh would hang real multi-host collectives on the dead
+            # member's devices.
+            self._holding = plan is not None and plan.generation != self.generation
             return False
+        self._holding = False
         if plan.generation == self.generation and self.state is not None:
             return False
         self._resize(plan)
@@ -189,8 +199,24 @@ class ElasticTrainer:
         ``num_steps`` counts *completed global steps*, not loop
         iterations (replayed steps after a failure re-run the same
         step numbers)."""
+        hold_started: Optional[float] = None
         while True:
             self.maybe_resize()
+            if self._holding:
+                # Barrier hold: the coordinator's current plan has no
+                # formable world.  Poll until membership recovers (the
+                # coordinator bumps the generation when it does).
+                now = time.monotonic()
+                if hold_started is None:
+                    hold_started = now
+                elif now - hold_started > self.barrier_timeout:
+                    raise RuntimeError(
+                        f"held at resize barrier > {self.barrier_timeout}s "
+                        "with no formable world"
+                    )
+                time.sleep(self.barrier_poll_interval)
+                continue
+            hold_started = None
             if self.state is None:
                 raise RuntimeError("no plan with world_size >= 1 available")
             step = int(self.state.step)

@@ -207,3 +207,41 @@ def test_mnist_elastic_smoke():
     hist = et.run(12)
     assert hist[-1].world_size == 4
     assert np.isfinite(hist[-1].loss)
+
+
+def test_coordinator_max_world_enforced():
+    """max_world caps both retargeting and plan size (review finding:
+    the cap existed but was unenforced)."""
+    c = LocalCoordinator(target_world=1, max_world=2)
+    for t in "abcd":
+        c.register(t)
+    c.set_target_world(100)  # clamped to max_world
+    assert c.plan().world_size == 2
+
+
+def test_hold_at_barrier_until_membership_recovers():
+    """With legal_sizes=[2] and one member dead, there is no formable
+    world: run() must hold (not step on the stale mesh), then resume
+    when membership recovers (review finding: it previously kept
+    stepping at the old generation)."""
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=2, max_world=2, legal_sizes=[2])
+    coord.register("tr0")
+    coord.register("tr1")
+    et = ElasticTrainer(model, optax.adam(1e-2), it, coord, checkpoint_interval=5)
+    et.run(6)
+    steps_before = len(et.history)
+
+    coord.deregister("tr1")  # world can no longer form
+    assert coord.plan().world_size == 0
+    et.barrier_timeout = 0.3
+    with pytest.raises(RuntimeError, match="barrier"):
+        et.run(20)
+    assert len(et.history) == steps_before, "must not step while holding"
+
+    coord.register("tr1")  # membership recovers
+    et.barrier_timeout = 300.0
+    et.run(12)
+    assert int(et.state.step) == 12

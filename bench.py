@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import statistics
-import time
 
 
 RESIZE_BUDGET_S = 60.0
@@ -73,7 +72,6 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
         else:
             coord.set_target_world(w)
         prev_w = w
-        t0 = time.perf_counter()
         et.maybe_resize()
         target += steps_per_phase
         et.run(target)
@@ -84,7 +82,6 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
         assert event.generation == gen
         resize_windows.append(event.seconds + first.seconds)
         step_times.extend(r.seconds for r in et.history[-3:])
-        del t0
 
     # Join any in-flight async checkpoint thread before teardown (a live
     # device->host copy racing interpreter exit aborts the TPU runtime).

@@ -1,0 +1,26 @@
+"""L3 autoscaler: the decision plane.
+
+Pure algorithm in ``algorithm`` (ref ``pkg/autoscaler.go:201-337``),
+event-driven control loop in ``scaler`` (ref ``:451-485``).
+"""
+
+from edl_tpu.autoscaler.algorithm import (
+    JobView,
+    fulfillment,
+    sorted_jobs,
+    search_assignable_node,
+    scale_dry_run,
+    scale_all_jobs_dry_run,
+)
+from edl_tpu.autoscaler.scaler import Autoscaler, ScalePlan
+
+__all__ = [
+    "JobView",
+    "fulfillment",
+    "sorted_jobs",
+    "search_assignable_node",
+    "scale_dry_run",
+    "scale_all_jobs_dry_run",
+    "Autoscaler",
+    "ScalePlan",
+]

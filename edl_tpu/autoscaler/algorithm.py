@@ -1,0 +1,315 @@
+"""The slice-quantized fixed-point scaling algorithm (pure functions).
+
+TPU-native rework of the reference's decision core
+(``pkg/autoscaler.go``): the same shape — ascending-fulfillment sort
+(ref ``:54-64``, ``:97-129``), per-job dry run against a mutable
+simulated ``ClusterResource`` (ref ``:201-291``), iterate to a fixed
+point (ref ``:296-337``) — with the deltas the reference could never
+have:
+
+- **Slice quantization.** A trainer replica owns a whole TPU slice, and
+  a job may additionally be limited to world sizes that divide its
+  global batch (``TrainingJob.legal_world_sizes``).  So a scaling step
+  is "to the next/previous *legal* world size", not ±1 pod
+  (SURVEY.md §7.4 "slice-quantized autoscaling").
+- **Pending-demand shedding.** The reference made room for pending jobs
+  only indirectly (shed when cluster load exceeds ``max_load_desired``,
+  ref ``:235-246``) — with device chips at 100% and a pending job
+  queued, nothing ever shed.  Here the dry run takes the pending jobs'
+  aggregate chip demand explicitly: while free chips are short of it,
+  scale-ups pause and the least-deserving elastic jobs shed toward min.
+- **No livelock.** The reference scales device use up to 100% (ref
+  ``:276``) but sheds when above ``max_load_desired`` (ref ``:235``) —
+  at full utilization those fight forever.  Our up/down conditions are
+  complementary (up to 100% of chips, shed only on oversubscription or
+  pending demand), and the fixed point is additionally capped.
+
+Deliberate reference-quirk fixes (SURVEY.md §2.1 "fix, don't
+replicate"): node idle resources are *subtracted* on simulated
+scale-up (the reference added them back, ``:213-216``), and scale-down
+returns capacity to cluster totals only (per-node placement of the
+shed replica is unknowable without pod inspection — same limitation as
+ref ``:230-249``, now documented).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from edl_tpu.cluster.resources import ClusterResource
+from edl_tpu.resource.training_job import TrainingJob
+
+
+@dataclass
+class JobView:
+    """The autoscaler's read-model of one job — the analog of the
+    reference's ``job`` struct (config + actuated trainer workload,
+    ref ``pkg/autoscaler.go:34-37``) flattened to plain numbers so the
+    algorithm stays pure and trivially testable."""
+
+    name: str
+    min_instance: int
+    max_instance: int
+    #: current actuated parallelism (ref ``*TrainerJob.Spec.Parallelism``)
+    parallelism: int
+    cpu_request_milli: int = 0
+    mem_request_mega: int = 0
+    #: TPU chips per trainer replica (0 = CPU-only job)
+    tpu_per_trainer: int = 0
+    #: ascending legal world sizes within [min, max]; empty = every size
+    legal_sizes: List[int] = field(default_factory=list)
+    elastic: bool = True
+
+    @staticmethod
+    def from_job(job: TrainingJob, parallelism: Optional[int] = None) -> "JobView":
+        t = job.spec.trainer
+        return JobView(
+            name=job.name,
+            min_instance=t.min_instance,
+            max_instance=t.max_instance,
+            parallelism=(
+                parallelism if parallelism is not None else job.status.parallelism
+            )
+            or t.min_instance,
+            cpu_request_milli=t.resources.cpu_request_milli(),
+            mem_request_mega=t.resources.mem_request_mega(),
+            tpu_per_trainer=job.tpu_per_trainer(),
+            legal_sizes=job.legal_world_sizes(),
+            elastic=job.elastic(),
+        )
+
+    # -- legal-size stepping ------------------------------------------------
+    def _sizes(self) -> List[int]:
+        if self.legal_sizes:
+            return self.legal_sizes
+        return list(range(self.min_instance, self.max_instance + 1))
+
+    def next_size_up(self, planned: int) -> Optional[int]:
+        """Smallest legal world size strictly above ``planned``."""
+        for s in self._sizes():
+            if s > planned:
+                return s
+        return None
+
+    def next_size_down(self, planned: int) -> Optional[int]:
+        """Largest legal world size strictly below ``planned``."""
+        for s in reversed(self._sizes()):
+            if s < planned:
+                return s
+        return None
+
+    def clamp_size(self, planned: int) -> int:
+        """Largest legal size <= planned (used to clamp over-max plans)."""
+        best = self._sizes()[0]
+        for s in self._sizes():
+            if s <= planned:
+                best = s
+        return best
+
+
+def fulfillment(j: JobView) -> float:
+    """(cur - min) / (max - min); 1.0 when min == max
+    (ref ``Fulfillment()``, ``pkg/autoscaler.go:54-64``)."""
+    if j.min_instance == j.max_instance:
+        return 1.0
+    return (j.parallelism - j.min_instance) / (j.max_instance - j.min_instance)
+
+
+def sorted_jobs(
+    jobs: Iterable[JobView], *filters
+) -> List[JobView]:
+    """Ascending by fulfillment; ties broken by TPU chips, then CPU
+    request, then memory request, all ascending — smaller jobs first
+    (ref ``jobs.Less`` + ``sortedJobs``, ``pkg/autoscaler.go:97-129,
+    175-189``; device axis is chips instead of the nvidia quantity)."""
+    out = [j for j in jobs if all(f(j) for f in filters)]
+    out.sort(
+        key=lambda j: (
+            fulfillment(j),
+            j.tpu_per_trainer,
+            j.cpu_request_milli,
+            j.mem_request_mega,
+        )
+    )
+    return out
+
+
+def elastic(j: JobView) -> bool:
+    """ref ``elastic`` filter (``pkg/autoscaler.go:132-134``)."""
+    return j.elastic
+
+
+def needs_tpu(j: JobView) -> bool:
+    """ref ``gpu`` filter (``pkg/autoscaler.go:137-139``)."""
+    return j.tpu_per_trainer > 0
+
+
+def search_assignable_node(r: ClusterResource, j: JobView) -> Optional[str]:
+    """First node/pool whose idle CPU, free memory, and free chips fit
+    one replica (ref ``searchAssignableNode``, ``pkg/autoscaler.go:
+    191-199``, extended with the chip axis).  Deterministic order so
+    plans are reproducible (the reference iterated a Go map)."""
+    for name in sorted(r.nodes.cpu_idle_milli):
+        if j.cpu_request_milli > r.nodes.cpu_idle_milli[name]:
+            continue
+        if j.mem_request_mega > r.nodes.memory_free_mega.get(name, 0):
+            continue
+        if j.tpu_per_trainer > 0 and j.tpu_per_trainer > r.nodes.tpu_free.get(
+            name, 0
+        ):
+            continue
+        return name
+    return None
+
+
+def _apply(r: ClusterResource, j: JobView, delta_replicas: int, nodes: Sequence[str]):
+    """Mutate the simulated inventory for ``delta_replicas`` more (or
+    fewer) replicas of ``j`` (the reference did this in a defer,
+    ``pkg/autoscaler.go:209-217`` — with the idle-adjustment sign
+    inverted, which we fix)."""
+    r.tpu_limit += j.tpu_per_trainer * delta_replicas
+    r.cpu_request_milli += j.cpu_request_milli * delta_replicas
+    r.memory_request_mega += j.mem_request_mega * delta_replicas
+    for name in nodes:
+        r.nodes.cpu_idle_milli[name] -= j.cpu_request_milli
+        r.nodes.memory_free_mega[name] -= j.mem_request_mega
+        if j.tpu_per_trainer > 0:
+            r.nodes.tpu_free[name] = (
+                r.nodes.tpu_free.get(name, 0) - j.tpu_per_trainer
+            )
+
+
+def scale_dry_run(
+    r: ClusterResource,
+    j: JobView,
+    cur_diff: int,
+    max_load_desired: float = 0.97,
+    scale_down: bool = False,
+    pending_tpu_demand: int = 0,
+) -> int:
+    """Decide one scaling step for one job against the simulated
+    inventory, mutating ``r`` by whatever is decided.  Returns the
+    replica delta (ref ``scaleDryRun``, ``pkg/autoscaler.go:201-291``).
+
+    Steps move between *legal* world sizes (slice + batch quantization);
+    feasibility is checked for the whole step, per replica, against the
+    per-node maps.
+    """
+    planned = j.parallelism + cur_diff
+
+    # ======================= scale down =======================
+    if scale_down:
+        if planned > j.max_instance:
+            # Over max (e.g. spec shrank): clamp down to the largest
+            # legal size (ref ``:231-234`` stepped -1; we jump).
+            target = j.clamp_size(min(planned, j.max_instance))
+            delta = target - planned
+            _apply(r, j, delta, ())
+            return delta
+        cpu_hot = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
+        tpu_over = r.tpu_limit > r.tpu_total  # oversubscribed (inventory shrank)
+        tpu_starved = (
+            pending_tpu_demand > 0
+            and r.tpu_total - r.tpu_limit < pending_tpu_demand
+        )
+        if cpu_hot or tpu_over or tpu_starved:
+            if planned > j.min_instance:
+                target = j.next_size_down(planned)
+                if target is not None and target >= j.min_instance:
+                    delta = target - planned
+                    _apply(r, j, delta, ())
+                    return delta
+        return 0
+
+    # ======================= scale up =========================
+    if planned >= j.max_instance:
+        # At (or erroneously above) max: clamp back, never grow
+        # (ref ``:252-257``).
+        delta = min(0, j.max_instance - planned)
+        _apply(r, j, delta, ())
+        return delta
+    if pending_tpu_demand > 0 and j.tpu_per_trainer > 0:
+        # Make room for pending jobs before growing running ones.
+        return 0
+
+    target = j.next_size_up(planned)
+    if target is None or target > j.max_instance:
+        return 0
+    step = target - planned
+
+    # Whole-step feasibility.
+    if r.memory_total_mega - r.memory_request_mega < j.mem_request_mega * step:
+        return 0  # insufficient memory (ref ``:259-263``)
+    if (
+        r.cpu_total_milli * max_load_desired - r.cpu_request_milli
+        < j.cpu_request_milli * step
+    ):
+        return 0  # would push CPU above max_load_desired (ref ``:269-273``)
+    if j.tpu_per_trainer > 0 and (
+        r.tpu_total - r.tpu_limit < j.tpu_per_trainer * step
+    ):
+        return 0  # not enough free chips; chips may go to 100% (ref ``:275-278``)
+
+    # Per-replica node placement (ref ``:264-267`` checked one replica
+    # on one node; a quantized step places each new replica).
+    placed: List[str] = []
+    for _ in range(step):
+        node = search_assignable_node(r, j)
+        if node is None:
+            # Roll back trial placements and refuse the step.
+            for n in placed:
+                r.nodes.cpu_idle_milli[n] += j.cpu_request_milli
+                r.nodes.memory_free_mega[n] += j.mem_request_mega
+                if j.tpu_per_trainer > 0:
+                    r.nodes.tpu_free[n] += j.tpu_per_trainer
+            return 0
+        # Reserve on the node map immediately so the next replica sees it.
+        r.nodes.cpu_idle_milli[node] -= j.cpu_request_milli
+        r.nodes.memory_free_mega[node] -= j.mem_request_mega
+        if j.tpu_per_trainer > 0:
+            r.nodes.tpu_free[node] = r.nodes.tpu_free.get(node, 0) - j.tpu_per_trainer
+        placed.append(node)
+
+    # Cluster-level totals (node maps already adjusted above).
+    _apply(r, j, step, ())
+    return step
+
+
+def scale_all_jobs_dry_run(
+    jobs: Sequence[JobView],
+    r: ClusterResource,
+    max_load_desired: float = 0.97,
+    pending_tpu_demand: int = 0,
+    max_iters: int = 100,
+) -> Dict[str, int]:
+    """Iterate per-job dry runs to a fixed point; returns name -> replica
+    delta (ref ``scaleAllJobsDryRun``, ``pkg/autoscaler.go:296-337``).
+
+    Forward pass scales up from the least-fulfilled job; reverse pass
+    scales down from the most-fulfilled.  ``r`` is mutated (pass a
+    ``deepcopy`` to keep the real inventory).  ``max_iters`` bounds the
+    loop (the reference had no bound and could livelock at full device
+    utilization)."""
+    diff: Dict[str, int] = {j.name: 0 for j in jobs}
+    sim = r  # mutated in place, like the reference's value copy
+    for _ in range(max_iters):
+        no_change = True
+        ordered = sorted_jobs(jobs, elastic)
+        for j in ordered:  # scale up, neediest first
+            add = scale_dry_run(
+                sim, j, diff[j.name], max_load_desired, False, pending_tpu_demand
+            )
+            diff[j.name] += add
+            if add != 0:
+                no_change = False
+        for j in reversed(ordered):  # scale down, most-fulfilled first
+            add = scale_dry_run(
+                sim, j, diff[j.name], max_load_desired, True, pending_tpu_demand
+            )
+            diff[j.name] += add
+            if add != 0:
+                no_change = False
+        if no_change:
+            break
+    return {k: v for k, v in diff.items() if v != 0}

@@ -1,0 +1,168 @@
+"""L3 autoscaler control loop: events + ticker -> plan -> actuation.
+
+The reference's ``Autoscaler.Run`` (``pkg/autoscaler.go:451-485``):
+select on a 5s ticker and an event channel, inventory the cluster,
+detect pending jobs, dry-run the fixed point over the reschedulable
+jobs, and actuate by rewriting trainer parallelism.  Same loop here,
+with ``run_once`` factored out so tests drive it synchronously (the
+reference's loop was untestable and only smoke-checked for liveness,
+``pkg/autoscaler_test.go:29-45``).
+
+Inventory departure (fix, don't replicate): the reference charged
+*unscheduled* pending pods' requests against cluster usage
+(``pkg/cluster.go:202-210`` lists all non-terminal pods), inflating
+load with demand that consumes nothing physically.  We charge only
+scheduled pods; unscheduled demand enters the algorithm explicitly as
+``pending_tpu_demand`` (see ``algorithm.scale_dry_run``), which both
+sheds room for it and stops scale-ups from stealing that room.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from edl_tpu.autoscaler.algorithm import JobView, scale_all_jobs_dry_run
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.resource.training_job import TrainingJob
+
+DEFAULT_LOOP_SECONDS = 5.0  # ref defaultLoopDur (pkg/autoscaler.go:30-32)
+DEFAULT_MAX_LOAD_DESIRED = 0.97  # ref cmd/edl/edl.go:19-20
+
+
+@dataclass
+class _Event:
+    type: str  # "add" | "update" | "del"  (ref eventType, :141-147)
+    job: TrainingJob
+
+
+@dataclass
+class ScalePlan:
+    """One loop iteration's outcome (for logging/metrics/tests)."""
+
+    targets: Dict[str, int]
+    diff: Dict[str, int]
+    have_pending: bool
+    pending_tpu_demand: int
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        cluster: Cluster,
+        max_load_desired: float = DEFAULT_MAX_LOAD_DESIRED,
+        loop_seconds: float = DEFAULT_LOOP_SECONDS,
+    ):
+        self.cluster = cluster
+        self.max_load_desired = max_load_desired
+        self.loop_seconds = loop_seconds
+        self.jobs: Dict[str, TrainingJob] = {}
+        self._events: "queue.Queue[_Event]" = queue.Queue()
+        self._stop = threading.Event()
+        self.plans: List[ScalePlan] = []
+
+    # -- event intake (ref OnAdd/OnUpdate/OnDel, :158-171) -------------------
+    def on_add(self, job: TrainingJob):
+        self._events.put(_Event("add", job))
+
+    def on_update(self, job: TrainingJob):
+        self._events.put(_Event("update", job))
+
+    def on_del(self, job: TrainingJob):
+        self._events.put(_Event("del", job))
+
+    def _drain_events(self):
+        """ref updateJobList (:383-402), minus the TrainerJob caching —
+        the workload is re-fetched fresh each loop anyway."""
+        while True:
+            try:
+                evt = self._events.get_nowait()
+            except queue.Empty:
+                return
+            if evt.type in ("add", "update"):
+                self.jobs[evt.job.name] = evt.job
+            elif evt.type == "del":
+                self.jobs.pop(evt.job.name, None)
+
+    # -- one decision cycle ---------------------------------------------------
+    def run_once(self) -> Optional[ScalePlan]:
+        """Inventory -> pending detection -> fixed-point dry run ->
+        actuation.  Returns the plan (None when there was nothing to
+        decide over)."""
+        self._drain_events()
+        if not self.jobs:
+            return None
+        r = self.cluster.inquiry_resource()
+
+        views: List[JobView] = []
+        pending_tpu_demand = 0
+        have_pending = False
+        for job in self.jobs.values():
+            w = self.cluster.get_trainer_workload(job)
+            if w is None:
+                continue  # not created yet (ref tryToRetrieve..., :424-447)
+            total, running, pending = self.cluster.job_pods(job)
+            if total > 0 and total == pending:
+                # every pod pending: the job cannot start (ref
+                # findPendingJob, :406-422)
+                have_pending = True
+                pending_tpu_demand += (
+                    job.spec.trainer.min_instance * job.tpu_per_trainer()
+                )
+                continue  # a fully-pending job is demand, not a candidate
+            views.append((JobView.from_job(job, parallelism=w.parallelism), total, running))
+
+        # Reschedulable set: stable jobs always; every job when pending
+        # exists (ref findTrainingJobsMightBeRescheduled, :487-511).
+        candidates = [
+            v for v, total, running in views if total == running or have_pending
+        ]
+        if not candidates and pending_tpu_demand == 0:
+            return None
+
+        diff = scale_all_jobs_dry_run(
+            candidates,
+            r.deepcopy(),
+            self.max_load_desired,
+            pending_tpu_demand=pending_tpu_demand,
+        )
+
+        targets: Dict[str, int] = {}
+        for v in candidates:
+            if diff.get(v.name):
+                targets[v.name] = v.parallelism + diff[v.name]
+        self._actuate(targets)
+        plan = ScalePlan(
+            targets=targets,
+            diff=diff,
+            have_pending=have_pending,
+            pending_tpu_demand=pending_tpu_demand,
+        )
+        self.plans.append(plan)
+        return plan
+
+    def _actuate(self, targets: Dict[str, int]):
+        """ref scaleAllJobs (:339-376); the 5-retry conflict loop lives
+        in Cluster.update_parallelism."""
+        for name, parallelism in targets.items():
+            job = self.jobs.get(name)
+            if job is None:
+                continue
+            self.cluster.update_parallelism(job, parallelism)
+
+    # -- the loop (ref Run, :451-485) ----------------------------------------
+    def run(self):
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # keep the loop alive, like the ref's log+continue
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self.loop_seconds)
+
+    def stop(self):
+        self._stop.set()

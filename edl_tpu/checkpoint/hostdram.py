@@ -141,8 +141,12 @@ class HostDRAMStore:
         th = threading.Thread(target=work, daemon=True, name=f"ckpt-save-{step_val}")
         with self._lock:
             # Prune finished workers so a long run between wait() calls
-            # doesn't retain one Thread object per interval save.
-            self._pending = [p for p in self._pending if p.is_alive()]
+            # doesn't retain one Thread object per interval save.  A
+            # thread with ident None was created but not yet started
+            # (the append below races th.start()) — keep it.
+            self._pending = [
+                p for p in self._pending if p.ident is None or p.is_alive()
+            ]
             self._pending.append(th)
         th.start()
         return th

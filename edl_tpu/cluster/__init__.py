@@ -4,6 +4,16 @@ from edl_tpu.cluster.tpu_topology import (
     legal_topologies,
     SliceTopology,
 )
+from edl_tpu.cluster.kube import (
+    KubeAPI,
+    FakeKube,
+    KubectlAPI,
+    NodeInfo,
+    PodInfo,
+    WorkloadInfo,
+    ConflictError,
+)
+from edl_tpu.cluster.cluster import Cluster
 
 __all__ = [
     "ClusterResource",
@@ -11,4 +21,12 @@ __all__ = [
     "topology_chips",
     "legal_topologies",
     "SliceTopology",
+    "KubeAPI",
+    "FakeKube",
+    "KubectlAPI",
+    "NodeInfo",
+    "PodInfo",
+    "WorkloadInfo",
+    "ConflictError",
+    "Cluster",
 ]

@@ -1,0 +1,377 @@
+"""The Kubernetes I/O boundary: a thin interface + an in-memory fake.
+
+The reference's ``Cluster`` talks straight to client-go and is therefore
+untestable (SURVEY.md §4: "What is *not* tested: Cluster (all k8s
+I/O)").  We keep the same *surface* but put it behind ``KubeAPI`` so the
+decision and control planes are testable against ``FakeKube`` — which
+also emulates the external actors the reference system leaned on:
+
+- the **kube Job controller** turning ``parallelism`` changes into pod
+  creation/deletion (ref relies on it after the PUT,
+  ``pkg/autoscaler.go:339-376``),
+- the **scheduler** binding pods to nodes with capacity, leaving the
+  rest ``Pending``.
+
+``KubectlAPI`` adapts the same interface onto a real cluster through
+the ``kubectl`` binary (no python k8s client dependency).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class NodeInfo:
+    """Allocatable capacity of one node/pool (the inventory unit of
+    ref ``InquiryResource``, ``pkg/cluster.go:176-242``)."""
+
+    name: str
+    cpu_milli: int = 0
+    memory_mega: int = 0
+    tpu_chips: int = 0
+    tpu_topology: str = ""  # e.g. "v5e-4": this pool schedules whole slices
+
+
+@dataclass
+class PodInfo:
+    name: str
+    job_name: str  # label paddle-job analog: edl-job=<name>
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    node: str = ""
+    cpu_request_milli: int = 0
+    memory_request_mega: int = 0
+    tpu_limit: int = 0
+    deleting: bool = False  # DeletionTimestamp set (ref pkg/cluster.go:127-131)
+
+
+@dataclass
+class WorkloadInfo:
+    """The trainer workload: name + parallelism (the one mutable knob,
+    ref ``Job.Spec.Parallelism``) + per-replica resources."""
+
+    name: str
+    job_name: str
+    parallelism: int
+    cpu_request_milli: int = 0
+    memory_request_mega: int = 0
+    tpu_limit: int = 0
+    resource_version: int = 0
+
+
+class ConflictError(RuntimeError):
+    """Optimistic-concurrency conflict (stale resourceVersion) — the
+    reason the reference retried updates 5 times (``pkg/autoscaler.go:
+    346-370``)."""
+
+
+class KubeAPI:
+    """Everything the framework asks of Kubernetes.  One process
+    boundary, kept narrow on purpose."""
+
+    # inventory
+    def list_nodes(self) -> List[NodeInfo]:
+        raise NotImplementedError
+
+    def list_pods(self) -> List[PodInfo]:
+        raise NotImplementedError
+
+    # trainer workload CRUD (ref pkg/cluster.go:91-113, 245-291)
+    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+        raise NotImplementedError
+
+    def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        raise NotImplementedError
+
+    def update_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        raise NotImplementedError
+
+    def delete_workload(self, name: str) -> bool:
+        raise NotImplementedError
+
+
+class FakeKube(KubeAPI):
+    """In-memory cluster with a synchronous Job-controller + scheduler
+    emulation: every mutation immediately reconciles pods to the
+    declared parallelism and binds what fits onto nodes.
+
+    Tests fabricate multi-node state as literals, exactly the
+    reference's test philosophy (SURVEY.md §4) — but with the actuation
+    half actually closed-loop.
+    """
+
+    def __init__(self, nodes: Optional[List[NodeInfo]] = None):
+        self._lock = threading.RLock()
+        self.nodes: Dict[str, NodeInfo] = {n.name: n for n in (nodes or [])}
+        self.workloads: Dict[str, WorkloadInfo] = {}
+        self.pods: Dict[str, PodInfo] = {}
+        self._pod_seq = 0
+        #: names of workloads whose pods must stay Pending (test knob to
+        #: simulate unschedulable jobs beyond capacity math)
+        self.hold_pending: set = set()
+
+    # -- inventory ----------------------------------------------------------
+    def list_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [NodeInfo(**vars(n)) for n in self.nodes.values()]
+
+    def list_pods(self) -> List[PodInfo]:
+        with self._lock:
+            return [PodInfo(**vars(p)) for p in self.pods.values()]
+
+    # -- workload CRUD ------------------------------------------------------
+    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+        with self._lock:
+            w = self.workloads.get(name)
+            return WorkloadInfo(**vars(w)) if w else None
+
+    def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        with self._lock:
+            if w.name in self.workloads:
+                raise ConflictError(f"workload {w.name} already exists")
+            stored = WorkloadInfo(**vars(w))
+            stored.resource_version = 1
+            self.workloads[w.name] = stored
+            self._reconcile(stored)
+            return WorkloadInfo(**vars(stored))
+
+    def update_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        with self._lock:
+            cur = self.workloads.get(w.name)
+            if cur is None:
+                raise KeyError(f"no workload {w.name}")
+            if w.resource_version != cur.resource_version:
+                raise ConflictError(
+                    f"stale resourceVersion {w.resource_version} != {cur.resource_version}"
+                )
+            cur.parallelism = w.parallelism
+            cur.resource_version += 1
+            self._reconcile(cur)
+            return WorkloadInfo(**vars(cur))
+
+    def delete_workload(self, name: str) -> bool:
+        with self._lock:
+            w = self.workloads.pop(name, None)
+            if w is None:
+                return False
+            for pname in [p for p, pod in self.pods.items() if pod.job_name == w.job_name]:
+                del self.pods[pname]
+            return True
+
+    # -- controller + scheduler emulation ------------------------------------
+    def _job_pods(self, job_name: str) -> List[PodInfo]:
+        return [
+            p
+            for p in self.pods.values()
+            if p.job_name == job_name and not p.deleting
+        ]
+
+    def _free_on(self, node: NodeInfo) -> Tuple[int, int, int]:
+        used_cpu = used_mem = used_tpu = 0
+        for p in self.pods.values():
+            if p.node == node.name and p.phase in ("Pending", "Running"):
+                used_cpu += p.cpu_request_milli
+                used_mem += p.memory_request_mega
+                used_tpu += p.tpu_limit
+        return (
+            node.cpu_milli - used_cpu,
+            node.memory_mega - used_mem,
+            node.tpu_chips - used_tpu,
+        )
+
+    def _reconcile(self, w: WorkloadInfo):
+        """Kube Job controller: match pod count to parallelism.
+        Scale-down deletes highest-index pods first (deterministic)."""
+        pods = sorted(self._job_pods(w.job_name), key=lambda p: p.name)
+        while len(pods) > w.parallelism:
+            victim = pods.pop()
+            del self.pods[victim.name]
+        while len(pods) < w.parallelism:
+            self._pod_seq += 1
+            p = PodInfo(
+                # zero-padded so lexicographic name order == creation order
+                name=f"{w.job_name}-pod-{self._pod_seq:06d}",
+                job_name=w.job_name,
+                cpu_request_milli=w.cpu_request_milli,
+                memory_request_mega=w.memory_request_mega,
+                tpu_limit=w.tpu_limit,
+            )
+            self.pods[p.name] = p
+            pods.append(p)
+        self._schedule()
+
+    def _schedule(self):
+        """Bind Pending pods to nodes with room; leave the rest Pending."""
+        for p in sorted(self.pods.values(), key=lambda p: p.name):
+            if p.phase != "Pending" or p.node or p.job_name in self.hold_pending:
+                continue
+            for node in sorted(self.nodes.values(), key=lambda n: n.name):
+                free_cpu, free_mem, free_tpu = self._free_on(node)
+                if (
+                    p.cpu_request_milli <= free_cpu
+                    and p.memory_request_mega <= free_mem
+                    and p.tpu_limit <= free_tpu
+                ):
+                    p.node = node.name
+                    p.phase = "Running"
+                    break
+
+    # -- test helpers --------------------------------------------------------
+    def kill_pod(self, name: str):
+        """Simulate a pod death (node failure, preemption)."""
+        with self._lock:
+            self.pods.pop(name, None)
+            # The Job controller would re-create it:
+            for w in self.workloads.values():
+                self._reconcile(w)
+
+    def retry_scheduling(self):
+        with self._lock:
+            self._schedule()
+
+
+class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
+    """Real-cluster adapter via the ``kubectl`` binary (the baked-in
+    image has no python k8s client; shelling out keeps the dependency
+    surface zero).  Only the subset the framework uses."""
+
+    def __init__(self, namespace: str = "default", kubectl: str = "kubectl"):
+        self.namespace = namespace
+        self.kubectl = kubectl
+
+    def _run(self, *args: str) -> dict:
+        out = subprocess.run(
+            [self.kubectl, "-n", self.namespace, *args, "-o", "json"],
+            capture_output=True,
+            text=True,
+            check=True,
+        )
+        return json.loads(out.stdout)
+
+    def list_nodes(self) -> List[NodeInfo]:
+        items = self._run("get", "nodes")["items"]
+        nodes = []
+        for it in items:
+            alloc = it["status"].get("allocatable", {})
+            from edl_tpu.utils.quantity import (
+                parse_count,
+                parse_cpu_milli,
+                parse_memory_mega,
+            )
+
+            nodes.append(
+                NodeInfo(
+                    name=it["metadata"]["name"],
+                    cpu_milli=parse_cpu_milli(alloc.get("cpu", 0)),
+                    memory_mega=parse_memory_mega(alloc.get("memory", 0)),
+                    tpu_chips=parse_count(alloc.get("google.com/tpu", 0)),
+                    tpu_topology=it["metadata"]
+                    .get("labels", {})
+                    .get("cloud.google.com/gke-tpu-topology", ""),
+                )
+            )
+        return nodes
+
+    def list_pods(self) -> List[PodInfo]:
+        from edl_tpu.utils.quantity import (
+            parse_count,
+            parse_cpu_milli,
+            parse_memory_mega,
+        )
+
+        items = self._run("get", "pods")["items"]
+        pods = []
+        for it in items:
+            cpu = mem = tpu = 0
+            for c in it["spec"].get("containers", []):
+                req = c.get("resources", {}).get("requests", {})
+                lim = c.get("resources", {}).get("limits", {})
+                cpu += parse_cpu_milli(req.get("cpu", 0))
+                mem += parse_memory_mega(req.get("memory", 0))
+                tpu += parse_count(lim.get("google.com/tpu", 0))
+            pods.append(
+                PodInfo(
+                    name=it["metadata"]["name"],
+                    job_name=it["metadata"].get("labels", {}).get("edl-job", ""),
+                    phase=it["status"].get("phase", "Pending"),
+                    node=it["spec"].get("nodeName", ""),
+                    cpu_request_milli=cpu,
+                    memory_request_mega=mem,
+                    tpu_limit=tpu,
+                    deleting="deletionTimestamp" in it["metadata"],
+                )
+            )
+        return pods
+
+    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+        try:
+            it = self._run("get", "job", name)
+        except subprocess.CalledProcessError:
+            return None
+        spec = it["spec"]
+        tmpl = spec["template"]["spec"]["containers"][0]
+        from edl_tpu.utils.quantity import (
+            parse_count,
+            parse_cpu_milli,
+            parse_memory_mega,
+        )
+
+        req = tmpl.get("resources", {}).get("requests", {})
+        lim = tmpl.get("resources", {}).get("limits", {})
+        return WorkloadInfo(
+            name=name,
+            job_name=it["metadata"].get("labels", {}).get("edl-job", name),
+            parallelism=spec.get("parallelism", 0),
+            cpu_request_milli=parse_cpu_milli(req.get("cpu", 0)),
+            memory_request_mega=parse_memory_mega(req.get("memory", 0)),
+            tpu_limit=parse_count(lim.get("google.com/tpu", 0)),
+            resource_version=int(it["metadata"]["resourceVersion"]),
+        )
+
+    def update_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        # Include resourceVersion in the merge patch so the API server
+        # enforces the optimistic-concurrency precondition; a 409 maps to
+        # ConflictError so Cluster.update_parallelism's retry loop works
+        # identically against FakeKube and a real cluster.
+        patch = {
+            "metadata": {"resourceVersion": str(w.resource_version)},
+            "spec": {"parallelism": w.parallelism},
+        }
+        r = subprocess.run(
+            [
+                self.kubectl,
+                "-n",
+                self.namespace,
+                "patch",
+                "job",
+                w.name,
+                "--type=merge",
+                "-p",
+                json.dumps(patch),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        if r.returncode != 0:
+            msg = r.stderr or r.stdout
+            if "Conflict" in msg or "the object has been modified" in msg:
+                raise ConflictError(msg.strip())
+            raise RuntimeError(f"kubectl patch failed: {msg.strip()}")
+        return self.get_workload(w.name)
+
+    def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+        raise NotImplementedError(
+            "create via manifests: edl_tpu.controller applies JobParser output"
+        )
+
+    def delete_workload(self, name: str) -> bool:
+        r = subprocess.run(
+            [self.kubectl, "-n", self.namespace, "delete", "job", name],
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0

@@ -1,0 +1,382 @@
+"""Decision-core tests, mirroring the reference's white-box suite
+(``pkg/autoscaler_internal_test.go``: fabricated ClusterResource
+literals, scale-up satisfied / starved variants, clamp-down, shed,
+whole-plan fixed point, fulfillment math, sort order) plus the
+TPU-native behaviors it couldn't have: slice/batch-quantized steps,
+pending-demand shedding, livelock-free full-utilization fixed point.
+"""
+
+import pytest
+
+from edl_tpu.autoscaler.algorithm import (
+    JobView,
+    elastic,
+    fulfillment,
+    needs_tpu,
+    scale_all_jobs_dry_run,
+    scale_dry_run,
+    search_assignable_node,
+    sorted_jobs,
+)
+from edl_tpu.cluster.resources import ClusterResource, Nodes
+from edl_tpu.resource.training_job import TrainingJob
+
+
+def make_view(
+    name="j",
+    cpu=1000,
+    mem=1024,
+    tpu=4,
+    mn=1,
+    mx=4,
+    parallelism=1,
+    legal=None,
+):
+    """Fixture builder (analog of the reference's ``makeJob``,
+    ``pkg/autoscaler_internal_test.go:56-94``)."""
+    return JobView(
+        name=name,
+        min_instance=mn,
+        max_instance=mx,
+        parallelism=parallelism,
+        cpu_request_milli=cpu,
+        mem_request_mega=mem,
+        tpu_per_trainer=tpu,
+        legal_sizes=list(legal) if legal else [],
+        elastic=mn < mx,
+    )
+
+
+def roomy_cluster(n_nodes=4, cpu=8000, mem=32768, tpu=16) -> ClusterResource:
+    names = [f"node-{i}" for i in range(n_nodes)]
+    return ClusterResource(
+        node_count=n_nodes,
+        tpu_total=tpu * n_nodes,
+        cpu_total_milli=cpu * n_nodes,
+        memory_total_mega=mem * n_nodes,
+        nodes=Nodes(
+            cpu_idle_milli={n: cpu for n in names},
+            memory_free_mega={n: mem for n in names},
+            tpu_free={n: tpu for n in names},
+        ),
+    )
+
+
+# ---- fulfillment + sort (ref :366-438) -------------------------------------
+
+
+def test_fulfillment_math():
+    assert fulfillment(make_view(mn=1, mx=1, parallelism=1)) == 1.0
+    assert fulfillment(make_view(mn=1, mx=3, parallelism=1)) == 0.0
+    assert fulfillment(make_view(mn=1, mx=3, parallelism=2)) == 0.5
+    assert fulfillment(make_view(mn=1, mx=3, parallelism=3)) == 1.0
+
+
+def test_sort_order_and_tiebreakers():
+    a = make_view("a", parallelism=3, mn=1, mx=3)  # fulfillment 1.0
+    b = make_view("b", parallelism=1, mn=1, mx=3)  # fulfillment 0.0
+    c = make_view("c", parallelism=2, mn=1, mx=3)  # fulfillment 0.5
+    assert [j.name for j in sorted_jobs([a, b, c])] == ["b", "c", "a"]
+    # ties: fewer chips first, then cpu, then mem (all ascending)
+    d = make_view("d", parallelism=1, mn=1, mx=3, tpu=8)
+    e = make_view("e", parallelism=1, mn=1, mx=3, tpu=4)
+    f = make_view("f", parallelism=1, mn=1, mx=3, tpu=4, cpu=500)
+    assert [j.name for j in sorted_jobs([d, e, f])] == ["f", "e", "d"]
+
+
+def test_filters():
+    el = make_view("el", mn=1, mx=4)
+    ne = make_view("ne", mn=2, mx=2)
+    cpu_only = make_view("c", tpu=0, mn=1, mx=4)
+    assert [j.name for j in sorted_jobs([el, ne], elastic)] == ["el"]
+    assert [j.name for j in sorted_jobs([el, cpu_only], needs_tpu)] == ["el"]
+
+
+# ---- search_assignable_node -------------------------------------------------
+
+
+def test_search_assignable_node_checks_all_axes():
+    r = roomy_cluster(n_nodes=2, cpu=2000, mem=2048, tpu=4)
+    j = make_view(cpu=1500, mem=1024, tpu=4)
+    assert search_assignable_node(r, j) == "node-0"
+    r.nodes.tpu_free["node-0"] = 0
+    assert search_assignable_node(r, j) == "node-1"
+    r.nodes.cpu_idle_milli["node-1"] = 100
+    assert search_assignable_node(r, j) is None
+
+
+# ---- scale_dry_run: scale-up (ref :103-177, :238-254) -----------------------
+
+
+def test_scale_up_satisfied():
+    r = roomy_cluster()
+    j = make_view(parallelism=1, mn=1, mx=4)
+    assert scale_dry_run(r, j, 0) == 1
+    # simulated inventory was charged
+    assert r.tpu_limit == 4
+    assert r.cpu_request_milli == 1000
+
+
+def test_scale_up_insufficient_cpu():
+    r = roomy_cluster(n_nodes=1, cpu=1000)  # one replica's worth already tight
+    r.cpu_request_milli = 500
+    j = make_view(parallelism=1, mn=1, mx=4, cpu=1000)
+    assert scale_dry_run(r, j, 0, max_load_desired=1.0) == 0
+
+
+def test_scale_up_insufficient_tpu():
+    r = roomy_cluster(n_nodes=1, tpu=4)
+    r.tpu_limit = 4  # all chips spoken for
+    j = make_view(parallelism=1, mn=1, mx=4, tpu=4)
+    assert scale_dry_run(r, j, 0) == 0
+
+
+def test_scale_up_insufficient_memory():
+    r = roomy_cluster(n_nodes=1, mem=1024)
+    r.memory_request_mega = 512
+    j = make_view(parallelism=1, mn=1, mx=4, mem=1024)
+    assert scale_dry_run(r, j, 0) == 0
+
+
+def test_scale_up_no_assignable_node():
+    # Cluster-level totals fine, but no single node fits the replica.
+    r = roomy_cluster(n_nodes=4, cpu=800)
+    j = make_view(parallelism=1, mn=1, mx=4, cpu=1000)
+    r.cpu_total_milli = 100_000  # plenty in aggregate
+    assert scale_dry_run(r, j, 0) == 0
+
+
+def test_scale_up_respects_max_load_desired():
+    r = roomy_cluster(n_nodes=1, cpu=10_000)
+    r.cpu_request_milli = 7500
+    j = make_view(parallelism=1, mn=1, mx=4, cpu=1000, tpu=0)
+    assert scale_dry_run(r, j, 0, max_load_desired=0.8) == 0
+    assert scale_dry_run(r, j, 0, max_load_desired=1.0) == 1
+
+
+def test_scale_up_clamps_at_max():
+    r = roomy_cluster()
+    j = make_view(parallelism=4, mn=1, mx=4)
+    assert scale_dry_run(r, j, 0) == 0
+    j2 = make_view(parallelism=6, mn=1, mx=4)
+    assert scale_dry_run(r, j2, 0) == -2  # erroneously above max: clamp
+
+
+# ---- scale_dry_run: scale-down (ref :179-236) -------------------------------
+
+
+def test_scale_down_beyond_max_clamps():
+    r = roomy_cluster()
+    j = make_view(parallelism=6, mn=1, mx=4)
+    assert scale_dry_run(r, j, 0, scale_down=True) == -2
+
+
+def test_scale_down_on_cpu_pressure_steps_toward_min():
+    r = roomy_cluster(n_nodes=1, cpu=4000)
+    r.cpu_request_milli = 4000  # 100% > max_load 0.97
+    j = make_view(parallelism=3, mn=1, mx=4, cpu=1000)
+    assert scale_dry_run(r, j, 0, scale_down=True) == -1
+    assert r.cpu_request_milli == 3000  # freed one replica
+
+
+def test_scale_down_stops_at_min():
+    r = roomy_cluster(n_nodes=1, cpu=1000)
+    r.cpu_request_milli = 1000
+    j = make_view(parallelism=1, mn=1, mx=4)
+    assert scale_dry_run(r, j, 0, scale_down=True) == 0
+
+
+def test_scale_down_idle_cluster_noop():
+    r = roomy_cluster()
+    j = make_view(parallelism=3, mn=1, mx=4)
+    assert scale_dry_run(r, j, 0, scale_down=True) == 0
+
+
+# ---- slice/batch quantization (TPU-native; SURVEY.md §7.4) ------------------
+
+
+def test_quantized_step_up_requires_room_for_whole_step():
+    j = make_view(parallelism=2, mn=1, mx=8, legal=[1, 2, 4, 8])
+    # 16 chips total, 8 in use -> room for exactly 2 more replicas: 2 -> 4 OK
+    r = roomy_cluster(n_nodes=4, tpu=4)
+    r.tpu_limit = 8
+    r.nodes.tpu_free["node-0"] = 0
+    r.nodes.tpu_free["node-1"] = 0
+    assert scale_dry_run(r, j, 0) == 2
+    # room for only 1 more replica: cannot half-step to 3 -> no change
+    r2 = roomy_cluster(n_nodes=4, tpu=4)
+    r2.tpu_limit = 12
+    for n in ("node-0", "node-1", "node-2"):
+        r2.nodes.tpu_free[n] = 0
+    assert scale_dry_run(r2, j, 0) == 0
+
+
+def test_quantized_step_down_jumps_to_previous_legal_size():
+    r = roomy_cluster(n_nodes=1, cpu=4000)
+    r.cpu_request_milli = 4000
+    j = make_view(parallelism=4, mn=1, mx=8, cpu=500, legal=[1, 2, 4, 8])
+    assert scale_dry_run(r, j, 0, scale_down=True) == -2  # 4 -> 2
+
+
+def test_legal_sizes_come_from_global_batch(tmp_path):
+    job = TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": "t"},
+            "spec": {
+                "fault_tolerant": True,
+                "global_batch_size": 96,
+                "trainer": {
+                    "min_instance": 1,
+                    "max_instance": 8,
+                    "slice_topology": "v5e-4",
+                },
+            },
+        }
+    ).validate()
+    v = JobView.from_job(job, parallelism=2)
+    assert v.legal_sizes == [1, 2, 3, 4, 6, 8]
+    assert v.tpu_per_trainer == 4
+    assert v.next_size_up(4) == 6
+
+
+# ---- whole-plan fixed point (ref :256-364) ----------------------------------
+
+
+def test_plan_grows_all_jobs_to_max_when_idle():
+    r = roomy_cluster(n_nodes=8, tpu=8)
+    a = make_view("a", parallelism=1, mn=1, mx=3, tpu=8)
+    b = make_view("b", parallelism=1, mn=1, mx=3, tpu=8)
+    diff = scale_all_jobs_dry_run([a, b], r.deepcopy())
+    assert diff == {"a": 2, "b": 2}
+
+
+def test_plan_splits_scarce_chips_fairly():
+    # 8 nodes x 4 chips = 32 chips; two jobs of 4-chip replicas, max 8
+    # each -> 64 chips wanted.  The fixed point should balance them.
+    r = roomy_cluster(n_nodes=8, tpu=4)
+    # charge the two already-running replicas (InquiryResource would)
+    r.tpu_limit = 8
+    r.nodes.tpu_free["node-0"] = 0
+    r.nodes.tpu_free["node-1"] = 0
+    a = make_view("a", parallelism=1, mn=1, mx=8)
+    b = make_view("b", parallelism=1, mn=1, mx=8)
+    diff = scale_all_jobs_dry_run([a, b], r.deepcopy())
+    ga = 1 + diff.get("a", 0)
+    gb = 1 + diff.get("b", 0)
+    assert ga + gb == 8  # all 32 chips used
+    assert abs(ga - gb) <= 1
+
+
+def test_plan_respects_max_load_partial(monkeypatch):
+    # maxLoadDesired=0.8 on CPU-only jobs (ref :256-364's 0.8 case).
+    r = roomy_cluster(n_nodes=1, cpu=10_000, tpu=0)
+    r.cpu_request_milli = 1000  # the one running replica
+    r.nodes.cpu_idle_milli["node-0"] -= 1000
+    a = make_view("a", parallelism=1, mn=1, mx=10, cpu=1000, tpu=0)
+    diff = scale_all_jobs_dry_run([a], r.deepcopy(), max_load_desired=0.8)
+    # 1000m used + d * 1000m <= 0.8 * 10000m -> d = 7
+    assert diff == {"a": 7}
+
+
+def test_plan_noop_for_non_elastic():
+    r = roomy_cluster()
+    a = make_view("a", parallelism=2, mn=2, mx=2)
+    assert scale_all_jobs_dry_run([a], r.deepcopy()) == {}
+
+
+def test_shed_until_under_max_load():
+    # CPU oversubscribed: elastic jobs shed, most-fulfilled first, until
+    # the load drops under max_load_desired (ref :219-236 semantics).
+    r = roomy_cluster(n_nodes=2, cpu=4000, tpu=0)
+    r.cpu_request_milli = 9000  # way past 0.97 * 8000
+    a = make_view("a", parallelism=4, mn=1, mx=4, cpu=1000, tpu=0)
+    b = make_view("b", parallelism=3, mn=1, mx=4, cpu=1000, tpu=0)
+    diff = scale_all_jobs_dry_run([a, b], r.deepcopy())
+    # a (fulfillment 1.0) sheds to 3 -> 8000m still hot; b sheds to 2 ->
+    # 7000m < 7760m -> stop.
+    assert diff == {"a": -1, "b": -1}
+
+
+def test_full_cluster_shed_reaches_min_under_extreme_pressure():
+    r = roomy_cluster(n_nodes=2, cpu=4000, tpu=0)
+    r.cpu_request_milli = 50_000  # shedding alone can never fix this
+    a = make_view("a", parallelism=4, mn=1, mx=4, cpu=1000, tpu=0)
+    b = make_view("b", parallelism=3, mn=1, mx=4, cpu=1000, tpu=0)
+    diff = scale_all_jobs_dry_run([a, b], r.deepcopy())
+    assert diff == {"a": -3, "b": -2}  # both pinned at min, loop terminates
+
+
+def test_fixed_point_terminates_at_full_tpu_utilization():
+    # chips at exactly 100%: the reference's up-to-100%/down-at-97%
+    # conditions would oscillate forever; ours must terminate with no
+    # change.
+    r = roomy_cluster(n_nodes=2, tpu=4)
+    r.tpu_limit = 8
+    for n in r.nodes.tpu_free:
+        r.nodes.tpu_free[n] = 0
+    a = make_view("a", parallelism=2, mn=1, mx=4)
+    assert scale_all_jobs_dry_run([a], r.deepcopy()) == {}
+
+
+# ---- pending-demand shedding (TPU-native fix of ref's gap) ------------------
+
+
+def test_pending_demand_sheds_running_elastic_jobs():
+    # All 16 chips in use by an elastic job; a pending job needs 4.
+    r = roomy_cluster(n_nodes=4, tpu=4)
+    r.tpu_limit = 16
+    for n in r.nodes.tpu_free:
+        r.nodes.tpu_free[n] = 0
+    a = make_view("a", parallelism=4, mn=1, mx=4)
+    diff = scale_all_jobs_dry_run([a], r.deepcopy(), pending_tpu_demand=4)
+    assert diff == {"a": -1}
+
+
+def test_pending_demand_suppresses_tpu_scale_up():
+    r = roomy_cluster(n_nodes=4, tpu=4)  # 16 chips, 12 free
+    r.tpu_limit = 4
+    a = make_view("a", parallelism=1, mn=1, mx=4)
+    diff = scale_all_jobs_dry_run([a], r.deepcopy(), pending_tpu_demand=8)
+    assert diff == {}
+
+
+def test_pending_demand_stops_shedding_once_satisfied():
+    r = roomy_cluster(n_nodes=4, tpu=4)
+    r.tpu_limit = 16
+    for n in r.nodes.tpu_free:
+        r.nodes.tpu_free[n] = 0
+    a = make_view("a", parallelism=4, mn=1, mx=4)
+    b = make_view("b", parallelism=4, mn=1, mx=4)
+    diff = scale_all_jobs_dry_run([a, b], r.deepcopy(), pending_tpu_demand=4)
+    # one shed replica frees exactly 4 chips; the other job keeps its 4
+    assert sum(diff.values()) == -1
+
+
+# ---- JobView plumbing -------------------------------------------------------
+
+
+def test_jobview_from_trainingjob_defaults():
+    job = TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": "x"},
+            "spec": {
+                "fault_tolerant": True,
+                "trainer": {
+                    "min_instance": 2,
+                    "max_instance": 6,
+                    "slice_topology": "v5e-8",
+                    "resources": {"requests": {"cpu": "4", "memory": "8Gi"}},
+                },
+            },
+        }
+    ).validate()
+    v = JobView.from_job(job)
+    assert v.parallelism == 2  # defaults to min when no status
+    assert v.cpu_request_milli == 4000
+    assert v.mem_request_mega == 8192
+    assert v.tpu_per_trainer == 8
+    assert v.elastic

@@ -6,6 +6,7 @@ event-driven control loop in ``scaler`` (ref ``:451-485``).
 
 from edl_tpu.autoscaler.algorithm import (
     JobView,
+    PendingDemand,
     fulfillment,
     sorted_jobs,
     search_assignable_node,
@@ -16,6 +17,7 @@ from edl_tpu.autoscaler.scaler import Autoscaler, ScalePlan
 
 __all__ = [
     "JobView",
+    "PendingDemand",
     "fulfillment",
     "sorted_jobs",
     "search_assignable_node",

@@ -16,8 +16,10 @@ have:
   only indirectly (shed when cluster load exceeds ``max_load_desired``,
   ref ``:235-246``) — with device chips at 100% and a pending job
   queued, nothing ever shed.  Here the dry run takes the pending jobs'
-  aggregate chip demand explicitly: while free chips are short of it,
-  scale-ups pause and the least-deserving elastic jobs shed toward min.
+  aggregate demand (chips, CPU, memory) explicitly: while free capacity
+  is short of it on an axis, scale-ups of jobs competing on that axis
+  pause and the least-deserving elastic jobs shed toward min; growth
+  always leaves the demand reserved.
 - **No livelock.** The reference scales device use up to 100% (ref
   ``:276``) but sheds when above ``max_load_desired`` (ref ``:235``) —
   at full utilization those fight forever.  Our up/down conditions are
@@ -39,6 +41,54 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 from edl_tpu.cluster.resources import ClusterResource
 from edl_tpu.resource.training_job import TrainingJob
+
+
+@dataclass
+class PendingDemand:
+    """Aggregate resources fully-pending jobs need to start (their
+    min_instance worth).  The reference had no such notion — pending
+    jobs got room only when cluster load happened to cross
+    ``max_load_desired`` (ref ``pkg/autoscaler.go:235-246``), which
+    never fires when chips are at 100% or the pressure is on an
+    uncharged axis.  The dry run treats unmet demand as *starvation*:
+    sheds fire and competing scale-ups pause until free capacity covers
+    it."""
+
+    tpu_chips: int = 0
+    cpu_milli: int = 0
+    mem_mega: int = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.tpu_chips or self.cpu_milli or self.mem_mega)
+
+
+def _starved_axes(
+    r: ClusterResource, demand: PendingDemand, max_load_desired: float
+) -> set:
+    """Axes whose free capacity cannot cover the pending demand."""
+    axes = set()
+    if demand.tpu_chips and r.tpu_total - r.tpu_limit < demand.tpu_chips:
+        axes.add("tpu")
+    if (
+        demand.cpu_milli
+        and r.cpu_total_milli * max_load_desired - r.cpu_request_milli
+        < demand.cpu_milli
+    ):
+        axes.add("cpu")
+    if (
+        demand.mem_mega
+        and r.memory_total_mega - r.memory_request_mega < demand.mem_mega
+    ):
+        axes.add("mem")
+    return axes
+
+
+def _competes_on(j: JobView, axes: set) -> bool:
+    return (
+        ("tpu" in axes and j.tpu_per_trainer > 0)
+        or ("cpu" in axes and j.cpu_request_milli > 0)
+        or ("mem" in axes and j.mem_request_mega > 0)
+    )
 
 
 @dataclass
@@ -186,7 +236,7 @@ def scale_dry_run(
     cur_diff: int,
     max_load_desired: float = 0.97,
     scale_down: bool = False,
-    pending_tpu_demand: int = 0,
+    pending: Optional[PendingDemand] = None,
 ) -> int:
     """Decide one scaling step for one job against the simulated
     inventory, mutating ``r`` by whatever is decided.  Returns the
@@ -197,6 +247,8 @@ def scale_dry_run(
     per-node maps.
     """
     planned = j.parallelism + cur_diff
+    pending = pending or PendingDemand()
+    starved = _starved_axes(r, pending, max_load_desired)
 
     # ======================= scale down =======================
     if scale_down:
@@ -208,12 +260,10 @@ def scale_dry_run(
             _apply(r, j, delta, ())
             return delta
         cpu_hot = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
-        tpu_over = r.tpu_limit > r.tpu_total  # oversubscribed (inventory shrank)
-        tpu_starved = (
-            pending_tpu_demand > 0
-            and r.tpu_total - r.tpu_limit < pending_tpu_demand
-        )
-        if cpu_hot or tpu_over or tpu_starved:
+        # Oversubscription: inventory shrank under running pods.
+        tpu_over = r.tpu_limit > r.tpu_total
+        mem_over = r.memory_request_mega > r.memory_total_mega
+        if cpu_hot or tpu_over or mem_over or _competes_on(j, starved):
             if planned > j.min_instance:
                 target = j.next_size_down(planned)
                 if target is not None and target >= j.min_instance:
@@ -229,8 +279,12 @@ def scale_dry_run(
         delta = min(0, j.max_instance - planned)
         _apply(r, j, delta, ())
         return delta
-    if pending_tpu_demand > 0 and j.tpu_per_trainer > 0:
-        # Make room for pending jobs before growing running ones.
+    if _competes_on(j, starved):
+        # Free capacity doesn't yet cover the pending jobs' demand on an
+        # axis this job consumes: pause its growth so sheds aren't
+        # immediately re-eaten.  (Once free >= demand, growth resumes —
+        # a job pending for non-capacity reasons can't freeze the
+        # cluster.)
         return 0
 
     target = j.next_size_up(planned)
@@ -238,16 +292,24 @@ def scale_dry_run(
         return 0
     step = target - planned
 
-    # Whole-step feasibility.
-    if r.memory_total_mega - r.memory_request_mega < j.mem_request_mega * step:
+    # Whole-step feasibility, with the pending jobs' demand reserved so
+    # growth never consumes room a queued job is waiting for (otherwise
+    # the fixed point would grow/shed in a loop).
+    if (
+        r.memory_total_mega - r.memory_request_mega - pending.mem_mega
+        < j.mem_request_mega * step
+    ):
         return 0  # insufficient memory (ref ``:259-263``)
     if (
-        r.cpu_total_milli * max_load_desired - r.cpu_request_milli
+        r.cpu_total_milli * max_load_desired
+        - r.cpu_request_milli
+        - pending.cpu_milli
         < j.cpu_request_milli * step
     ):
         return 0  # would push CPU above max_load_desired (ref ``:269-273``)
     if j.tpu_per_trainer > 0 and (
-        r.tpu_total - r.tpu_limit < j.tpu_per_trainer * step
+        r.tpu_total - r.tpu_limit - pending.tpu_chips
+        < j.tpu_per_trainer * step
     ):
         return 0  # not enough free chips; chips may go to 100% (ref ``:275-278``)
 
@@ -280,7 +342,7 @@ def scale_all_jobs_dry_run(
     jobs: Sequence[JobView],
     r: ClusterResource,
     max_load_desired: float = 0.97,
-    pending_tpu_demand: int = 0,
+    pending: Optional[PendingDemand] = None,
     max_iters: int = 100,
 ) -> Dict[str, int]:
     """Iterate per-job dry runs to a fixed point; returns name -> replica
@@ -298,14 +360,14 @@ def scale_all_jobs_dry_run(
         ordered = sorted_jobs(jobs, elastic)
         for j in ordered:  # scale up, neediest first
             add = scale_dry_run(
-                sim, j, diff[j.name], max_load_desired, False, pending_tpu_demand
+                sim, j, diff[j.name], max_load_desired, False, pending
             )
             diff[j.name] += add
             if add != 0:
                 no_change = False
         for j in reversed(ordered):  # scale down, most-fulfilled first
             add = scale_dry_run(
-                sim, j, diff[j.name], max_load_desired, True, pending_tpu_demand
+                sim, j, diff[j.name], max_load_desired, True, pending
             )
             diff[j.name] += add
             if add != 0:

@@ -25,7 +25,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from edl_tpu.autoscaler.algorithm import JobView, scale_all_jobs_dry_run
+from edl_tpu.autoscaler.algorithm import (
+    JobView,
+    PendingDemand,
+    scale_all_jobs_dry_run,
+)
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.resource.training_job import TrainingJob
 
@@ -46,7 +50,7 @@ class ScalePlan:
     targets: Dict[str, int]
     diff: Dict[str, int]
     have_pending: bool
-    pending_tpu_demand: int
+    pending: PendingDemand
 
 
 class Autoscaler:
@@ -96,22 +100,25 @@ class Autoscaler:
         if not self.jobs:
             return None
         r = self.cluster.inquiry_resource()
+        pods_by_job = self.cluster.job_pods_map()  # ONE pod list per tick
 
-        views: List[JobView] = []
-        pending_tpu_demand = 0
+        views: List[tuple] = []
+        demand = PendingDemand()
         have_pending = False
         for job in self.jobs.values():
             w = self.cluster.get_trainer_workload(job)
             if w is None:
                 continue  # not created yet (ref tryToRetrieve..., :424-447)
-            total, running, pending = self.cluster.job_pods(job)
+            total, running, pending = pods_by_job.get(job.name, (0, 0, 0))
             if total > 0 and total == pending:
                 # every pod pending: the job cannot start (ref
-                # findPendingJob, :406-422)
+                # findPendingJob, :406-422).  Its min-instance needs
+                # become explicit demand on every axis it consumes.
                 have_pending = True
-                pending_tpu_demand += (
-                    job.spec.trainer.min_instance * job.tpu_per_trainer()
-                )
+                t = job.spec.trainer
+                demand.tpu_chips += t.min_instance * job.tpu_per_trainer()
+                demand.cpu_milli += t.min_instance * t.resources.cpu_request_milli()
+                demand.mem_mega += t.min_instance * t.resources.mem_request_mega()
                 continue  # a fully-pending job is demand, not a candidate
             views.append((JobView.from_job(job, parallelism=w.parallelism), total, running))
 
@@ -120,14 +127,14 @@ class Autoscaler:
         candidates = [
             v for v, total, running in views if total == running or have_pending
         ]
-        if not candidates and pending_tpu_demand == 0:
+        if not candidates and not demand:
             return None
 
         diff = scale_all_jobs_dry_run(
             candidates,
             r.deepcopy(),
             self.max_load_desired,
-            pending_tpu_demand=pending_tpu_demand,
+            pending=demand,
         )
 
         targets: Dict[str, int] = {}
@@ -139,7 +146,7 @@ class Autoscaler:
             targets=targets,
             diff=diff,
             have_pending=have_pending,
-            pending_tpu_demand=pending_tpu_demand,
+            pending=demand,
         )
         self.plans.append(plan)
         return plan

@@ -1,0 +1,264 @@
+"""L5 CLI: submit / list / kill / manifests / crd / local-run / local-sim.
+
+The reference had no CLI of its own — users went through the external
+``paddlecloud`` client/server, which also created the k8s objects
+(``pkg/resource/training_job.go:39-58``, ``pkg/controller.go:115-118``).
+This CLI subsumes that role (SURVEY.md §2.2):
+
+- ``submit``     validate a TrainingJob YAML and apply the CR (kubectl)
+- ``manifests``  print the rendered trainer/coordinator manifests
+- ``crd``        print the TrainingJob CustomResourceDefinition
+- ``list``       list TrainingJobs (kubectl)
+- ``kill``       delete a TrainingJob (kubectl)
+- ``local-run``  the §7.3 end-to-end slice in one process: spec ->
+                 validate -> elastic training on local devices with
+                 mid-run resizes -> loss-continuity summary
+- ``local-sim``  controller + autoscaler closed loop against an
+                 in-memory fake cluster (no k8s needed)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from typing import List, Optional
+
+from edl_tpu.resource.training_job import TrainingJob, crd_manifest
+
+
+def _load_job(path: str) -> TrainingJob:
+    with open(path) as f:
+        text = f.read()
+    return TrainingJob.from_yaml(text).validate()
+
+
+def _dump_yaml(objs) -> str:
+    import yaml
+
+    if isinstance(objs, dict):
+        objs = [objs]
+    return "---\n".join(yaml.safe_dump(o, sort_keys=False) for o in objs)
+
+
+def cmd_submit(args) -> int:
+    job = _load_job(args.spec)
+    manifest = job.to_manifest()
+    if args.dry_run:
+        print(_dump_yaml(manifest))
+        return 0
+    p = subprocess.run(
+        ["kubectl", "apply", "-f", "-"],
+        input=_dump_yaml(manifest),
+        text=True,
+        capture_output=True,
+    )
+    sys.stdout.write(p.stdout)
+    sys.stderr.write(p.stderr)
+    return p.returncode
+
+
+def cmd_manifests(args) -> int:
+    from edl_tpu.controller.jobparser import parse_to_coordinator, parse_to_trainer
+
+    job = _load_job(args.spec)
+    objs = [parse_to_trainer(job)] + parse_to_coordinator(job)
+    print(_dump_yaml(objs))
+    return 0
+
+
+def cmd_crd(args) -> int:
+    print(_dump_yaml(crd_manifest()))
+    return 0
+
+
+def cmd_list(args) -> int:
+    p = subprocess.run(
+        ["kubectl", "get", "trainingjobs", "-A"], capture_output=True, text=True
+    )
+    sys.stdout.write(p.stdout)
+    sys.stderr.write(p.stderr)
+    return p.returncode
+
+
+def cmd_kill(args) -> int:
+    p = subprocess.run(
+        ["kubectl", "delete", "trainingjob", args.name],
+        capture_output=True,
+        text=True,
+    )
+    sys.stdout.write(p.stdout)
+    sys.stderr.write(p.stderr)
+    return p.returncode
+
+
+def _parse_resizes(specs: List[str]):
+    """--resize-at step:world pairs."""
+    out = []
+    for s in specs or []:
+        step, world = s.split(":")
+        out.append((int(step), int(world)))
+    return sorted(out)
+
+
+def cmd_local_run(args) -> int:
+    """One process, local devices: train the job's model elastically,
+    applying the requested mid-run resizes — the minimum end-to-end
+    slice of SURVEY.md §7.3."""
+    import jax
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    job = _load_job(args.spec)
+    model = get_model(job.spec.trainer.entrypoint or "mnist")
+    n_dev = len(jax.devices())
+    t = job.spec.trainer
+    start_world = min(t.min_instance, n_dev)
+    gbs = job.spec.global_batch_size or max(64, 8 * n_dev)
+    data = ShardedDataIterator(
+        synthetic_dataset(model.synth_batch, max(4096, gbs)),
+        global_batch_size=gbs,
+        seed=args.seed,
+    )
+    coord = LocalCoordinator(
+        target_world=start_world,
+        max_world=min(t.max_instance, n_dev),
+        legal_sizes=[w for w in job.legal_world_sizes() if w <= n_dev],
+    )
+    for i in range(min(t.max_instance, n_dev)):
+        coord.register(f"local-{i}")
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-3),
+        data,
+        coord,
+        checkpoint_interval=job.spec.checkpoint_interval_steps,
+        seed=args.seed,
+    )
+
+    resizes = _parse_resizes(args.resize_at)
+    steps = args.steps
+    for at_step, world in resizes:
+        if at_step > steps:
+            break
+        et.run(at_step)
+        coord.set_target_world(world)
+        print(f"[resize] step={at_step} -> target world {world}")
+    et.run(steps)
+    et.store.wait()
+
+    first = et.history[0] if et.history else None
+    last = et.history[-1] if et.history else None
+    summary = {
+        "job": job.name,
+        "model": model.name,
+        "steps": len(et.history),
+        "first_loss": round(first.loss, 4) if first else None,
+        "final_loss": round(last.loss, 4) if last else None,
+        "resizes": [
+            {
+                "generation": e.generation,
+                "world_size": e.world_size,
+                "seconds": round(e.seconds, 4),
+                "graceful": e.graceful,
+            }
+            for e in et.resize_events
+        ],
+        "world_sizes_seen": sorted({r.world_size for r in et.history}),
+    }
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+def cmd_local_sim(args) -> int:
+    """Controller + autoscaler closed loop against FakeKube: shows the
+    scheduling/scaling story without k8s or devices."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.kube import FakeKube, NodeInfo
+    from edl_tpu.controller.controller import Controller
+
+    jobs = [_load_job(p) for p in args.spec]
+    kube = FakeKube(
+        [
+            NodeInfo(
+                name=f"pool-{i}",
+                cpu_milli=args.node_cpu_milli,
+                memory_mega=args.node_memory_mega,
+                tpu_chips=args.node_tpu_chips,
+            )
+            for i in range(args.nodes)
+        ]
+    )
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, Autoscaler(cluster, max_load_desired=args.max_load))
+    for job in jobs:
+        ctrl.on_add(job)
+    for i in range(args.iterations):
+        ctrl.run_once()
+        kube.retry_scheduling()
+    ctrl.reconcile_status()
+    print(json.dumps(ctrl.job_statuses(), indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="edl", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("submit", help="validate + apply a TrainingJob")
+    s.add_argument("spec")
+    s.add_argument("--dry-run", action="store_true")
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("manifests", help="print rendered k8s manifests")
+    s.add_argument("spec")
+    s.set_defaults(fn=cmd_manifests)
+
+    s = sub.add_parser("crd", help="print the TrainingJob CRD")
+    s.set_defaults(fn=cmd_crd)
+
+    s = sub.add_parser("list", help="list TrainingJobs")
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("kill", help="delete a TrainingJob")
+    s.add_argument("name")
+    s.set_defaults(fn=cmd_kill)
+
+    s = sub.add_parser("local-run", help="end-to-end elastic run, local devices")
+    s.add_argument("spec")
+    s.add_argument("--steps", type=int, default=50)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument(
+        "--resize-at",
+        action="append",
+        metavar="STEP:WORLD",
+        help="trigger a resize at a step (repeatable)",
+    )
+    s.set_defaults(fn=cmd_local_run)
+
+    s = sub.add_parser("local-sim", help="controller+autoscaler vs fake cluster")
+    s.add_argument("spec", nargs="+")
+    s.add_argument("--nodes", type=int, default=4)
+    s.add_argument("--node-tpu-chips", type=int, default=4)
+    s.add_argument("--node-cpu-milli", type=int, default=8000)
+    s.add_argument("--node-memory-mega", type=int, default=32768)
+    s.add_argument("--max-load", type=float, default=0.97)
+    s.add_argument("--iterations", type=int, default=6)
+    s.set_defaults(fn=cmd_local_sim)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,7 +15,7 @@ entirely untested, SURVEY.md §4).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from edl_tpu.cluster.kube import KubeAPI, WorkloadInfo
 from edl_tpu.cluster.resources import ClusterResource, Nodes
@@ -96,16 +96,23 @@ class Cluster:
         """(total, running, pending) over the job's non-deleting pods
         (ref ``pkg/cluster.go:117-136``: label-selected, honoring
         DeletionTimestamp)."""
-        total = running = pending = 0
+        return self.job_pods_map().get(job.name, (0, 0, 0))
+
+    def job_pods_map(self) -> Dict[str, Tuple[int, int, int]]:
+        """(total, running, pending) for every job in ONE pod list —
+        the autoscaler loop uses this so a tick costs one list call,
+        not one per job."""
+        out: Dict[str, List[int]] = {}
         for p in self.kube.list_pods():
-            if p.job_name != job.name or p.deleting:
+            if not p.job_name or p.deleting:
                 continue
-            total += 1
+            c = out.setdefault(p.job_name, [0, 0, 0])
+            c[0] += 1
             if p.phase == "Running":
-                running += 1
+                c[1] += 1
             elif p.phase == "Pending":
-                pending += 1
-        return total, running, pending
+                c[2] += 1
+        return {k: (v[0], v[1], v[2]) for k, v in out.items()}
 
     # -- CRUD (ref :245-291) -------------------------------------------------
     def create_trainer_workload(self, job: TrainingJob) -> WorkloadInfo:

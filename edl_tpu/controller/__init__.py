@@ -1,0 +1,19 @@
+"""L2/L4: job parsing, lifecycle, controller, and the pod launcher."""
+
+from edl_tpu.controller.jobparser import (
+    JobParser,
+    parse_to_trainer,
+    parse_to_coordinator,
+    pod_env,
+)
+from edl_tpu.controller.lifecycle import JobLifecycle
+from edl_tpu.controller.controller import Controller
+
+__all__ = [
+    "JobParser",
+    "parse_to_trainer",
+    "parse_to_coordinator",
+    "pod_env",
+    "JobLifecycle",
+    "Controller",
+]

@@ -1,0 +1,91 @@
+"""Job lifecycle: create/ensure/teardown of a job's cluster objects.
+
+The reference's ``TrainingJober`` (``pkg/trainingjober.go``) did this
+for trainer Job + pserver RS + master RS — but was **never wired in**
+(SURVEY.md §1 "orphaned"; creation happened in the external paddlecloud
+server).  Here the lifecycle is owned by the controller, as the
+reference's own TODO intended (``pkg/controller.go:115-133``), and a
+job is two objects: trainer workload + coordinator.
+
+Semantics kept from the reference: ``ensure`` = bounded retries with a
+pause (ref 3 tries x 1s, ``pkg/trainingjober.go:25-28,196-207``);
+partial-creation rollback (ref ``:170-189``); ``complete`` tears down
+the coordinator but leaves the trainer workload for GC (ref
+``Complete`` kept the trainer Job, ``:126-132``); ``destroy`` removes
+everything (ref ``:135-140``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.kube import WorkloadInfo
+from edl_tpu.resource.training_job import TrainingJob
+
+ENSURE_ATTEMPTS = 3  # ref convertedJobMaxRetryCount (pkg/trainingjober.go:25-28)
+ENSURE_PAUSE_SECONDS = 1.0
+
+
+class JobLifecycle:
+    def __init__(
+        self,
+        cluster: Cluster,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.cluster = cluster
+        self._sleep = sleep
+
+    # -- create -------------------------------------------------------------
+    def _coordinator_workload(self, job: TrainingJob) -> WorkloadInfo:
+        res = job.spec.coordinator.resources
+        return WorkloadInfo(
+            name=job.coordinator_name(),
+            job_name=job.coordinator_name(),
+            parallelism=1,
+            cpu_request_milli=res.cpu_request_milli() or 250,
+            memory_request_mega=res.mem_request_mega() or 256,
+            tpu_limit=0,
+        )
+
+    def check_and_create(self, job: TrainingJob) -> bool:
+        """Create whichever of the job's objects are missing; roll back
+        this call's creations on failure (ref ``checkAndCreate``,
+        ``pkg/trainingjober.go:142-193``)."""
+        created = []
+        try:
+            if self.cluster.kube.get_workload(job.coordinator_name()) is None:
+                self.cluster.kube.create_workload(self._coordinator_workload(job))
+                created.append(job.coordinator_name())
+            if self.cluster.get_trainer_workload(job) is None:
+                self.cluster.create_trainer_workload(job)
+                created.append(job.trainer_job_name())
+            return True
+        except Exception:
+            for name in created:  # rollback partial creation
+                try:
+                    self.cluster.kube.delete_workload(name)
+                except Exception:
+                    pass
+            return False
+
+    def ensure(self, job: TrainingJob) -> bool:
+        """ref ``Ensure`` (``pkg/trainingjober.go:196-207``)."""
+        for attempt in range(ENSURE_ATTEMPTS):
+            if self.check_and_create(job):
+                return True
+            if attempt < ENSURE_ATTEMPTS - 1:
+                self._sleep(ENSURE_PAUSE_SECONDS)
+        return False
+
+    # -- teardown -----------------------------------------------------------
+    def complete(self, job: TrainingJob) -> None:
+        """Job finished: drop the coordinator, keep the trainer workload
+        for inspection/GC (ref ``Complete``, ``:126-132``)."""
+        self.cluster.kube.delete_workload(job.coordinator_name())
+
+    def destroy(self, job: TrainingJob) -> None:
+        """Job deleted: remove everything (ref ``Destroy``, ``:135-140``)."""
+        self.cluster.kube.delete_workload(job.coordinator_name())
+        self.cluster.delete_trainer_workload(job)

@@ -1,0 +1,146 @@
+"""Pod launcher: the ``paddle_k8s`` replacement.
+
+The reference's pods booted through an external ``paddle_k8s`` shell
+script that resolved peers from env/etcd and exec'd the right binary
+(``pkg/jobparser.go:78-82,118-122,197``).  Our launcher is in-framework
+(SURVEY.md §2.2: "our own launcher"):
+
+1. read the ``EDL_*`` env contract (``controller/jobparser.py``),
+2. ``jax.distributed.initialize`` when the pod is part of a multi-host
+   TPU slice (JAX's coordination service replaces etcd discovery),
+3. register with the job coordinator (``EDL_COORDINATOR_ADDR``),
+4. build the model named by the entrypoint and run the elastic loop.
+
+Also runnable by hand for local/smoke use:
+``python -m edl_tpu.launcher --entrypoint mnist --steps 100``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import uuid
+from typing import Optional
+
+
+def env_config() -> dict:
+    """Parse the EDL_* pod env contract into a config dict."""
+    e = os.environ
+    return {
+        "job_name": e.get("EDL_JOB_NAME", "local"),
+        "coordinator_addr": e.get("EDL_COORDINATOR_ADDR", ""),
+        "entrypoint": e.get("EDL_ENTRYPOINT", ""),
+        "workspace": e.get("EDL_WORKSPACE", ""),
+        "slice_topology": e.get("EDL_SLICE_TOPOLOGY", "v5e-1"),
+        "min_instance": int(e.get("EDL_MIN_INSTANCE", "1")),
+        "max_instance": int(e.get("EDL_MAX_INSTANCE", "1")),
+        "num_passes": int(e.get("EDL_NUM_PASSES", "1")),
+        "global_batch_size": int(e.get("EDL_GLOBAL_BATCH_SIZE", "0")),
+        "checkpoint_interval": int(e.get("EDL_CHECKPOINT_INTERVAL", "100")),
+        "fault_tolerant": e.get("EDL_FAULT_TOLERANT", "0") == "1",
+        "pod_name": e.get("EDL_POD_NAME", ""),
+    }
+
+
+def init_distributed() -> None:
+    """Join the slice's JAX coordination service when this pod is part
+    of a multi-host TPU slice.  On GKE TPU podslices the TPU runtime
+    env (``TPU_WORKER_HOSTNAMES`` etc.) carries everything
+    ``jax.distributed.initialize`` needs; single-host pods skip this.
+    (This one call replaces the reference's entire port/etcd discovery
+    plumbing, SURVEY.md §2.5.)"""
+    import jax
+
+    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    ):
+        jax.distributed.initialize()
+
+
+def run(
+    entrypoint: str,
+    steps: Optional[int] = None,
+    coordinator_addr: str = "",
+    global_batch_size: int = 0,
+    checkpoint_interval: int = 100,
+    seed: int = 0,
+    dataset_examples: int = 4096,
+) -> "ElasticTrainer":
+    """Build and run the elastic training loop for a registered model.
+
+    Returns the ElasticTrainer (with history) for inspection."""
+    import jax
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coord_service import HTTPCoordinator
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    cfg = env_config()
+    model = get_model(entrypoint or cfg["entrypoint"])
+    n_dev = len(jax.devices())
+    gbs = global_batch_size or cfg["global_batch_size"] or max(64, 8 * n_dev)
+    data = ShardedDataIterator(
+        synthetic_dataset(model.synth_batch, max(dataset_examples, gbs)),
+        global_batch_size=gbs,
+        seed=seed,
+    )
+
+    trainer_id = cfg["pod_name"] or f"trainer-{uuid.uuid4().hex[:8]}"
+    addr = coordinator_addr or cfg["coordinator_addr"]
+    if addr:
+        coordinator = HTTPCoordinator(addr)
+        coordinator.register(trainer_id)
+    else:
+        # Local mode: in-process coordinator, one membership per device.
+        coordinator = LocalCoordinator(
+            target_world=min(cfg["max_instance"], n_dev) or n_dev,
+            max_world=max(cfg["max_instance"], n_dev),
+        )
+        for i in range(n_dev):
+            coordinator.register(f"{trainer_id}-{i}")
+
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-3),
+        data,
+        coordinator,
+        checkpoint_interval=checkpoint_interval or cfg["checkpoint_interval"],
+        seed=seed,
+    )
+    if steps is None:
+        steps = cfg["num_passes"] * data.batches_per_epoch
+    et.run(steps)
+    et.store.wait()
+    return et
+
+
+def main(argv=None):  # pragma: no cover - process entrypoint
+    p = argparse.ArgumentParser(description="EDL-TPU trainer launcher")
+    p.add_argument("--entrypoint", default="", help="registered model name")
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--coordinator", default="", help="coordinator address")
+    p.add_argument("--global-batch-size", type=int, default=0)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    init_distributed()
+    et = run(
+        entrypoint=args.entrypoint,
+        steps=args.steps,
+        coordinator_addr=args.coordinator,
+        global_batch_size=args.global_batch_size,
+        seed=args.seed,
+    )
+    last = et.history[-1] if et.history else None
+    print(
+        f"done: steps={len(et.history)} "
+        f"final_loss={last.loss if last else float('nan'):.4f} "
+        f"resizes={len(et.resize_events)}"
+    )
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

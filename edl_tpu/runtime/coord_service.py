@@ -1,0 +1,216 @@
+"""Coordinator as a service: HTTP front-end over ``LocalCoordinator``.
+
+In the deployed reference system, membership truth lived in an etcd
+sidecar next to the master (``pkg/jobparser.go:174-232``) and trainers
+reached it through env-plumbed endpoints.  Our replacement is one tiny
+JSON-over-HTTP service (stdlib only — the pod image needs nothing but
+python) exposing exactly the ``LocalCoordinator`` interface; the
+``HTTPCoordinator`` client is interface-compatible with
+``LocalCoordinator`` so ``ElasticTrainer`` works with either (in-process
+for tests/local mode, over the network in a cluster).
+
+Run as a pod: ``python -m edl_tpu.runtime.coord_service --port 7164
+--min-world 1 --max-world 8`` (this is the command
+``parse_to_coordinator`` bakes into the coordinator Deployment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from edl_tpu.runtime.coordinator import ElasticPlan, LocalCoordinator
+
+
+def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
+    if plan is None:
+        return None
+    return {
+        "generation": plan.generation,
+        "world_size": plan.world_size,
+        "members": list(plan.members),
+        "restore_step": plan.restore_step,
+    }
+
+
+def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
+    if not d:
+        return None
+    return ElasticPlan(
+        generation=d["generation"],
+        world_size=d["world_size"],
+        members=tuple(d["members"]),
+        restore_step=d.get("restore_step", -1),
+    )
+
+
+class CoordinatorServer:
+    """Serve a LocalCoordinator over HTTP.  One POST endpoint per
+    coordinator method; GET /plan for the hot-path poll."""
+
+    def __init__(self, coordinator: LocalCoordinator, host: str = "0.0.0.0", port: int = 7164):
+        self.coordinator = coordinator
+        coord = coordinator
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/plan":
+                    self._reply({"plan": _plan_to_dict(coord.plan())})
+                elif self.path == "/members":
+                    self._reply({"members": coord.members()})
+                elif self.path == "/healthz":
+                    self._reply({"ok": True})
+                else:
+                    self._reply({"error": "not found"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                try:
+                    if self.path == "/register":
+                        plan = coord.register(req["trainer_id"])
+                        self._reply({"plan": _plan_to_dict(plan)})
+                    elif self.path == "/deregister":
+                        coord.deregister(req["trainer_id"])
+                        self._reply({"ok": True})
+                    elif self.path == "/heartbeat":
+                        coord.heartbeat(req["trainer_id"])
+                        self._reply({"ok": True})
+                    elif self.path == "/ack":
+                        coord.ack_generation(req["trainer_id"], req["generation"])
+                        self._reply({"ok": True})
+                    elif self.path == "/target":
+                        coord.set_target_world(req["world"])
+                        self._reply({"ok": True})
+                    elif self.path == "/checkpoint":
+                        coord.report_checkpoint(req["step"])
+                        self._reply({"ok": True})
+                    elif self.path == "/evict_dead":
+                        self._reply({"evicted": coord.evict_dead()})
+                    else:
+                        self._reply({"error": "not found"}, 404)
+                except KeyError as e:
+                    self._reply({"error": f"unknown trainer: {e}"}, 404)
+                except ValueError as e:
+                    self._reply({"error": str(e)}, 400)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="edl-coord"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class HTTPCoordinator:
+    """Client-side twin of ``LocalCoordinator`` — same methods, same
+    types, network underneath.  Injected into ``ElasticTrainer`` by the
+    launcher when ``EDL_COORDINATOR_ADDR`` is set."""
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        if "://" not in address:
+            address = f"http://{address}"
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str) -> dict:
+        with urllib.request.urlopen(
+            f"{self.address}{path}", timeout=self.timeout
+        ) as r:
+            return json.loads(r.read())
+
+    def _post(self, path: str, **payload) -> dict:
+        req = urllib.request.Request(
+            f"{self.address}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            body = json.loads(r.read())
+        return body
+
+    # -- LocalCoordinator interface -----------------------------------------
+    def register(self, trainer_id: str) -> Optional[ElasticPlan]:
+        return _plan_from_dict(self._post("/register", trainer_id=trainer_id)["plan"])
+
+    def deregister(self, trainer_id: str):
+        self._post("/deregister", trainer_id=trainer_id)
+
+    def heartbeat(self, trainer_id: str):
+        self._post("/heartbeat", trainer_id=trainer_id)
+
+    def ack_generation(self, trainer_id: str, generation: int):
+        self._post("/ack", trainer_id=trainer_id, generation=generation)
+
+    def set_target_world(self, n: int):
+        self._post("/target", world=n)
+
+    def report_checkpoint(self, step: int):
+        self._post("/checkpoint", step=step)
+
+    def evict_dead(self) -> List[str]:
+        return self._post("/evict_dead")["evicted"]
+
+    def plan(self) -> Optional[ElasticPlan]:
+        return _plan_from_dict(self._get("/plan")["plan"])
+
+    def members(self) -> List[str]:
+        return self._get("/members")["members"]
+
+
+def main(argv=None):  # pragma: no cover - pod entrypoint
+    p = argparse.ArgumentParser(description="EDL-TPU coordinator service")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7164)
+    p.add_argument("--min-world", type=int, default=1)
+    p.add_argument("--max-world", type=int, default=1)
+    p.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    p.add_argument(
+        "--legal-sizes",
+        default="",
+        help="comma-separated legal world sizes (default: every size)",
+    )
+    args = p.parse_args(argv)
+    legal = (
+        [int(s) for s in args.legal_sizes.split(",") if s] or None
+    )
+    coord = LocalCoordinator(
+        target_world=args.min_world,
+        max_world=args.max_world,
+        heartbeat_timeout=args.heartbeat_timeout,
+        legal_sizes=legal,
+    )
+    server = CoordinatorServer(coord, host=args.host, port=args.port)
+    print(f"edl-tpu coordinator listening on {args.host}:{server.port}")
+    server._server.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -10,6 +10,7 @@ import pytest
 
 from edl_tpu.autoscaler.algorithm import (
     JobView,
+    PendingDemand,
     elastic,
     fulfillment,
     needs_tpu,
@@ -330,16 +331,28 @@ def test_pending_demand_sheds_running_elastic_jobs():
     for n in r.nodes.tpu_free:
         r.nodes.tpu_free[n] = 0
     a = make_view("a", parallelism=4, mn=1, mx=4)
-    diff = scale_all_jobs_dry_run([a], r.deepcopy(), pending_tpu_demand=4)
+    diff = scale_all_jobs_dry_run(
+        [a], r.deepcopy(), pending=PendingDemand(tpu_chips=4)
+    )
     assert diff == {"a": -1}
 
 
-def test_pending_demand_suppresses_tpu_scale_up():
+def test_pending_demand_suppresses_scale_up_only_while_starved():
     r = roomy_cluster(n_nodes=4, tpu=4)  # 16 chips, 12 free
     r.tpu_limit = 4
     a = make_view("a", parallelism=1, mn=1, mx=4)
-    diff = scale_all_jobs_dry_run([a], r.deepcopy(), pending_tpu_demand=8)
+    # demand 16 > 12 free: starved -> no growth
+    diff = scale_all_jobs_dry_run(
+        [a], r.deepcopy(), pending=PendingDemand(tpu_chips=16)
+    )
     assert diff == {}
+    # demand 4 <= 12 free: not starved -> growth proceeds, but only up
+    # to what keeps the demand reserved (12 free - 4 reserved = 8 chips
+    # = 2 replicas)
+    diff = scale_all_jobs_dry_run(
+        [a], r.deepcopy(), pending=PendingDemand(tpu_chips=4)
+    )
+    assert diff == {"a": 2}
 
 
 def test_pending_demand_stops_shedding_once_satisfied():
@@ -349,9 +362,32 @@ def test_pending_demand_stops_shedding_once_satisfied():
         r.nodes.tpu_free[n] = 0
     a = make_view("a", parallelism=4, mn=1, mx=4)
     b = make_view("b", parallelism=4, mn=1, mx=4)
-    diff = scale_all_jobs_dry_run([a, b], r.deepcopy(), pending_tpu_demand=4)
+    diff = scale_all_jobs_dry_run(
+        [a, b], r.deepcopy(), pending=PendingDemand(tpu_chips=4)
+    )
     # one shed replica frees exactly 4 chips; the other job keeps its 4
     assert sum(diff.values()) == -1
+
+
+def test_cpu_pending_demand_sheds_cpu_jobs():
+    # CPU-only pending job must also force room (the reference only
+    # handled this via load inflation; we do it explicitly).
+    r = roomy_cluster(n_nodes=2, cpu=4000, tpu=0)
+    r.cpu_request_milli = 7000  # 87.5% of 8000: under max_load, so only
+    # the explicit demand can trigger the shed
+    a = make_view("a", parallelism=3, mn=1, mx=4, cpu=1000, tpu=0)
+    diff = scale_all_jobs_dry_run(
+        [a], r.deepcopy(), pending=PendingDemand(cpu_milli=2000)
+    )
+    assert diff == {"a": -2}  # frees 2000m so the pending job fits
+
+
+def test_memory_oversubscription_sheds():
+    # Inventory shrank: memory requests exceed the total -> shed.
+    r = roomy_cluster(n_nodes=1, mem=8192, tpu=0)
+    r.memory_request_mega = 10000
+    a = make_view("a", parallelism=3, mn=1, mx=4, mem=1024, tpu=0)
+    assert scale_dry_run(r, a, 0, scale_down=True) == -1
 
 
 # ---- JobView plumbing -------------------------------------------------------

@@ -1,0 +1,96 @@
+"""CLI surface tests (the reference had no CLI — paddlecloud did this;
+SURVEY.md §2.2)."""
+
+import json
+
+import pytest
+import yaml
+
+from edl_tpu.cli import main
+
+JOB_YAML = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: cli-demo}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  trainer:
+    entrypoint: fit_a_line
+    min_instance: 1
+    max_instance: 4
+    slice_topology: cpu
+    resources:
+      requests: {cpu: "1", memory: 1Gi}
+"""
+
+
+@pytest.fixture
+def spec(tmp_path):
+    p = tmp_path / "job.yaml"
+    p.write_text(JOB_YAML)
+    return str(p)
+
+
+def test_submit_dry_run(spec, capsys):
+    assert main(["submit", spec, "--dry-run"]) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["kind"] == "TrainingJob"
+    assert doc["metadata"]["name"] == "cli-demo"
+
+
+def test_manifests(spec, capsys):
+    assert main(["manifests", spec]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    kinds = sorted(d["kind"] for d in docs)
+    assert kinds == ["Deployment", "Job", "Service"]
+
+
+def test_crd(capsys):
+    assert main(["crd"]) == 0
+    doc = yaml.safe_load(capsys.readouterr().out)
+    assert doc["kind"] == "CustomResourceDefinition"
+    assert doc["metadata"]["name"] == "trainingjobs.edl.tpu.dev"
+
+
+def test_local_sim(spec, capsys):
+    assert (
+        main(
+            [
+                "local-sim",
+                spec,
+                "--nodes",
+                "2",
+                "--node-tpu-chips",
+                "0",
+                "--iterations",
+                "4",
+            ]
+        )
+        == 0
+    )
+    out = json.loads(capsys.readouterr().out)
+    assert out[0]["name"] == "cli-demo"
+    assert out[0]["state"] in ("Running", "Scaling")
+    assert out[0]["parallelism"] >= 1
+
+
+def test_local_run_with_resize(spec, capsys):
+    assert (
+        main(
+            [
+                "local-run",
+                spec,
+                "--steps",
+                "16",
+                "--resize-at",
+                "8:4",
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{") :])
+    assert summary["steps"] == 16
+    assert 4 in summary["world_sizes_seen"]
+    assert summary["final_loss"] < summary["first_loss"]

@@ -1,0 +1,254 @@
+"""L2 jobparser + L4 controller/lifecycle tests, plus the coordinator
+HTTP service round trip."""
+
+import pytest
+
+from edl_tpu.autoscaler.scaler import Autoscaler
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.kube import FakeKube, NodeInfo
+from edl_tpu.controller.controller import Controller
+from edl_tpu.controller.jobparser import (
+    JOB_LABEL,
+    parse_to_coordinator,
+    parse_to_trainer,
+    pod_env,
+)
+from edl_tpu.controller.lifecycle import JobLifecycle
+from edl_tpu.resource.training_job import JobState, TrainingJob
+
+
+def make_job(name="demo", mn=1, mx=4, topo="v5e-4"):
+    return TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": mn < mx,
+                "trainer": {
+                    "entrypoint": "mnist",
+                    "min_instance": mn,
+                    "max_instance": mx,
+                    "slice_topology": topo,
+                    "resources": {"requests": {"cpu": "1", "memory": "2Gi"}},
+                },
+            },
+        }
+    ).validate()
+
+
+def tpu_nodes(n=4, chips=4):
+    return [
+        NodeInfo(name=f"pool-{i}", cpu_milli=8000, memory_mega=32768, tpu_chips=chips)
+        for i in range(n)
+    ]
+
+
+# ---- jobparser --------------------------------------------------------------
+
+
+def test_parse_to_trainer_shape():
+    job = make_job()
+    m = parse_to_trainer(job)
+    assert m["kind"] == "Job"
+    assert m["metadata"]["name"] == "demo-trainer"
+    assert m["spec"]["parallelism"] == 1
+    tmpl = m["spec"]["template"]["spec"]
+    assert tmpl["restartPolicy"] == "Never"  # ref pkg/jobparser.go:153
+    c = tmpl["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert tmpl["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert m["metadata"]["labels"][JOB_LABEL] == "demo"
+
+
+def test_pod_env_contract():
+    job = make_job()
+    env = {e["name"]: e.get("value") for e in pod_env(job)}
+    assert env["EDL_JOB_NAME"] == "demo"
+    assert env["EDL_COORDINATOR_ADDR"] == "demo-coordinator:7164"
+    assert env["EDL_ENTRYPOINT"] == "mnist"
+    assert env["EDL_MIN_INSTANCE"] == "1"
+    assert env["EDL_MAX_INSTANCE"] == "4"
+    assert env["EDL_FAULT_TOLERANT"] == "1"
+    # rank/world deliberately absent: membership facts live in the
+    # coordinator, not env (the reference's TRAINERS env was wrong under
+    # elasticity, ref pkg/jobparser.go:281-285)
+    assert "EDL_RANK" not in env and "EDL_WORLD" not in env
+
+
+def test_parse_to_coordinator_is_deployment_plus_service():
+    job = make_job()
+    dep, svc = parse_to_coordinator(job)
+    assert dep["kind"] == "Deployment" and dep["spec"]["replicas"] == 1
+    assert svc["kind"] == "Service"
+    assert svc["spec"]["ports"][0]["port"] == 7164
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "edl_tpu.runtime.coord_service" in cmd
+
+
+def test_cpu_job_has_no_tpu_selector():
+    job = make_job(topo="cpu", mn=1, mx=2)
+    m = parse_to_trainer(job)
+    tmpl = m["spec"]["template"]["spec"]
+    assert tmpl["nodeSelector"] == {}
+    assert "google.com/tpu" not in m["spec"]["template"]["spec"]["containers"][0][
+        "resources"
+    ]["limits"]
+
+
+# ---- lifecycle --------------------------------------------------------------
+
+
+def test_lifecycle_ensure_creates_both_objects():
+    kube = FakeKube(tpu_nodes())
+    lc = JobLifecycle(Cluster(kube), sleep=lambda s: None)
+    job = make_job()
+    assert lc.ensure(job)
+    assert kube.get_workload("demo-trainer") is not None
+    assert kube.get_workload("demo-coordinator") is not None
+    # idempotent
+    assert lc.ensure(job)
+
+
+def test_lifecycle_rollback_on_partial_failure():
+    kube = FakeKube(tpu_nodes())
+    cluster = Cluster(kube)
+    lc = JobLifecycle(cluster, sleep=lambda s: None)
+    job = make_job()
+
+    real_create = kube.create_workload
+    calls = {"n": 0}
+
+    def failing_create(w):
+        calls["n"] += 1
+        if w.name.endswith("-trainer"):
+            raise RuntimeError("boom")
+        return real_create(w)
+
+    kube.create_workload = failing_create
+    assert not lc.ensure(job)
+    # the coordinator created in the same attempt was rolled back
+    assert kube.get_workload("demo-coordinator") is None
+
+
+def test_lifecycle_complete_keeps_trainer():
+    kube = FakeKube(tpu_nodes())
+    lc = JobLifecycle(Cluster(kube), sleep=lambda s: None)
+    job = make_job()
+    lc.ensure(job)
+    lc.complete(job)
+    assert kube.get_workload("demo-coordinator") is None
+    assert kube.get_workload("demo-trainer") is not None
+    lc.destroy(job)
+    assert kube.get_workload("demo-trainer") is None
+
+
+# ---- controller -------------------------------------------------------------
+
+
+def test_controller_wires_creation_and_scaling():
+    kube = FakeKube(tpu_nodes(4))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    job = make_job(mn=1, mx=4)
+    ctrl.on_add(job)
+    assert kube.get_workload("demo-trainer") is not None  # wired (ref TODO fixed)
+    for _ in range(5):
+        ctrl.run_once()
+    assert cluster.get_trainer_workload(job).parallelism == 4
+    st = ctrl.job_statuses()[0]
+    assert st["state"] in ("Running", "Scaling")
+    assert st["parallelism"] == 4
+
+
+def test_controller_status_state_machine():
+    clock = {"t": 100.0}
+    kube = FakeKube(tpu_nodes(1))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: clock["t"])
+    job = make_job(mn=1, mx=1)
+    ctrl.on_add(job)
+    assert job.status.state == JobState.CREATED
+    clock["t"] = 107.5
+    ctrl.reconcile_status()
+    assert job.status.state == JobState.RUNNING
+    assert job.status.started_at == 107.5
+    assert job.status.pending_seconds() == 7.5
+    ctrl.mark_succeeded("demo")
+    assert job.status.state == JobState.SUCCEED
+    assert kube.get_workload("demo-coordinator") is None  # complete() ran
+    # the finished job left the autoscaler's managed set
+    ctrl.autoscaler._drain_events()
+    assert "demo" not in ctrl.autoscaler.jobs
+
+
+def test_controller_delete_tears_down():
+    kube = FakeKube(tpu_nodes())
+    ctrl = Controller(Cluster(kube))
+    job = make_job()
+    ctrl.on_add(job)
+    ctrl.on_delete(job)
+    assert kube.get_workload("demo-trainer") is None
+    assert kube.get_workload("demo-coordinator") is None
+    assert ctrl.jobs == {}
+
+
+def test_controller_failed_creation_marks_failed():
+    kube = FakeKube(tpu_nodes())
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, lifecycle=_AlwaysFailLifecycle(cluster))
+    job = make_job()
+    ctrl.on_add(job)
+    assert job.status.state == JobState.FAILED
+
+
+class _AlwaysFailLifecycle(JobLifecycle):
+    def ensure(self, job):
+        return False
+
+
+# ---- coordinator service round trip ----------------------------------------
+
+
+def test_coord_service_http_roundtrip():
+    from edl_tpu.runtime.coord_service import CoordinatorServer, HTTPCoordinator
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    server = CoordinatorServer(
+        LocalCoordinator(target_world=2, max_world=4), host="127.0.0.1", port=0
+    ).start()
+    try:
+        c = HTTPCoordinator(f"127.0.0.1:{server.port}")
+        p1 = c.register("a")
+        assert p1.world_size == 1 and p1.members == ("a",)
+        c.register("b")
+        c.heartbeat("a")
+        plan = c.plan()
+        assert plan.world_size == 2
+        c.ack_generation("a", plan.generation)
+        c.set_target_world(1)
+        assert c.plan().world_size == 1
+        c.report_checkpoint(40)
+        assert c.plan().restore_step == -1  # restore_step fixed at plan build
+        c.deregister("b")
+        assert c.members() == ["a"]
+        assert c.evict_dead() == []
+        with pytest.raises(Exception):
+            c.heartbeat("ghost")
+    finally:
+        server.stop()
+
+
+def test_coord_service_rejects_bad_target():
+    from edl_tpu.runtime.coord_service import CoordinatorServer, HTTPCoordinator
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    server = CoordinatorServer(
+        LocalCoordinator(target_world=1), host="127.0.0.1", port=0
+    ).start()
+    try:
+        c = HTTPCoordinator(f"127.0.0.1:{server.port}")
+        with pytest.raises(Exception):
+            c.set_target_world(0)
+    finally:
+        server.stop()

@@ -42,21 +42,22 @@ def _dump_yaml(objs) -> str:
     return "---\n".join(yaml.safe_dump(o, sort_keys=False) for o in objs)
 
 
+def _kubectl(argv: List[str], input: Optional[str] = None) -> int:
+    p = subprocess.run(
+        ["kubectl", *argv], input=input, text=True, capture_output=True
+    )
+    sys.stdout.write(p.stdout)
+    sys.stderr.write(p.stderr)
+    return p.returncode
+
+
 def cmd_submit(args) -> int:
     job = _load_job(args.spec)
     manifest = job.to_manifest()
     if args.dry_run:
         print(_dump_yaml(manifest))
         return 0
-    p = subprocess.run(
-        ["kubectl", "apply", "-f", "-"],
-        input=_dump_yaml(manifest),
-        text=True,
-        capture_output=True,
-    )
-    sys.stdout.write(p.stdout)
-    sys.stderr.write(p.stderr)
-    return p.returncode
+    return _kubectl(["apply", "-f", "-"], input=_dump_yaml(manifest))
 
 
 def cmd_manifests(args) -> int:
@@ -74,23 +75,11 @@ def cmd_crd(args) -> int:
 
 
 def cmd_list(args) -> int:
-    p = subprocess.run(
-        ["kubectl", "get", "trainingjobs", "-A"], capture_output=True, text=True
-    )
-    sys.stdout.write(p.stdout)
-    sys.stderr.write(p.stderr)
-    return p.returncode
+    return _kubectl(["get", "trainingjobs", "-A"])
 
 
 def cmd_kill(args) -> int:
-    p = subprocess.run(
-        ["kubectl", "delete", "trainingjob", args.name],
-        capture_output=True,
-        text=True,
-    )
-    sys.stdout.write(p.stdout)
-    sys.stderr.write(p.stderr)
-    return p.returncode
+    return _kubectl(["delete", "trainingjob", args.name])
 
 
 def _parse_resizes(specs: List[str]):

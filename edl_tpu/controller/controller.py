@@ -77,6 +77,7 @@ class Controller:
     # -- status reconciliation (what the reference never did) ----------------
     def reconcile_status(self) -> None:
         """Refresh every job's status from observed cluster state."""
+        pods_by_job = self.cluster.job_pods_map()  # one pod list per tick
         for job in list(self.jobs.values()):
             if job.status.state in (JobState.SUCCEED, JobState.FAILED):
                 continue
@@ -85,7 +86,7 @@ class Controller:
                 job.status.state = JobState.FAILED
                 job.status.message = "trainer workload disappeared"
                 continue
-            total, running, pending = self.cluster.job_pods(job)
+            total, running, pending = pods_by_job.get(job.name, (0, 0, 0))
             job.status.parallelism = w.parallelism
             job.status.running = running
             job.status.pending = pending

@@ -181,6 +181,15 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
                                 str(job.spec.trainer.min_instance),
                                 "--max-world",
                                 str(job.spec.trainer.max_instance),
+                                # batch-divisibility quantization: without
+                                # this a transient membership count (e.g. 5
+                                # of 8 pods up) would form an illegal world
+                                "--legal-sizes",
+                                ",".join(str(w) for w in job.legal_world_sizes()),
+                                # generous lease: a resize window (flush
+                                # + compile) must not outlive it
+                                "--heartbeat-timeout",
+                                "30",
                             ],
                             "env": [
                                 {"name": "EDL_JOB_NAME", "value": job.name},

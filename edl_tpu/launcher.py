@@ -62,7 +62,7 @@ def run(
     steps: Optional[int] = None,
     coordinator_addr: str = "",
     global_batch_size: int = 0,
-    checkpoint_interval: int = 100,
+    checkpoint_interval: Optional[int] = None,
     seed: int = 0,
     dataset_examples: int = 4096,
 ) -> "ElasticTrainer":
@@ -90,26 +90,40 @@ def run(
 
     trainer_id = cfg["pod_name"] or f"trainer-{uuid.uuid4().hex[:8]}"
     addr = coordinator_addr or cfg["coordinator_addr"]
+    heartbeat_ids = [trainer_id]
     if addr:
         coordinator = HTTPCoordinator(addr)
         coordinator.register(trainer_id)
     else:
         # Local mode: in-process coordinator, one membership per device.
+        max_w = max(cfg["max_instance"], n_dev)
+        legal = None
+        if gbs:
+            # same quantization the deployed coordinator gets via
+            # --legal-sizes: only worlds dividing the global batch
+            legal = [w for w in range(1, max_w + 1) if gbs % w == 0]
         coordinator = LocalCoordinator(
             target_world=min(cfg["max_instance"], n_dev) or n_dev,
-            max_world=max(cfg["max_instance"], n_dev),
+            max_world=max_w,
+            legal_sizes=legal,
         )
-        for i in range(n_dev):
-            coordinator.register(f"{trainer_id}-{i}")
+        heartbeat_ids = [f"{trainer_id}-{i}" for i in range(n_dev)]
+        for tid in heartbeat_ids:
+            coordinator.register(tid)
 
     et = ElasticTrainer(
         model,
         optax.adam(1e-3),
         data,
         coordinator,
-        checkpoint_interval=checkpoint_interval or cfg["checkpoint_interval"],
+        checkpoint_interval=(
+            checkpoint_interval
+            if checkpoint_interval is not None
+            else cfg["checkpoint_interval"]
+        ),
         seed=seed,
     )
+    et.heartbeat_ids = heartbeat_ids
     if steps is None:
         steps = cfg["num_passes"] * data.batches_per_epoch
     et.run(steps)

@@ -9,5 +9,8 @@ from edl_tpu.models.base import ModelDef, get_model, register_model, registered_
 # Built-ins register on import.
 import edl_tpu.models.fit_a_line  # noqa: F401
 import edl_tpu.models.mnist  # noqa: F401
+import edl_tpu.models.resnet  # noqa: F401
+import edl_tpu.models.transformer  # noqa: F401
+import edl_tpu.models.transformer_lm  # noqa: F401
 
 __all__ = ["ModelDef", "get_model", "register_model", "registered_models"]

@@ -1,0 +1,132 @@
+"""ResNet-50 — benchmark config 3 (BASELINE.md): "ResNet-50 / ImageNet,
+elastic 4 -> 64 trainers, pserver -> allreduce migration".
+
+TPU-first notes:
+
+- **GroupNorm instead of BatchNorm.**  BatchNorm carries mutable
+  batch statistics that (a) break the pure params -> loss contract the
+  elastic checkpoint/restore path relies on and (b) entangle replicas
+  through cross-device stat sync under a *changing* DP width — exactly
+  the elasticity hazard SURVEY.md §7.4 warns about (batch semantics
+  must be invariant to world size).  GroupNorm is deterministic per
+  example, so resizes are bit-clean.
+- bfloat16 convs (MXU), float32 norms and final logits.
+- NHWC layout (TPU-native conv layout).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.models.base import ModelDef, register_model
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.GroupNorm, num_groups=32, dtype=jnp.float32)
+
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = norm(name="norm1")(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), self.strides, name="conv2")(y)
+        y = norm(name="norm2")(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm(name="norm3")(y)
+
+        if residual.shape != y.shape:
+            residual = conv(
+                self.features * 4, (1, 1), self.strides, name="proj"
+            )(x)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, H, W, 3] float32 NHWC
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.width, (7, 7), (2, 2), use_bias=False, dtype=self.dtype, name="stem"
+        )(x)
+        x = nn.GroupNorm(num_groups=32, dtype=jnp.float32, name="stem_norm")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.width * 2**i,
+                    strides,
+                    self.dtype,
+                    name=f"stage{i}_block{j}",
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def _make(image_size: int, num_classes: int, stage_sizes, width, name) -> ModelDef:
+    module = ResNet(
+        stage_sizes=tuple(stage_sizes), num_classes=num_classes, width=width
+    )
+    sample = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+
+    def init_params(rng: jax.Array):
+        return module.init(rng, sample)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        logits = module.apply({"params": params}, batch["image"])
+        labels = jax.nn.one_hot(batch["label"], num_classes)
+        loss = jnp.mean(-jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, {"loss": loss, "accuracy": acc}
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        """Class-dependent spatial stripes (a brightness-only signal
+        would be erased by normalization; spatial structure survives)."""
+        label = rng.randint(0, num_classes, size=(n,))
+        img = 0.5 * rng.randn(n, image_size, image_size, 3).astype(np.float32)
+        band = max(2, image_size // num_classes)
+        for c in range(num_classes):
+            idx = label == c
+            if idx.any():
+                row = (c * image_size) // num_classes
+                img[idx, row : row + band, :, :] += 2.0
+        return {"image": img, "label": label.astype(np.int32)}
+
+    # ResNet-50 @224: ~4.1 GFLOPs fwd; scale by (size/224)^2, x3 for bwd
+    flops = int(3 * 4.1e9 * (image_size / 224) ** 2)
+    return ModelDef(
+        name=name,
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        flops_per_example=flops,
+    )
+
+
+@register_model("resnet50")
+def resnet50(tiny: bool = False) -> ModelDef:
+    """ResNet-50.  ``tiny=True`` gives a 2-2-2 stage, 32x32, 10-class
+    variant for tests (same code path)."""
+    if tiny:
+        return _make(32, 10, (1, 1, 1), 32, "resnet50")
+    return _make(224, 1000, (3, 4, 6, 3), 64, "resnet50")

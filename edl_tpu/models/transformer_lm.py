@@ -1,0 +1,193 @@
+"""Decoder-only transformer LM — the long-context workload.
+
+Where ``transformer.py`` is the WMT encoder-decoder benchmark config,
+this family is the sequence-parallel path: causal self-attention runs
+as **ring attention** over the mesh's ``sp`` axis
+(``edl_tpu.ops.ring_attention``), so sequences shard across devices and
+context length scales with the ring size instead of one device's HBM.
+
+Build with ``get_model("transformer_lm", sp_mesh=mesh)`` to enable the
+ring (the model needs the mesh because ring attention is a
+``shard_map`` over it); without a mesh it runs fused single-device
+attention — same math, so tests can diff the two.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.ops import fused_attention, ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    num_heads: int
+    d_model: int
+    sp_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        head_dim = self.d_model // self.num_heads
+        qkv = nn.DenseGeneral(
+            features=(3, self.num_heads, head_dim),
+            axis=-1,
+            dtype=self.dtype,
+            name="qkv",
+        )(x)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,D]
+        if self.sp_mesh is not None:
+            out = ring_attention(q, k, v, self.sp_mesh, axis="sp", causal=True)
+        else:
+            out = fused_attention(q, k, v, causal=True)  # flash kernel on TPU
+        return nn.DenseGeneral(
+            features=self.d_model,
+            axis=(-2, -1),
+            dtype=self.dtype,
+            name="out",
+        )(out.astype(self.dtype))
+
+
+class LMBlock(nn.Module):
+    num_heads: int
+    d_model: int
+    d_ff: int
+    sp_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.d_model, self.sp_mesh, self.dtype, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype, name="wi")(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(self.d_model, dtype=self.dtype, name="wo")(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    d_model: int
+    d_ff: int
+    num_heads: int
+    num_layers: int
+    max_len: int
+    sp_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):  # [B, T] int32
+        T = tokens.shape[1]
+        embed = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            embedding_init=nn.initializers.normal(1.0),
+            name="embed",
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
+        for i in range(self.num_layers):
+            x = LMBlock(
+                self.num_heads,
+                self.d_model,
+                self.d_ff,
+                self.sp_mesh,
+                self.dtype,
+                name=f"layer_{i}",
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return embed.attend(x.astype(jnp.float32))
+
+
+def _partition_rules(params) -> Any:
+    def spec_for(path: str, x) -> P:
+        if x.ndim <= 1 or "pos_embed" in path:
+            return P()
+        if "embedding" in path:
+            return P("tp", "fsdp")
+        if "qkv/kernel" in path:  # [d_model, 3, H, D]
+            return P("fsdp", None, "tp", None)
+        if "out/kernel" in path:  # [H, D, d_model]
+            return P("tp", None, "fsdp")
+        if "wi/kernel" in path:
+            return P("fsdp", "tp")
+        if "wo/kernel" in path:
+            return P("tp", "fsdp")
+        if x.ndim == 2:
+            return P("fsdp", None)
+        return P()
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = [
+        spec_for("/".join(str(getattr(k, "key", k)) for k in path), leaf)
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@register_model("transformer_lm")
+def transformer_lm(
+    tiny: bool = False,
+    seq_len: Optional[int] = None,
+    sp_mesh: Optional[Mesh] = None,
+) -> ModelDef:
+    if tiny:
+        vocab, d_model, d_ff, heads, layers = 256, 64, 256, 4, 2
+        L = seq_len or 64
+    else:
+        vocab, d_model, d_ff, heads, layers = 32000, 768, 3072, 12, 12
+        L = seq_len or 2048
+    module = TransformerLM(
+        vocab_size=vocab,
+        d_model=d_model,
+        d_ff=d_ff,
+        num_heads=heads,
+        num_layers=layers,
+        max_len=L,
+        sp_mesh=sp_mesh,
+    )
+    sample = jnp.zeros((1, L), jnp.int32)
+
+    def init_params(rng: jax.Array):
+        return module.init(rng, sample)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        tokens = batch["tokens"]
+        logits = module.apply({"params": params}, tokens[:, :-1])
+        labels = tokens[:, 1:]
+        mask = (labels != 0).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, {"loss": loss}
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        """Periodic token stream (period 7) — learnable with context."""
+        start = rng.randint(3, vocab - 8, size=(n, 1))
+        t = np.arange(L + 1)[None, :]
+        tokens = 3 + ((start - 3) + t) % (vocab - 3)
+        return {"tokens": tokens.astype(np.int32)}
+
+    params_per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
+    flops = 6 * (layers * params_per_layer + vocab * d_model) * L
+    return ModelDef(
+        name="transformer_lm",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        param_partition=_partition_rules,
+        flops_per_example=flops,
+    )

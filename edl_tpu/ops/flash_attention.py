@@ -1,0 +1,183 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The single-device attention hot path: blockwise online-softmax so the
+[T, T] score matrix never materializes in HBM — scores live in VMEM one
+(block_q x block_k) tile at a time, matmuls hit the MXU in f32
+accumulation, and causal runs skip fully-masked K blocks entirely.
+
+Layering: ``ring_attention`` (sequence parallel, ``ops/ring_attention``)
+distributes the sequence *across chips*; this kernel optimizes the
+*within-chip* block loop.  They compose: the ring's per-step local
+attention is exactly this computation.
+
+Backward: ``jax.custom_vjp`` with a recompute backward (standard
+flash-attention practice — residuals are O(T) stats, not O(T^2)
+scores); the backward math is expressed in plain jnp and fuses under
+XLA.  On non-TPU backends the kernel runs in Pallas interpret mode, so
+tests validate the identical code path on the CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _pick_block(t: int, want: int) -> int:
+    """Largest divisor of ``t`` that is <= want (prefers want itself)."""
+    b = min(want, t)
+    while t % b != 0:
+        b -= 1
+    return b
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
+    qb = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    block_q = qb.shape[0]
+    i = pl.program_id(1)
+    num_k = seq_len // block_k
+
+    q_pos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, 1), 0
+    )  # [bq, 1]
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb,
+            kb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [bq,1]
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)  # [bq,1]
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p,
+            vb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        return acc, m_new, l
+
+    if causal:
+        # K blocks whose start exceeds this Q block's last position are
+        # fully masked: skip them (the flash speedup for causal).
+        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
+    else:
+        upper = num_k
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_fwd_3d(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q, k, v: [BH, T, D] -> [BH, T, D]."""
+    bh, t, d = q.shape
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_k=block_k,
+        seq_len=t,
+    )
+    grid = (bh, t // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _run(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _run(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash_fwd_3d(
+        to3(q), to3(k), to3(v), causal, scale, block_q, block_k, interpret
+    )
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_ref(q, k, v, causal, scale):
+    """Recompute oracle for the backward pass (plain jnp; XLA fuses)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = _run(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _flash_ref(q, k, v, causal, scale), q, k, v)
+    return vjp(g.astype(jnp.float32) if g.dtype != q.dtype else g)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over [B, T, H, D] tensors.
+
+    ``interpret=None`` auto-selects: real kernel on TPU, Pallas
+    interpreter elsewhere (tests on the CPU mesh take this path)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    t = q.shape[1]
+    block_q = _pick_block(t, block_q)
+    block_k = _pick_block(t, block_k)
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)

@@ -1,0 +1,170 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context path: a sequence too long for one device's HBM is sharded
+over the mesh's ``sp`` axis.  Each device keeps its Q shard resident
+and the K/V shards *rotate* around the ring via ``lax.ppermute`` (one
+ICI hop per step — neighbor exchanges, the cheapest collective there
+is), while a blockwise online-softmax accumulates exact results
+(numerically identical to full attention up to float reassociation).
+
+This is the standard public recipe (Ring Attention / blockwise
+parallel attention; see PAPERS.md) implemented jax-natively with
+``shard_map`` — communication overlaps compute because each step's
+matmuls and the next block's ppermute are independent in XLA's
+schedule.
+
+The reference system has nothing like this (SURVEY.md §5.7: 2018-era,
+pre-dates sequence parallelism entirely); it is required for the
+long-context capability bar of the TPU rebuild.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """Scores + masked softmax stats for one (Q block, K/V block) pair.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    Returns (o_unnorm [B,Tq,H,D], m [B,H,Tq], l [B,H,Tq]) — f32 stats.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Tq]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - m_safe[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o.astype(jnp.float32), m_safe, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Merge two online-softmax partials (flash-attention combine)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[..., None]
+    return o, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with sequence sharded over ``mesh`` axis ``axis``.
+
+    q, k, v: [B, T, H, D] with T sharded over ``axis`` (global arrays).
+    Returns [B, T, H, D], same sharding.  ``causal`` applies a global
+    causal mask (each device resolves its shard's absolute positions
+    from its ring rank).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if axis not in mesh.axis_names:
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    t_local = q.shape[1] // n
+
+    # Batch stays sharded over the data axes present; sequence over the
+    # ring axis.  Heads/head_dim replicated (tp composes by sharding H
+    # outside this op).  Axes that don't divide the (static) batch size
+    # are dropped — e.g. module.init traces with batch 1.
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    data_axes: list = []
+    prod = 1
+    for a in ("dp", "fsdp"):
+        if a in sizes and q.shape[0] % (prod * sizes[a]) == 0:
+            data_axes.append(a)
+            prod *= sizes[a]
+    bspec = (
+        tuple(data_axes)
+        if len(data_axes) > 1
+        else (data_axes[0] if data_axes else None)
+    )
+    spec = P(bspec, axis, None, None)
+
+    def local_fn(q_blk, k_blk, v_blk):
+        rank = lax.axis_index(axis)
+        q_pos = rank * t_local + jnp.arange(t_local)  # absolute Q positions
+
+        def mask_for(src_rank):
+            if not causal:
+                return None
+            k_pos = src_rank * t_local + jnp.arange(t_local)
+            return q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+
+        # step 0: attend to the locally-resident K/V block
+        o, m, l = _block_attn(q_blk, k_blk, v_blk, scale, mask_for(rank))
+
+        if n > 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            def body(t, carry):
+                o, m, l, k_cur, v_cur = carry
+                k_cur = lax.ppermute(k_cur, axis, perm)
+                v_cur = lax.ppermute(v_cur, axis, perm)
+                # after t+1 hops, this device holds the block that
+                # originated at ring rank (rank - t - 1) mod n
+                src = (rank - t - 1) % n
+                if causal:
+                    k_pos = src * t_local + jnp.arange(t_local)
+                    blk_mask = q_pos[:, None] >= k_pos[None, :]
+                else:
+                    blk_mask = None
+                o2, m2, l2 = _block_attn(q_blk, k_cur, v_cur, scale, blk_mask)
+                o, m, l = _merge(o, m, l, o2, m2, l2)
+                return (o, m, l, k_cur, v_cur)
+
+            o, m, l, _, _ = lax.fori_loop(
+                0, n - 1, body, (o, m, l, k_blk, v_blk)
+            )
+
+        denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+        return (o / denom).astype(q_blk.dtype)
+
+    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        fn = shard_map(local_fn, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        fn = shard_map(local_fn, check_rep=False, **kwargs)
+    return fn(q, k, v)
+
+
+def reference_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device exact attention (the correctness oracle)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)

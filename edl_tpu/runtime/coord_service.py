@@ -164,7 +164,15 @@ class HTTPCoordinator:
         self._post("/deregister", trainer_id=trainer_id)
 
     def heartbeat(self, trainer_id: str):
-        self._post("/heartbeat", trainer_id=trainer_id)
+        import urllib.error
+
+        try:
+            self._post("/heartbeat", trainer_id=trainer_id)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                # same contract as LocalCoordinator.heartbeat
+                raise KeyError(trainer_id) from None
+            raise
 
     def ack_generation(self, trainer_id: str, generation: int):
         self._post("/ack", trainer_id=trainer_id, generation=generation)
@@ -208,6 +216,19 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
         legal_sizes=legal,
     )
     server = CoordinatorServer(coord, host=args.host, port=args.port)
+
+    # Eviction timer: failure detection is live only if someone drives
+    # evict_dead (trainers heartbeat; this reaps the ones that stop).
+    def evict_loop():
+        import time as _time
+
+        while True:
+            _time.sleep(args.heartbeat_timeout / 2)
+            dead = coord.evict_dead()
+            if dead:
+                print(f"evicted dead trainers: {dead}")
+
+    threading.Thread(target=evict_loop, daemon=True, name="edl-evict").start()
     print(f"edl-tpu coordinator listening on {args.host}:{server.port}")
     server._server.serve_forever()
 

@@ -47,6 +47,10 @@ class ElasticPlan:
     members: tuple
     #: step to restore from when joining this generation (-1: fresh init)
     restore_step: int = -1
+    #: member addresses in rank order (host:port of each trainer pod) —
+    #: rank 0's address seeds ``jax.distributed.initialize`` when the
+    #: world spans processes (the launcher's world_builder)
+    addresses: tuple = ()
 
 
 @dataclass
@@ -55,6 +59,7 @@ class _Member:
     last_heartbeat: float
     joined_generation: int
     acked_generation: int = -1
+    address: str = ""
 
 
 class LocalCoordinator:
@@ -91,14 +96,17 @@ class LocalCoordinator:
         self._resize_log: List[dict] = []
 
     # -- membership (trainer-facing) ----------------------------------------
-    def register(self, trainer_id: str) -> ElasticPlan:
-        """Join the job.  Bumps the generation; returns the new plan."""
+    def register(self, trainer_id: str, address: str = "") -> ElasticPlan:
+        """Join the job.  Bumps the generation; returns the new plan.
+        ``address`` is the member's reachable host:port (used to seed
+        the JAX process group when the world spans pods)."""
         with self._lock:
             now = self._clock()
             self._members[trainer_id] = _Member(
                 trainer_id=trainer_id,
                 last_heartbeat=now,
                 joined_generation=self._generation + 1,
+                address=address,
             )
             self._rebuild_plan("join")
             return self._plan
@@ -223,6 +231,7 @@ class LocalCoordinator:
             world_size=len(active),
             members=active,
             restore_step=self._latest_checkpoint_step,
+            addresses=tuple(self._members[t].address for t in active),
         )
         self._resize_log.append(
             {

@@ -84,7 +84,18 @@ class ElasticTrainer:
         devices_per_trainer: int = 1,
         checkpoint_interval: int = 50,
         seed: int = 0,
+        world_builder: Optional[Callable[[Any], Sequence[jax.Device]]] = None,
     ):
+        """``world_builder``: optional hook invoked with each new
+        ElasticPlan to (re)build the *process group* and return the
+        global device list for the new generation.  Single-process runs
+        leave it None (devices never change).  The deployed multi-pod
+        launcher passes one that tears down and re-initializes
+        ``jax.distributed`` from the plan's rank-ordered addresses —
+        cross-pod gradient sync requires all member processes in one
+        JAX world (XLA collectives cannot span separate worlds).  When
+        set, the compiled-trainer cache is invalidated on every
+        generation (device objects change identity across re-inits)."""
         self.model = model
         self.optimizer = optimizer
         self.data = data
@@ -104,6 +115,15 @@ class ElasticTrainer:
         #: how long run() waits for a formable world before giving up
         self.barrier_timeout: float = 300.0
         self.barrier_poll_interval: float = 0.05
+        #: member ids this process keeps alive at the coordinator (the
+        #: launcher sets its own pod id; local mode sets all simulated
+        #: members).  Heartbeats are what make eviction-based failure
+        #: detection live (SURVEY.md §5.3).
+        self.heartbeat_ids: List[str] = []
+        self.heartbeat_interval: float = 2.0
+        self._last_heartbeat = 0.0
+        self._hb_thread = None
+        self._hb_stop = None
 
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
@@ -153,7 +173,15 @@ class ElasticTrainer:
             self.state = trainer.init_state()
             restored_step = 0
         else:
-            self.state = self.store.restore(ckpt, trainer.mesh)
+            # Model-sharded states restore onto this mesh's actual
+            # layout (the re-sharding moment of SURVEY.md §7.4);
+            # pure-DP states replicate.
+            shardings = (
+                trainer.state_shardings()
+                if self.model.param_partition is not None
+                else None
+            )
+            self.state = self.store.restore(ckpt, trainer.mesh, shardings)
             restored_step = int(ckpt.step)
         replayed = max(0, self._last_completed_step - restored_step)
 
@@ -172,7 +200,57 @@ class ElasticTrainer:
         for tid in plan.members:
             self.coordinator.ack_generation(tid, plan.generation)
 
+    def _beat_once(self):
+        for tid in list(self.heartbeat_ids):
+            try:
+                self.coordinator.heartbeat(tid)
+            except KeyError:
+                # Evicted while actually alive (e.g. a long compile or
+                # GC pause outlived the lease): rejoin so the capacity
+                # isn't silently lost — the generation bump puts us
+                # through the normal resize barrier.
+                try:
+                    self.coordinator.register(tid)
+                except Exception:
+                    pass  # coordinator unreachable; retry next beat
+
+    def _heartbeat(self):
+        """Keep this process's members alive at the coordinator,
+        throttled to ``heartbeat_interval``.  A background thread does
+        the same so long resize windows (checkpoint flush + compile)
+        can't cause self-eviction."""
+        if not self.heartbeat_ids:
+            return
+        self._ensure_heartbeat_thread()
+        now = time.monotonic()
+        if now - self._last_heartbeat < self.heartbeat_interval:
+            return
+        self._last_heartbeat = now
+        self._beat_once()
+
+    def _ensure_heartbeat_thread(self):
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        import threading
+
+        self._hb_stop = threading.Event()
+
+        def loop():
+            while not self._hb_stop.wait(max(self.heartbeat_interval, 0.05)):
+                if self.heartbeat_ids:
+                    self._beat_once()
+
+        self._hb_thread = threading.Thread(
+            target=loop, daemon=True, name="edl-heartbeat"
+        )
+        self._hb_thread.start()
+
+    def stop_heartbeat(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+
     def maybe_resize(self) -> bool:
+        self._heartbeat()
         plan = self.coordinator.plan()
         if plan is None or plan.world_size < 1:
             # No formable world (e.g. legal_sizes can't fit the surviving

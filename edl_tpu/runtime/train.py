@@ -65,9 +65,44 @@ class Trainer:
         # Parameter shardings: model partition rules if provided, else
         # fully replicated (pure DP).
         self._param_spec_fn = model.param_partition
+        self._state_shardings = None  # cached after init_state()
+
+        axis_names = set(mesh.axis_names)
+
+        def filter_spec(spec: P) -> P:
+            """Drop references to axes this mesh doesn't have, so one
+            rule set serves every mesh (a pure-DP mesh simply ignores
+            tp/fsdp placements)."""
+
+            def keep(entry):
+                if entry is None:
+                    return None
+                if isinstance(entry, (tuple, list)):
+                    kept = tuple(a for a in entry if a in axis_names)
+                    return kept if kept else None
+                return entry if entry in axis_names else None
+
+            return P(*(keep(e) for e in spec))
+
+        def constrain(params):
+            """Pin params to the model's partition rules on this mesh;
+            XLA's sharding propagation then lays out grads/opt-state to
+            match (GSPMD does the work the reference's pserver sharding
+            did by hand)."""
+            if self._param_spec_fn is None:
+                return params
+            specs = self._param_spec_fn(params)
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, filter_spec(s)),
+                specs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.lax.with_sharding_constraint(params, shardings)
+
+        self._constrain = constrain
 
         def init_fn(rng):
-            params = model.init_params(rng)
+            params = constrain(model.init_params(rng))
             opt_state = optimizer.init(params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
@@ -86,7 +121,7 @@ class Trainer:
                 state.params
             )
             updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+            new_params = constrain(optax.apply_updates(state.params, updates))
             new_state = TrainState(
                 step=state.step + 1, params=new_params, opt_state=new_opt
             )
@@ -104,21 +139,41 @@ class Trainer:
         )
 
     # -- shardings ----------------------------------------------------------
-    def state_sharding(self, state_shape=None) -> Any:
-        """NamedSharding pytree for TrainState on this mesh."""
+    def state_shardings(self) -> Any:
+        """Per-leaf sharding pytree for TrainState on this mesh.
+
+        Replicated for pure-DP models; for models with partition rules
+        the layout is whatever GSPMD propagated from the param
+        constraints — derived here by *compiling* init (no execution,
+        no throwaway allocation: this runs inside the resize window)."""
         if self._param_spec_fn is None:
             return NamedSharding(self.mesh, P())
-        raise NotImplementedError(
-            "model-sharded states resolve per-leaf specs; see parallel.sharded"
-        )
+        if self._state_shardings is None:
+            with self.mesh:
+                compiled = (
+                    jax.jit(self._init_fn)
+                    .lower(jax.random.key(self.seed))
+                    .compile()
+                )
+            self._state_shardings = compiled.output_shardings
+        return self._state_shardings
 
     def init_state(self) -> TrainState:
-        """Initialize state directly on the mesh, params replicated."""
+        """Initialize state directly on the mesh: params laid out by the
+        model's partition rules (replicated when there are none)."""
         rng = jax.random.key(self.seed)
-        out_sharding = NamedSharding(self.mesh, P())
         with self.mesh:
-            init = jax.jit(self._init_fn, out_shardings=out_sharding)
-            return init(rng)
+            if self._param_spec_fn is None:
+                init = jax.jit(
+                    self._init_fn, out_shardings=NamedSharding(self.mesh, P())
+                )
+            else:
+                init = jax.jit(self._init_fn)  # constraints inside init_fn
+            state = init(rng)
+        self._state_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding, state
+        )
+        return state
 
     # -- stepping -----------------------------------------------------------
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:

@@ -252,3 +252,25 @@ def test_coord_service_rejects_bad_target():
             c.set_target_world(0)
     finally:
         server.stop()
+
+
+def test_coordinator_command_carries_legal_sizes():
+    """The deployed coordinator must quantize worlds exactly like the
+    local path (review finding: legal sizes were dropped)."""
+    job = TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": "q"},
+            "spec": {
+                "fault_tolerant": True,
+                "global_batch_size": 96,
+                "trainer": {"min_instance": 1, "max_instance": 8,
+                            "slice_topology": "v5e-4"},
+            },
+        }
+    ).validate()
+    dep, _ = parse_to_coordinator(job)
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    i = cmd.index("--legal-sizes")
+    assert cmd[i + 1] == "1,2,3,4,6,8"

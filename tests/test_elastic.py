@@ -245,3 +245,31 @@ def test_hold_at_barrier_until_membership_recovers():
     et.barrier_timeout = 300.0
     et.run(12)
     assert int(et.state.step) == 12
+
+
+def test_heartbeats_keep_members_alive_under_eviction():
+    """The elastic runtime heartbeats its members, so an eviction sweep
+    reaps only trainers that actually stopped (review finding: the
+    deployed path previously never heartbeat at all)."""
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=2, max_world=2, heartbeat_timeout=0.2)
+    coord.register("tr0")
+    coord.register("tr1")
+    et = ElasticTrainer(model, optax.adam(1e-2), it, coord, checkpoint_interval=5)
+    et.heartbeat_ids = ["tr0", "tr1"]
+    et.heartbeat_interval = 0.0  # every step
+    et.run(5)
+    import time as _t
+
+    _t.sleep(0.3)  # past the timeout with no steps -> stale...
+    et.run(10)  # ...but stepping heartbeats again before the sweep
+    assert coord.evict_dead() == []
+    assert sorted(coord.members()) == ["tr0", "tr1"]
+
+    # a member that is NOT heartbeated gets reaped
+    et.heartbeat_ids = ["tr0"]
+    _t.sleep(0.3)
+    et.run(12)
+    assert coord.evict_dead() == ["tr1"]

@@ -1,0 +1,109 @@
+"""Ring attention correctness vs the single-device oracle, on an
+8-device sp mesh (the long-context path, SURVEY.md §5.7 gap filled)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.ops.ring_attention import reference_attention, ring_attention
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+def qkv(rng, B=2, T=64, H=4, D=16, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(rng), 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh():
+    return build_mesh(MeshSpec.create(sp=8))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(sp_mesh, causal):
+    q, k, v = qkv(0)
+    want = reference_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, sp_mesh, axis="sp", causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_on_dp_sp_mesh():
+    """sp composes with dp: batch over dp, sequence over sp."""
+    mesh = build_mesh(MeshSpec.create(dp=2, sp=4))
+    q, k, v = qkv(1, B=4, T=32)
+    want = reference_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, axis="sp", causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_ring_gradients_match(sp_mesh):
+    q, k, v = qkv(2, T=32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, sp_mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5)
+
+
+def test_ring_trivial_axis():
+    """axis of size 1 (or absent) degrades to plain attention."""
+    mesh = build_mesh(MeshSpec.create(dp=8))
+    q, k, v = qkv(3, T=16)
+    want = reference_attention(q, k, v)
+    got = ring_attention(q, k, v, mesh, axis="sp")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+# ---- end-to-end: decoder LM with sequence parallelism ----------------------
+
+
+def test_transformer_lm_ring_matches_fused():
+    """Same params, same batch: the sp_mesh (ring) model and the fused
+    model must produce the same loss."""
+    from edl_tpu.models import get_model
+
+    mesh = build_mesh(MeshSpec.create(dp=2, sp=4))
+    m_ring = get_model("transformer_lm", tiny=True, sp_mesh=mesh)
+    m_fused = get_model("transformer_lm", tiny=True)
+    params = m_fused.init_params(jax.random.key(0))
+    batch = m_fused.synth_batch(np.random.RandomState(0), 4)
+    l_fused, _ = m_fused.loss_fn(params, batch, jax.random.key(1))
+    l_ring, _ = m_ring.loss_fn(params, batch, jax.random.key(1))
+    np.testing.assert_allclose(float(l_ring), float(l_fused), rtol=2e-3)
+
+
+def test_transformer_lm_trains_with_sequence_parallelism():
+    import optax
+
+    from edl_tpu.models import get_model
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.train import Trainer
+
+    mesh = build_mesh(MeshSpec.create(dp=2, sp=4))
+    m = get_model("transformer_lm", tiny=True, sp_mesh=mesh)
+    tr = Trainer(m, optax.adam(3e-3), mesh)
+    state = tr.init_state()
+    data = ShardedDataIterator(
+        synthetic_dataset(m.synth_batch, 128), global_batch_size=16
+    )
+    first = last = None
+    for step in range(12):
+        batch = data.device_batch(step, mesh)
+        state, metrics = tr.step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+        last = float(metrics["loss"])
+    assert np.isfinite(last)
+    assert last < first - 0.3, f"no learning under sp: {first} -> {last}"

@@ -101,11 +101,31 @@ class HostDRAMStore:
         # invalidated while the host copy is still in flight.  jnp.copy
         # dispatches asynchronously; the snapshot buffers are owned here
         # and immune to donation.
+        #
+        # Leaves spanning processes (multi-pod world) can't be fetched
+        # by device_get unless fully replicated; replicate them with an
+        # XLA allgather first.  That is a collective: every member of
+        # the world must dispatch the same saves in the same order —
+        # which holds, because interval saves fire at identical steps
+        # on every member and resize flushes run once per generation on
+        # every old-world member.
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        leaves = [
-            jnp.copy(l) if isinstance(l, jax.Array) else l for l in leaves
-        ]
+        def snapshot(l):
+            if not isinstance(l, jax.Array):
+                return l
+            if not l.is_fully_addressable:
+                if l.is_fully_replicated:
+                    return l  # device_get fetches the local replica
+                mesh = l.sharding.mesh
+                return jax.jit(
+                    lambda a: a,
+                    out_shardings=NamedSharding(mesh, PartitionSpec()),
+                )(l)
+            return jnp.copy(l)
+
+        leaves = [snapshot(l) for l in leaves]
         for leaf in leaves:
             if isinstance(leaf, jax.Array):
                 try:
@@ -164,6 +184,15 @@ class HostDRAMStore:
                 self._save_errors.clear()
                 raise RuntimeError("async checkpoint save failed") from err
 
+    def put(self, ckpt: HostCheckpoint) -> None:
+        """Adopt an externally produced checkpoint (e.g. one received by
+        broadcast when joining a multi-pod world)."""
+        with self._lock:
+            self._checkpoints[ckpt.step] = ckpt
+            extra = sorted(self._checkpoints)[: -self.keep]
+            for s in extra:
+                del self._checkpoints[s]
+
     # -- query --------------------------------------------------------------
     def latest(self) -> Optional[HostCheckpoint]:
         with self._lock:
@@ -196,14 +225,27 @@ class HostDRAMStore:
         state_host = ckpt.unflatten()
         if sharding_tree is None:
             sharding_tree = NamedSharding(mesh, P())
+
+        # A mesh spanning multiple processes has devices this process
+        # cannot address; device_put can't target those, so build each
+        # global array from the local shards only (every process holds
+        # the full host value — make_array_from_callback slices it).
+        multiproc = any(
+            d.process_index != jax.process_index() for d in mesh.devices.flat
+        )
+
+        def place(x, s):
+            if not multiproc:
+                return jax.device_put(x, s)
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, s, lambda idx: arr[idx]
+            )
+
         if isinstance(sharding_tree, (NamedSharding,)):
             single = sharding_tree
-            return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, single), state_host
-            )
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, s), state_host, sharding_tree
-        )
+            return jax.tree_util.tree_map(lambda x: place(x, single), state_host)
+        return jax.tree_util.tree_map(place, state_host, sharding_tree)
 
     # -- disk spill (durability; not on the resize fast path) ---------------
     def _spill(self, ckpt: HostCheckpoint):

@@ -95,6 +95,10 @@ def cmd_local_run(args) -> int:
     """One process, local devices: train the job's model elastically,
     applying the requested mid-run resizes — the minimum end-to-end
     slice of SURVEY.md §7.3."""
+    if getattr(args, "platform", ""):
+        from edl_tpu.launcher import force_platform
+
+        force_platform(args.platform)
     import jax
     import optax
 
@@ -223,6 +227,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("spec")
     s.add_argument("--steps", type=int, default=50)
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument(
+        "--platform",
+        default="",
+        help=(
+            "force a JAX platform (config-level: wins even where an "
+            "early jax import latched another platform from the env)"
+        ),
+    )
     s.add_argument(
         "--resize-at",
         action="append",

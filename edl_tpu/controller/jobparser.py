@@ -69,6 +69,9 @@ def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
             "name": "EDL_POD_IP",
             "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
         },
+        # Base port for per-generation jax.distributed worlds; the
+        # launcher derives EDL_POD_ADDRESS = $(EDL_POD_IP):$(this).
+        {"name": "EDL_JAX_COORD_PORT", "value": "8476"},
     ]
     return env
 

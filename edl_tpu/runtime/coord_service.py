@@ -34,6 +34,7 @@ def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
         "world_size": plan.world_size,
         "members": list(plan.members),
         "restore_step": plan.restore_step,
+        "addresses": list(plan.addresses),
     }
 
 
@@ -45,6 +46,7 @@ def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
         world_size=d["world_size"],
         members=tuple(d["members"]),
         restore_step=d.get("restore_step", -1),
+        addresses=tuple(d.get("addresses", ())),
     )
 
 
@@ -83,7 +85,9 @@ class CoordinatorServer:
                 req = json.loads(self.rfile.read(n) or b"{}")
                 try:
                     if self.path == "/register":
-                        plan = coord.register(req["trainer_id"])
+                        plan = coord.register(
+                            req["trainer_id"], address=req.get("address", "")
+                        )
                         self._reply({"plan": _plan_to_dict(plan)})
                     elif self.path == "/deregister":
                         coord.deregister(req["trainer_id"])
@@ -111,19 +115,35 @@ class CoordinatorServer:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+        self._evict_stop: Optional[threading.Event] = None
 
     @property
     def port(self) -> int:
         return self._server.server_address[1]
 
-    def start(self):
+    def start(self, evict: bool = True):
+        """``evict``: also run the heartbeat-lease reaper (failure
+        detection is live only if someone drives ``evict_dead``)."""
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True, name="edl-coord"
         )
         self._thread.start()
+        if evict:
+            self._evict_stop = threading.Event()
+            period = max(self.coordinator._heartbeat_timeout / 2, 0.5)
+
+            def evict_loop():
+                while not self._evict_stop.wait(period):
+                    self.coordinator.evict_dead()
+
+            threading.Thread(
+                target=evict_loop, daemon=True, name="edl-evict"
+            ).start()
         return self
 
     def stop(self):
+        if self._evict_stop is not None:
+            self._evict_stop.set()
         self._server.shutdown()
         self._server.server_close()
 
@@ -133,32 +153,52 @@ class HTTPCoordinator:
     types, network underneath.  Injected into ``ElasticTrainer`` by the
     launcher when ``EDL_COORDINATOR_ADDR`` is set."""
 
-    def __init__(self, address: str, timeout: float = 5.0):
+    def __init__(self, address: str, timeout: float = 5.0, retries: int = 3):
         if "://" not in address:
             address = f"http://{address}"
         self.address = address.rstrip("/")
         self.timeout = timeout
+        self.retries = retries
+
+    def _request(self, req) -> dict:
+        """All coordinator calls are idempotent (register/heartbeat/ack/
+        target re-apply cleanly), so transient network failures retry
+        with backoff instead of raising into the step loop."""
+        import time as _time
+        import urllib.error
+
+        last: Optional[Exception] = None
+        for attempt in range(self.retries):
+            try:
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError:
+                raise  # the server answered; not transient
+            except Exception as e:  # URLError, timeout, connection reset
+                last = e
+                _time.sleep(0.2 * (2**attempt))
+        raise ConnectionError(
+            f"coordinator unreachable after {self.retries} tries"
+        ) from last
 
     def _get(self, path: str) -> dict:
-        with urllib.request.urlopen(
-            f"{self.address}{path}", timeout=self.timeout
-        ) as r:
-            return json.loads(r.read())
+        return self._request(f"{self.address}{path}")
 
     def _post(self, path: str, **payload) -> dict:
-        req = urllib.request.Request(
-            f"{self.address}{path}",
-            data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
+        return self._request(
+            urllib.request.Request(
+                f"{self.address}{path}",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            body = json.loads(r.read())
-        return body
 
     # -- LocalCoordinator interface -----------------------------------------
-    def register(self, trainer_id: str) -> Optional[ElasticPlan]:
-        return _plan_from_dict(self._post("/register", trainer_id=trainer_id)["plan"])
+    def register(self, trainer_id: str, address: str = "") -> Optional[ElasticPlan]:
+        return _plan_from_dict(
+            self._post("/register", trainer_id=trainer_id, address=address)["plan"]
+        )
 
     def deregister(self, trainer_id: str):
         self._post("/deregister", trainer_id=trainer_id)
@@ -202,12 +242,17 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
     p.add_argument(
         "--legal-sizes",
-        default="",
-        help="comma-separated legal world sizes (default: every size)",
+        default=None,
+        help=(
+            "comma-separated legal world sizes; absent = every size legal, "
+            "explicitly empty = NO legal size (trainers hold at the barrier)"
+        ),
     )
     args = p.parse_args(argv)
     legal = (
-        [int(s) for s in args.legal_sizes.split(",") if s] or None
+        None
+        if args.legal_sizes is None
+        else [int(s) for s in args.legal_sizes.split(",") if s]
     )
     coord = LocalCoordinator(
         target_world=args.min_world,
@@ -216,21 +261,9 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
         legal_sizes=legal,
     )
     server = CoordinatorServer(coord, host=args.host, port=args.port)
-
-    # Eviction timer: failure detection is live only if someone drives
-    # evict_dead (trainers heartbeat; this reaps the ones that stop).
-    def evict_loop():
-        import time as _time
-
-        while True:
-            _time.sleep(args.heartbeat_timeout / 2)
-            dead = coord.evict_dead()
-            if dead:
-                print(f"evicted dead trainers: {dead}")
-
-    threading.Thread(target=evict_loop, daemon=True, name="edl-evict").start()
+    server.start(evict=True)
     print(f"edl-tpu coordinator listening on {args.host}:{server.port}")
-    server._server.serve_forever()
+    threading.Event().wait()  # serve until killed
 
 
 if __name__ == "__main__":  # pragma: no cover

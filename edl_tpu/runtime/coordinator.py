@@ -89,7 +89,11 @@ class LocalCoordinator:
         self._target_world = target_world
         self._max_world = max_world or target_world
         self._heartbeat_timeout = heartbeat_timeout
-        self._legal_sizes = sorted(set(legal_sizes)) if legal_sizes else None
+        # None = every size legal; [] = NO legal size (world_size pins to
+        # 0 and trainers hold) — distinct on purpose, see ADVICE r1.
+        self._legal_sizes = (
+            sorted(set(legal_sizes)) if legal_sizes is not None else None
+        )
         self._clock = clock
         self._latest_checkpoint_step = -1
         self._plan: Optional[ElasticPlan] = None
@@ -215,7 +219,6 @@ class LocalCoordinator:
     def _rebuild_plan(self, reason: str):
         """Recompute the plan after any membership/target change.  Caller
         holds the lock."""
-        self._generation += 1
         # Rank order: stable by join time (dict preserves insertion);
         # members beyond the target world wait in standby (they keep
         # heartbeating and join when the target grows — the analog of
@@ -226,12 +229,26 @@ class LocalCoordinator:
             fitting = [s for s in self._legal_sizes if s <= world]
             world = fitting[-1] if fitting else 0
         active = tuple(alive[:world])
+        addresses = tuple(self._members[t].address for t in active)
+        if (
+            self._plan is not None
+            and self._plan.members == active
+            and self._plan.addresses == addresses
+            and self._plan.world_size == len(active)
+        ):
+            # The change touched only standby membership (e.g. an extra
+            # pod joined beyond the target, or a standby left): the
+            # active world is identical, so don't force trainers
+            # through a needless resize barrier.
+            self._lock.notify_all()
+            return
+        self._generation += 1
         self._plan = ElasticPlan(
             generation=self._generation,
             world_size=len(active),
             members=active,
             restore_step=self._latest_checkpoint_step,
-            addresses=tuple(self._members[t].address for t in active),
+            addresses=addresses,
         )
         self._resize_log.append(
             {

@@ -38,6 +38,7 @@ import numpy as np
 import optax
 
 from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.checkpoint.hostdram import HostCheckpoint
 from edl_tpu.models.base import ModelDef
 from edl_tpu.parallel.mesh import dp_mesh
 from edl_tpu.runtime.coordinator import ElasticPlan, LocalCoordinator
@@ -95,18 +96,31 @@ class ElasticTrainer:
         cross-pod gradient sync requires all member processes in one
         JAX world (XLA collectives cannot span separate worlds).  When
         set, the compiled-trainer cache is invalidated on every
-        generation (device objects change identity across re-inits)."""
+        generation (device objects change identity across re-inits),
+        and a plan that does not include any of this process's
+        ``heartbeat_ids`` puts it in *standby*: world torn down, polling
+        until a future plan readmits it."""
         self.model = model
         self.optimizer = optimizer
         self.data = data
         self.coordinator = coordinator
         self.store = store if store is not None else HostDRAMStore()
-        self.devices = list(devices) if devices is not None else jax.devices()
+        if devices is not None:
+            self.devices = list(devices)
+        elif world_builder is not None:
+            # Multi-pod: querying devices now would initialize the
+            # backend before jax.distributed can form the world; the
+            # builder supplies devices at first resize.
+            self.devices = []
+        else:
+            self.devices = jax.devices()
         self.devices_per_trainer = devices_per_trainer
         self.checkpoint_interval = checkpoint_interval
         self.seed = seed
+        self.world_builder = world_builder
 
         self.generation = -1
+        self._standby = False
         self.mesh = None
         self.state: Optional[TrainState] = None
         self._trainers: Dict[int, Trainer] = {}  # world_size -> compiled Trainer
@@ -120,6 +134,11 @@ class ElasticTrainer:
         #: members).  Heartbeats are what make eviction-based failure
         #: detection live (SURVEY.md §5.3).
         self.heartbeat_ids: List[str] = []
+        #: this process's reachable address, re-sent when an evicted
+        #: member rejoins (a rejoin without it would poison the plan's
+        #: rank-ordered addresses for every member)
+        self.register_address: str = ""
+        self._leaving = False
         self.heartbeat_interval: float = 2.0
         self._last_heartbeat = 0.0
         self._hb_thread = None
@@ -154,38 +173,108 @@ class ElasticTrainer:
         self.state = None
 
     # -- resize barrier -----------------------------------------------------
-    def _resize(self, plan: ElasticPlan) -> None:
+    def _flush(self, generation: int) -> None:
+        """Synchronously checkpoint the live state (graceful resize:
+        no steps lost)."""
+        self.store.save_async(self.state, generation=generation)
+        self.store.wait()
+        self.coordinator.report_checkpoint(int(jax.device_get(self.state.step)))
+
+    def _can_flush_without_collectives(self) -> bool:
+        """A resize flush happens exactly when membership changed, so it
+        must not dispatch collectives: a departed old-world member would
+        never join them and the survivors would hang.  Replicated or
+        locally addressable leaves fetch without communication; anything
+        else (model-sharded multi-pod state) skips the flush and relies
+        on the last *interval* checkpoint + deterministic replay."""
+        return all(
+            (not isinstance(l, jax.Array))
+            or l.is_fully_addressable
+            or l.is_fully_replicated
+            for l in jax.tree_util.tree_leaves(self.state)
+        )
+
+    def _my_member_ids(self, plan: ElasticPlan) -> List[str]:
+        """The plan members this process is responsible for.  The
+        launcher owns exactly its pod id; local/simulated mode (no
+        heartbeat_ids) drives every member."""
+        if self.heartbeat_ids:
+            mine = [t for t in plan.members if t in self.heartbeat_ids]
+            return mine
+        return list(plan.members)
+
+    def _rebuild_world(self, plan: ElasticPlan) -> bool:
+        """Invoke the world_builder for ``plan``.  Returns False when
+        world formation failed (caller holds and retries on the next,
+        possibly fresher, plan)."""
+        self._trainers.clear()
+        self.mesh = None
+        try:
+            devs = self.world_builder(plan)
+        except Exception:
+            return False
+        if devs is None:
+            return False
+        self.devices = list(devs)
+        return True
+
+    def _enter_standby(self, plan: ElasticPlan) -> None:
+        """This process is not in ``plan``'s world: flush what we have,
+        tear down our slice of the old world, hold until readmitted."""
+        if self.state is not None and self._can_flush_without_collectives():
+            self._flush(plan.generation)
+        self.state = None
+        self._trainers.clear()
+        self.mesh = None
+        if self.world_builder is not None:
+            try:
+                self.world_builder(plan)  # teardown-only (not a member)
+            except Exception:
+                pass
+        self.generation = plan.generation
+        self._standby = True
+
+    def _resize(self, plan: ElasticPlan) -> bool:
         t0 = time.perf_counter()
-        graceful = self.state is not None
+        graceful = self.state is not None and self._can_flush_without_collectives()
 
         if graceful:
-            # Flush a fresh checkpoint so no steps are lost.
-            self.store.save_async(self.state, generation=plan.generation)
-            self.store.wait()
-            self.coordinator.report_checkpoint(int(self.state.step))
+            # Flush a fresh checkpoint so no steps are lost.  Must land
+            # before any world teardown: the state's device buffers die
+            # with the old process group.
+            self._flush(plan.generation)
+
+        if self.world_builder is not None:
+            self.state = None
+            if not self._rebuild_world(plan):
+                return False
 
         trainer = self._trainer_for(plan.world_size)
         self.mesh = trainer.mesh
 
-        ckpt = self.store.latest()
-        if ckpt is None:
-            # Fresh job: initialize on the new mesh.
-            self.state = trainer.init_state()
-            restored_step = 0
+        if jax.process_count() > 1:
+            self.state, restored_step = self._restore_multiprocess(trainer)
         else:
-            # Model-sharded states restore onto this mesh's actual
-            # layout (the re-sharding moment of SURVEY.md §7.4);
-            # pure-DP states replicate.
-            shardings = (
-                trainer.state_shardings()
-                if self.model.param_partition is not None
-                else None
-            )
-            self.state = self.store.restore(ckpt, trainer.mesh, shardings)
-            restored_step = int(ckpt.step)
+            ckpt = self.store.latest()
+            if ckpt is None:
+                # Fresh job: initialize on the new mesh.
+                self.state = trainer.init_state()
+                restored_step = 0
+            else:
+                # Model-sharded states restore onto this mesh's actual
+                # layout (the re-sharding moment of SURVEY.md §7.4);
+                # pure-DP states replicate.
+                shardings = (
+                    trainer.state_shardings()
+                    if self.model.param_partition is not None
+                    else None
+                )
+                self.state = self.store.restore(ckpt, trainer.mesh, shardings)
+                restored_step = int(ckpt.step)
         replayed = max(0, self._last_completed_step - restored_step)
 
         self.generation = plan.generation
+        self._standby = False
         seconds = time.perf_counter() - t0
         self.resize_events.append(
             ResizeEvent(
@@ -197,20 +286,80 @@ class ElasticTrainer:
                 graceful=graceful,
             )
         )
-        for tid in plan.members:
+        # Ack only the members this process owns: via the HTTP
+        # coordinator, acking on behalf of peers would release the
+        # barrier before the world actually re-formed (ADVICE r1).
+        for tid in self._my_member_ids(plan):
             self.coordinator.ack_generation(tid, plan.generation)
+        return True
+
+    def _restore_multiprocess(self, trainer: Trainer):
+        """Agree on one state across the (re-formed) process group.
+
+        Rank 0 is the oldest surviving member (plan order is join
+        order), so its checkpoint is authoritative; joiners arrive with
+        empty stores and receive the state by broadcast — the TPU-native
+        replacement for the reference joiners' pserver parameter pull.
+        Runs collectives: every member process must call this inside
+        the same generation's resize."""
+        from jax.experimental import multihost_utils
+
+        ckpt = self.store.latest()
+        source = jax.process_index() == 0
+        have = np.asarray(1 if (source and ckpt is not None) else 0, np.int32)
+        have = int(multihost_utils.broadcast_one_to_all(have))
+        if not have:
+            # Rank 0 has nothing (fresh job): deterministic same-seed
+            # init everywhere — no broadcast needed.
+            return trainer.init_state(), 0
+
+        if source:
+            leaves = list(ckpt.leaves)
+            treedef = ckpt.treedef
+        else:
+            # Joiner: build a shape/dtype-congruent template (structure
+            # comes from the model, not from any local checkpoint).
+            abstract = jax.eval_shape(
+                trainer._init_fn, jax.random.key(trainer.seed)
+            )
+            leaves_abs, treedef = jax.tree_util.tree_flatten(abstract)
+            leaves = [np.zeros(a.shape, a.dtype) for a in leaves_abs]
+
+        out = multihost_utils.broadcast_one_to_all(leaves, is_source=source)
+        host_leaves = [np.asarray(x) for x in out]
+        merged = HostCheckpoint(
+            step=0,
+            generation=self.generation,
+            leaves=host_leaves,
+            treedef=treedef,
+        )
+        merged.step = int(np.asarray(merged.unflatten().step))
+        # Adopt the broadcast checkpoint locally so this process can be
+        # the restore source after a future resize.
+        self.store.put(merged)
+        shardings = (
+            trainer.state_shardings()
+            if self.model.param_partition is not None
+            else None
+        )
+        state = self.store.restore(merged, trainer.mesh, shardings)
+        return state, merged.step
 
     def _beat_once(self):
+        if self._leaving:
+            return
         for tid in list(self.heartbeat_ids):
             try:
                 self.coordinator.heartbeat(tid)
             except KeyError:
+                if self._leaving:
+                    return  # deregistered on purpose; do not resurrect
                 # Evicted while actually alive (e.g. a long compile or
                 # GC pause outlived the lease): rejoin so the capacity
                 # isn't silently lost — the generation bump puts us
                 # through the normal resize barrier.
                 try:
-                    self.coordinator.register(tid)
+                    self.coordinator.register(tid, address=self.register_address)
                 except Exception:
                     pass  # coordinator unreachable; retry next beat
 
@@ -246,8 +395,14 @@ class ElasticTrainer:
         self._hb_thread.start()
 
     def stop_heartbeat(self):
+        """Stop beating before deregistering.  Marks the trainer as
+        leaving (an in-flight beat must not resurrect the membership)
+        and joins the thread so no beat lands after this returns."""
+        self._leaving = True
         if self._hb_stop is not None:
             self._hb_stop.set()
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            self._hb_thread.join(timeout=10)
 
     def maybe_resize(self) -> bool:
         self._heartbeat()
@@ -259,10 +414,25 @@ class ElasticTrainer:
             # member's devices.
             self._holding = plan is not None and plan.generation != self.generation
             return False
-        self._holding = False
-        if plan.generation == self.generation and self.state is not None:
+        if plan.generation == self.generation and (
+            self.state is not None or self._standby
+        ):
+            self._holding = self._standby
             return False
-        self._resize(plan)
+        if self.heartbeat_ids and not self._my_member_ids(plan):
+            # Multi-pod scale-down: this pod dropped out of the world's
+            # rank order.  Stand by (keep heartbeating) until a future
+            # plan readmits it — the analog of the reference's standby
+            # pods the kube Job controller folds back in.
+            self._enter_standby(plan)
+            self._holding = True
+            return False
+        if not self._resize(plan):
+            # World formation failed (e.g. peers raced to a newer plan):
+            # hold; the next poll retries against the fresh plan.
+            self._holding = True
+            return False
+        self._holding = False
         return True
 
     # -- the loop -----------------------------------------------------------
@@ -284,8 +454,12 @@ class ElasticTrainer:
                 # Barrier hold: the coordinator's current plan has no
                 # formable world.  Poll until membership recovers (the
                 # coordinator bumps the generation when it does).
+                # Standby is different: a healthy steady state (the pod
+                # waits to be readmitted), never a timeout.
                 now = time.monotonic()
-                if hold_started is None:
+                if self._standby:
+                    hold_started = None
+                elif hold_started is None:
                     hold_started = now
                 elif now - hold_started > self.barrier_timeout:
                     raise RuntimeError(
@@ -326,5 +500,7 @@ class ElasticTrainer:
         return self.history
 
     def _world_size(self) -> int:
-        sizes = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-        return sizes.get("dp", 1) // self.devices_per_trainer or 1
+        # Trainer count = total mesh devices / devices-per-trainer (the
+        # mesh may factor devices over dp x fsdp x ..., so no single
+        # axis carries the world size).
+        return max(1, self.mesh.devices.size // self.devices_per_trainer)

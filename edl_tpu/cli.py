@@ -42,9 +42,11 @@ def _dump_yaml(objs) -> str:
     return "---\n".join(yaml.safe_dump(o, sort_keys=False) for o in objs)
 
 
-def _kubectl(argv: List[str], input: Optional[str] = None) -> int:
+def _kubectl(
+    argv: List[str], input: Optional[str] = None, kubectl: str = "kubectl"
+) -> int:
     p = subprocess.run(
-        ["kubectl", *argv], input=input, text=True, capture_output=True
+        [kubectl, *argv], input=input, text=True, capture_output=True
     )
     sys.stdout.write(p.stdout)
     sys.stderr.write(p.stderr)
@@ -57,7 +59,11 @@ def cmd_submit(args) -> int:
     if args.dry_run:
         print(_dump_yaml(manifest))
         return 0
-    return _kubectl(["apply", "-f", "-"], input=_dump_yaml(manifest))
+    return _kubectl(
+        ["apply", "-f", "-"],
+        input=json.dumps(manifest),
+        kubectl=args.kubectl,
+    )
 
 
 def cmd_manifests(args) -> int:
@@ -75,11 +81,11 @@ def cmd_crd(args) -> int:
 
 
 def cmd_list(args) -> int:
-    return _kubectl(["get", "trainingjobs", "-A"])
+    return _kubectl(["get", "trainingjobs", "-A"], kubectl=args.kubectl)
 
 
 def cmd_kill(args) -> int:
-    return _kubectl(["delete", "trainingjob", args.name])
+    return _kubectl(["delete", "trainingjob", args.name], kubectl=args.kubectl)
 
 
 def _parse_resizes(specs: List[str]):
@@ -168,6 +174,42 @@ def cmd_local_run(args) -> int:
     return 0
 
 
+def cmd_controller(args) -> int:
+    """Run the control plane against a real cluster: watch TrainingJob
+    CRs and reconcile/autoscale forever — the reference's whole
+    deliverable (``cmd/edl/edl.go:47-50``: two goroutines, watch +
+    autoscaler loop), plus the creation wiring its TODO promised."""
+    import time
+
+    from edl_tpu.autoscaler.scaler import Autoscaler
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.kube import KubectlAPI
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.controller.watch import TrainingJobWatcher
+
+    kube = KubectlAPI(namespace=args.namespace, kubectl=args.kubectl)
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, Autoscaler(cluster, max_load_desired=args.max_load))
+    watcher = TrainingJobWatcher(kube.list_training_jobs, ctrl)
+
+    n = 0
+    while True:
+        try:
+            watcher.poll_once()
+            ctrl.run_once()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        n += 1
+        if args.iterations and n >= args.iterations:
+            break
+        time.sleep(args.interval)
+    if args.iterations:
+        print(json.dumps(ctrl.job_statuses(), indent=2))
+    return 0
+
+
 def cmd_local_sim(args) -> int:
     """Controller + autoscaler closed loop against FakeKube: shows the
     scheduling/scaling story without k8s or devices."""
@@ -207,6 +249,7 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("submit", help="validate + apply a TrainingJob")
     s.add_argument("spec")
     s.add_argument("--dry-run", action="store_true")
+    s.add_argument("--kubectl", default="kubectl", help="kubectl binary")
     s.set_defaults(fn=cmd_submit)
 
     s = sub.add_parser("manifests", help="print rendered k8s manifests")
@@ -217,10 +260,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.set_defaults(fn=cmd_crd)
 
     s = sub.add_parser("list", help="list TrainingJobs")
+    s.add_argument("--kubectl", default="kubectl", help="kubectl binary")
     s.set_defaults(fn=cmd_list)
 
     s = sub.add_parser("kill", help="delete a TrainingJob")
     s.add_argument("name")
+    s.add_argument("--kubectl", default="kubectl", help="kubectl binary")
     s.set_defaults(fn=cmd_kill)
 
     s = sub.add_parser("local-run", help="end-to-end elastic run, local devices")
@@ -242,6 +287,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="trigger a resize at a step (repeatable)",
     )
     s.set_defaults(fn=cmd_local_run)
+
+    s = sub.add_parser(
+        "controller", help="run the control-plane daemon against a cluster"
+    )
+    s.add_argument("--namespace", default="default")
+    s.add_argument("--kubectl", default="kubectl", help="kubectl binary")
+    s.add_argument(
+        "--interval", type=float, default=5.0, help="reconcile period (ref 5s tick)"
+    )
+    s.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        help="stop after N reconcile loops and print statuses (0 = forever)",
+    )
+    s.add_argument("--max-load", type=float, default=0.97)
+    s.set_defaults(fn=cmd_controller)
 
     s = sub.add_parser("local-sim", help="controller+autoscaler vs fake cluster")
     s.add_argument("spec", nargs="+")

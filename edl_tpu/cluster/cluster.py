@@ -115,17 +115,14 @@ class Cluster:
         return {k: (v[0], v[1], v[2]) for k, v in out.items()}
 
     # -- CRUD (ref :245-291) -------------------------------------------------
-    def create_trainer_workload(self, job: TrainingJob) -> WorkloadInfo:
-        t = job.spec.trainer
-        w = WorkloadInfo(
-            name=job.trainer_job_name(),
-            job_name=job.name,
-            parallelism=t.min_instance,
-            cpu_request_milli=t.resources.cpu_request_milli(),
-            memory_request_mega=t.resources.mem_request_mega(),
-            tpu_limit=job.tpu_per_trainer(),
-        )
-        return self.kube.create_workload(w)
+    def create_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:
+        """Create the trainer workload by applying the jobparser's real
+        manifest — one creation path for FakeKube and a live cluster
+        (the reference's TODO at ``pkg/controller.go:115-133``, wired)."""
+        from edl_tpu.controller.jobparser import parse_to_trainer
+
+        self.kube.apply_manifests([parse_to_trainer(job)])
+        return self.kube.get_workload(job.trainer_job_name())
 
     def delete_trainer_workload(self, job: TrainingJob) -> bool:
         return self.kube.delete_workload(job.trainer_job_name())

@@ -1,0 +1,238 @@
+"""A ``kubectl`` stand-in backed by FakeKube, for end-to-end control
+plane tests without a cluster.
+
+Each invocation loads cluster state from the JSON file named by
+``EDL_FAKE_KUBE_STATE``, performs one kubectl-shaped operation through
+the *real* ``FakeKube`` implementation (so the Job-controller +
+scheduler emulation applies), and writes the state back.  Point
+``KubectlAPI(kubectl=<shim>)`` — where the shim execs
+``python -m edl_tpu.cluster.fake_kubectl "$@"`` — at it and the entire
+KubectlAPI surface (get/apply/patch/delete, TrainingJob CRs) runs
+against deterministic in-memory semantics.
+
+Supported verb shapes (exactly what ``KubectlAPI`` and the CLI emit):
+
+- ``get nodes|pods|trainingjobs [-A] -o json``
+- ``get job <name> -o json``
+- ``apply -f -``                     (JSON List on stdin)
+- ``patch job <name> --type=merge -p <json>``
+- ``delete job|deployment|service|trainingjob <name> [--ignore-not-found]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+from edl_tpu.cluster.kube import (
+    ConflictError,
+    FakeKube,
+    NodeInfo,
+    PodInfo,
+    WorkloadInfo,
+)
+
+
+def _load() -> tuple[FakeKube, dict]:
+    path = os.environ["EDL_FAKE_KUBE_STATE"]
+    with open(path) as f:
+        raw = json.load(f)
+    kube = FakeKube([NodeInfo(**n) for n in raw.get("nodes", [])])
+    kube.workloads = {
+        w["name"]: WorkloadInfo(**w) for w in raw.get("workloads", [])
+    }
+    kube.pods = {p["name"]: PodInfo(**p) for p in raw.get("pods", [])}
+    kube.services = {s["metadata"]["name"]: s for s in raw.get("services", [])}
+    kube._pod_seq = raw.get("pod_seq", 0)
+    return kube, raw
+
+
+def _save(kube: FakeKube, raw: dict) -> None:
+    raw["nodes"] = [vars(n) for n in kube.nodes.values()]
+    raw["workloads"] = [vars(w) for w in kube.workloads.values()]
+    raw["pods"] = [vars(p) for p in kube.pods.values()]
+    raw["services"] = list(kube.services.values())
+    raw["pod_seq"] = kube._pod_seq
+    path = os.environ["EDL_FAKE_KUBE_STATE"]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(raw, f)
+    os.replace(tmp, path)
+
+
+def _node_manifest(n: NodeInfo) -> dict:
+    labels = {}
+    if n.tpu_topology:
+        labels["cloud.google.com/gke-tpu-topology"] = n.tpu_topology
+    return {
+        "metadata": {"name": n.name, "labels": labels},
+        "status": {
+            "allocatable": {
+                "cpu": f"{n.cpu_milli}m",
+                "memory": f"{n.memory_mega}Mi",
+                "google.com/tpu": str(n.tpu_chips),
+            }
+        },
+    }
+
+
+def _pod_manifest(p: PodInfo) -> dict:
+    meta = {"name": p.name, "labels": {"edl-job": p.job_name} if p.job_name else {}}
+    if p.deleting:
+        meta["deletionTimestamp"] = "1970-01-01T00:00:00Z"
+    return {
+        "metadata": meta,
+        "status": {"phase": p.phase},
+        "spec": {
+            "nodeName": p.node,
+            "containers": [
+                {
+                    "resources": {
+                        "requests": {
+                            "cpu": f"{p.cpu_request_milli}m",
+                            "memory": f"{p.memory_request_mega}Mi",
+                        },
+                        "limits": {"google.com/tpu": str(p.tpu_limit)},
+                    }
+                }
+            ],
+        },
+    }
+
+
+def _job_manifest(w: WorkloadInfo) -> dict:
+    return {
+        "metadata": {
+            "name": w.name,
+            "labels": {"edl-job": w.job_name},
+            "resourceVersion": str(w.resource_version),
+        },
+        "spec": {
+            "parallelism": w.parallelism,
+            "template": {
+                "spec": {
+                    "containers": [
+                        {
+                            "resources": {
+                                "requests": {
+                                    "cpu": f"{w.cpu_request_milli}m",
+                                    "memory": f"{w.memory_request_mega}Mi",
+                                },
+                                "limits": {"google.com/tpu": str(w.tpu_limit)},
+                            }
+                        }
+                    ]
+                }
+            },
+        },
+    }
+
+
+def main(argv: List[str]) -> int:
+    # Strip flags KubectlAPI interleaves; record the ones that matter.
+    args: List[str] = []
+    out_json = False
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "-n":
+            i += 2
+            continue
+        if a == "-o":
+            out_json = argv[i + 1] == "json"
+            i += 2
+            continue
+        if a in ("-A", "--ignore-not-found"):
+            i += 1
+            continue
+        args.append(a)
+        i += 1
+
+    kube, raw = _load()
+    verb = args[0]
+
+    if verb == "get":
+        kind = args[1]
+        if kind == "nodes":
+            print(json.dumps({"items": [_node_manifest(n) for n in kube.list_nodes()]}))
+        elif kind == "pods":
+            print(json.dumps({"items": [_pod_manifest(p) for p in kube.list_pods()]}))
+        elif kind == "trainingjobs":
+            print(json.dumps({"items": raw.get("trainingjobs", [])}))
+        elif kind == "job":
+            w = kube.get_workload(args[2])
+            if w is None:
+                print(f'Error from server (NotFound): jobs "{args[2]}" not found', file=sys.stderr)
+                return 1
+            print(json.dumps(_job_manifest(w)))
+        else:
+            print(f"fake-kubectl: unsupported get {kind}", file=sys.stderr)
+            return 1
+        return 0
+
+    if verb == "apply":
+        payload = json.loads(sys.stdin.read())
+        items = payload.get("items", [payload])
+        crs = {m["metadata"]["name"]: m for m in raw.get("trainingjobs", [])}
+        rest = []
+        for m in items:
+            if m.get("kind") == "TrainingJob":
+                crs[m["metadata"]["name"]] = m
+            else:
+                rest.append(m)
+        raw["trainingjobs"] = list(crs.values())
+        if rest:
+            kube.apply_manifests(rest)
+        _save(kube, raw)
+        for m in items:
+            print(f"{m.get('kind', 'object').lower()}/{m['metadata']['name']} configured")
+        return 0
+
+    if verb == "patch":
+        # patch job <name> --type=merge -p <json>
+        name = args[2]
+        patch = json.loads(args[args.index("-p") + 1])
+        w = kube.get_workload(name)
+        if w is None:
+            print(f'Error from server (NotFound): jobs "{name}" not found', file=sys.stderr)
+            return 1
+        rv = patch.get("metadata", {}).get("resourceVersion")
+        if rv is not None:
+            w.resource_version = int(rv)
+        w.parallelism = patch.get("spec", {}).get("parallelism", w.parallelism)
+        try:
+            kube.update_workload(w)
+        except ConflictError as e:
+            print(f"Error from server (Conflict): {e}", file=sys.stderr)
+            return 1
+        _save(kube, raw)
+        print(f"job/{name} patched")
+        return 0
+
+    if verb == "delete":
+        kind, name = args[1], args[2]
+        if kind == "trainingjob":
+            before = raw.get("trainingjobs", [])
+            raw["trainingjobs"] = [m for m in before if m["metadata"]["name"] != name]
+            _save(kube, raw)
+            if len(raw["trainingjobs"]) < len(before):
+                print(f"trainingjob/{name} deleted")
+            return 0
+        existed = (
+            kube.delete_workload(name)
+            if kind in ("job", "deployment")
+            else kube.services.pop(name, None) is not None
+        )
+        _save(kube, raw)
+        if existed:
+            print(f"{kind}/{name} deleted")
+        return 0
+
+    print(f"fake-kubectl: unsupported verb {verb}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entrypoint
+    sys.exit(main(sys.argv[1:]))

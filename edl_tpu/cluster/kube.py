@@ -69,6 +69,42 @@ class ConflictError(RuntimeError):
     346-370``)."""
 
 
+def _workload_from_manifest(m: dict) -> WorkloadInfo:
+    """Project a rendered Job/Deployment manifest onto the WorkloadInfo
+    view (name, parallelism knob, per-replica resources)."""
+    from edl_tpu.utils.quantity import (
+        parse_count,
+        parse_cpu_milli,
+        parse_memory_mega,
+    )
+
+    kind = m["kind"]
+    meta = m["metadata"]
+    spec = m["spec"]
+    parallelism = (
+        spec.get("parallelism", 1) if kind == "Job" else spec.get("replicas", 1)
+    )
+    cpu = mem = tpu = 0
+    for c in spec.get("template", {}).get("spec", {}).get("containers", []):
+        req = c.get("resources", {}).get("requests", {})
+        lim = c.get("resources", {}).get("limits", {})
+        cpu += parse_cpu_milli(req.get("cpu", 0))
+        mem += parse_memory_mega(req.get("memory", 0))
+        tpu += parse_count(lim.get("google.com/tpu", 0))
+    labels = meta.get("labels", {})
+    # Trainer Jobs carry the pod-counting label; coordinator Deployments
+    # must NOT be counted as trainer pods (see jobparser OWNER_LABEL).
+    job_name = labels.get("edl-job", meta["name"]) if kind == "Job" else meta["name"]
+    return WorkloadInfo(
+        name=meta["name"],
+        job_name=job_name,
+        parallelism=parallelism,
+        cpu_request_milli=cpu,
+        memory_request_mega=mem,
+        tpu_limit=tpu,
+    )
+
+
 class KubeAPI:
     """Everything the framework asks of Kubernetes.  One process
     boundary, kept narrow on purpose."""
@@ -84,7 +120,10 @@ class KubeAPI:
     def get_workload(self, name: str) -> Optional[WorkloadInfo]:
         raise NotImplementedError
 
-    def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
+    def apply_manifests(self, manifests: List[dict]) -> None:
+        """Create-or-update rendered k8s manifests (the jobparser's
+        output).  This is the creation path — the reference's TODO
+        (``pkg/controller.go:115-133``) wired for real."""
         raise NotImplementedError
 
     def update_workload(self, w: WorkloadInfo) -> WorkloadInfo:
@@ -109,6 +148,7 @@ class FakeKube(KubeAPI):
         self.nodes: Dict[str, NodeInfo] = {n.name: n for n in (nodes or [])}
         self.workloads: Dict[str, WorkloadInfo] = {}
         self.pods: Dict[str, PodInfo] = {}
+        self.services: Dict[str, dict] = {}
         self._pod_seq = 0
         #: names of workloads whose pods must stay Pending (test knob to
         #: simulate unschedulable jobs beyond capacity math)
@@ -155,12 +195,35 @@ class FakeKube(KubeAPI):
 
     def delete_workload(self, name: str) -> bool:
         with self._lock:
+            self.services.pop(name, None)
             w = self.workloads.pop(name, None)
             if w is None:
                 return False
             for pname in [p for p, pod in self.pods.items() if pod.job_name == w.job_name]:
                 del self.pods[pname]
             return True
+
+    # -- manifest application -------------------------------------------------
+    def apply_manifests(self, manifests: List[dict]) -> None:
+        """Interpret the jobparser's real manifests — so FakeKube tests
+        exercise the identical creation path a live cluster gets."""
+        for m in manifests:
+            kind = m.get("kind", "")
+            if kind == "Service":
+                with self._lock:
+                    self.services[m["metadata"]["name"]] = m
+                continue
+            if kind not in ("Job", "Deployment"):
+                raise ValueError(f"FakeKube cannot apply kind {kind!r}")
+            w = _workload_from_manifest(m)
+            with self._lock:
+                cur = self.workloads.get(w.name)
+                if cur is None:
+                    self.create_workload(w)
+                else:
+                    cur.parallelism = w.parallelism
+                    cur.resource_version += 1
+                    self._reconcile(cur)
 
     # -- controller + scheduler emulation ------------------------------------
     def _job_pods(self, job_name: str) -> List[PodInfo]:
@@ -363,15 +426,51 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
             raise RuntimeError(f"kubectl patch failed: {msg.strip()}")
         return self.get_workload(w.name)
 
-    def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
-        raise NotImplementedError(
-            "create via manifests: edl_tpu.controller applies JobParser output"
-        )
-
-    def delete_workload(self, name: str) -> bool:
+    def list_training_jobs(self) -> List[dict]:
+        """All TrainingJob CRs across namespaces (the watch source,
+        ref informer ListWatch ``pkg/controller.go:80-85``)."""
         r = subprocess.run(
-            [self.kubectl, "-n", self.namespace, "delete", "job", name],
+            [self.kubectl, "get", "trainingjobs", "-A", "-o", "json"],
             capture_output=True,
             text=True,
         )
-        return r.returncode == 0
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"kubectl get trainingjobs failed: {(r.stderr or r.stdout).strip()}"
+            )
+        return json.loads(r.stdout).get("items", [])
+
+    def apply_manifests(self, manifests: List[dict]) -> None:
+        payload = json.dumps({"apiVersion": "v1", "kind": "List", "items": manifests})
+        r = subprocess.run(
+            [self.kubectl, "-n", self.namespace, "apply", "-f", "-"],
+            input=payload,
+            capture_output=True,
+            text=True,
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"kubectl apply failed: {(r.stderr or r.stdout).strip()}"
+            )
+
+    def delete_workload(self, name: str) -> bool:
+        """Delete by name across the kinds a job owns: trainer batch
+        Job, or coordinator Deployment + Service (same name)."""
+        deleted = False
+        for kind in ("job", "deployment", "service"):
+            r = subprocess.run(
+                [
+                    self.kubectl,
+                    "-n",
+                    self.namespace,
+                    "delete",
+                    kind,
+                    name,
+                    "--ignore-not-found",
+                ],
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                deleted = True
+        return deleted

@@ -21,7 +21,6 @@ import time
 from typing import Callable, Optional
 
 from edl_tpu.cluster.cluster import Cluster
-from edl_tpu.cluster.kube import WorkloadInfo
 from edl_tpu.resource.training_job import TrainingJob
 
 ENSURE_ATTEMPTS = 3  # ref convertedJobMaxRetryCount (pkg/trainingjober.go:25-28)
@@ -38,25 +37,17 @@ class JobLifecycle:
         self._sleep = sleep
 
     # -- create -------------------------------------------------------------
-    def _coordinator_workload(self, job: TrainingJob) -> WorkloadInfo:
-        res = job.spec.coordinator.resources
-        return WorkloadInfo(
-            name=job.coordinator_name(),
-            job_name=job.coordinator_name(),
-            parallelism=1,
-            cpu_request_milli=res.cpu_request_milli() or 250,
-            memory_request_mega=res.mem_request_mega() or 256,
-            tpu_limit=0,
-        )
-
     def check_and_create(self, job: TrainingJob) -> bool:
-        """Create whichever of the job's objects are missing; roll back
+        """Create whichever of the job's objects are missing — by
+        applying the jobparser's rendered manifests — with rollback of
         this call's creations on failure (ref ``checkAndCreate``,
         ``pkg/trainingjober.go:142-193``)."""
+        from edl_tpu.controller.jobparser import parse_to_coordinator
+
         created = []
         try:
             if self.cluster.kube.get_workload(job.coordinator_name()) is None:
-                self.cluster.kube.create_workload(self._coordinator_workload(job))
+                self.cluster.kube.apply_manifests(parse_to_coordinator(job))
                 created.append(job.coordinator_name())
             if self.cluster.get_trainer_workload(job) is None:
                 self.cluster.create_trainer_workload(job)

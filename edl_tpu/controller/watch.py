@@ -1,0 +1,65 @@
+"""TrainingJob watch source: the informer analog.
+
+The reference watched the CRD through a client-go informer
+(``pkg/controller.go:79-108``: ListWatch + NewInformer, resync 0) and
+dispatched add/update/delete to the autoscaler.  Kubernetes watches are
+just long-polled lists with resourceVersion bookmarks; a plain
+poll-and-diff loop provides the same semantics with zero client
+dependencies, and the list function is injected so tests, local-sim,
+and a real cluster (``KubectlAPI.list_training_jobs``) all drive the
+identical controller object.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List
+
+from edl_tpu.resource.training_job import TrainingJob
+
+
+class TrainingJobWatcher:
+    def __init__(
+        self,
+        list_fn: Callable[[], List[dict]],
+        controller,
+    ):
+        """``list_fn``: returns the current TrainingJob CR manifests
+        (dicts).  ``controller``: an ``edl_tpu.controller.Controller``."""
+        self._list = list_fn
+        self.controller = controller
+        self._seen: Dict[str, str] = {}  # name -> canonical spec json
+
+    @staticmethod
+    def _fingerprint(manifest: dict) -> str:
+        return json.dumps(manifest.get("spec", {}), sort_keys=True)
+
+    def poll_once(self) -> int:
+        """Diff the listed CRs against the known set; fire on_add /
+        on_update / on_delete (ref handler set, ``:110-147``).  Returns
+        the number of events dispatched."""
+        current: Dict[str, dict] = {}
+        for m in self._list():
+            try:
+                name = m["metadata"]["name"]
+            except (KeyError, TypeError):
+                continue
+            current[name] = m
+
+        events = 0
+        for name, m in current.items():
+            fp = self._fingerprint(m)
+            if name not in self._seen:
+                self.controller.on_add(TrainingJob.from_manifest(m))
+                events += 1
+            elif self._seen[name] != fp:
+                self.controller.on_update(TrainingJob.from_manifest(m))
+                events += 1
+            self._seen[name] = fp
+        for name in [n for n in self._seen if n not in current]:
+            del self._seen[name]
+            job = self.controller.jobs.get(name)
+            if job is not None:
+                self.controller.on_delete(job)
+                events += 1
+        return events

@@ -1,0 +1,209 @@
+"""End-to-end control plane against a kubectl-shaped fake cluster.
+
+The reference's deliverable was a daemon that watches TrainingJobs and
+scales them (``cmd/edl/edl.go:47-50``) — but its creation path was a
+logged TODO and nothing in-repo could run against a cluster.  This test
+drives the FULL loop through the real ``KubectlAPI`` surface:
+
+    edl submit -> CR stored -> edl controller (watch + create + scale)
+    -> trainer Job + coordinator exist -> autoscaler grows the elastic
+    job to max under an idle cluster -> edl kill -> objects destroyed
+
+backed by ``edl_tpu.cluster.fake_kubectl`` (FakeKube semantics behind
+the kubectl CLI, state in a JSON file).
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from edl_tpu.cli import main as cli_main
+
+JOB_YAML = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: e2e-mnist}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  trainer:
+    entrypoint: mnist
+    min_instance: 1
+    max_instance: 4
+    slice_topology: v5e-4
+    resources:
+      requests: {cpu: "1", memory: 1Gi}
+"""
+
+
+@pytest.fixture
+def fake_cluster(tmp_path, monkeypatch):
+    """A 4-pool x 4-chip fake cluster behind a kubectl shim."""
+    state = tmp_path / "kube-state.json"
+    state.write_text(
+        json.dumps(
+            {
+                "nodes": [
+                    {
+                        "name": f"pool-{i}",
+                        "cpu_milli": 16000,
+                        "memory_mega": 65536,
+                        "tpu_chips": 4,
+                        "tpu_topology": "2x2",
+                    }
+                    for i in range(4)
+                ]
+            }
+        )
+    )
+    shim = tmp_path / "kubectl"
+    shim.write_text(
+        "#!/bin/sh\n"
+        f'exec {sys.executable} -m edl_tpu.cluster.fake_kubectl "$@"\n'
+    )
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("EDL_FAKE_KUBE_STATE", str(state))
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return {"state": state, "kubectl": str(shim)}
+
+
+def _state(fake_cluster) -> dict:
+    return json.loads(fake_cluster["state"].read_text())
+
+
+def test_submit_controller_scale_kill(fake_cluster, tmp_path, capsys):
+    spec = tmp_path / "job.yaml"
+    spec.write_text(JOB_YAML)
+    kubectl = fake_cluster["kubectl"]
+
+    # submit: the CR lands in the (fake) API server
+    assert cli_main(["submit", str(spec), "--kubectl", kubectl]) == 0
+    crs = _state(fake_cluster)["trainingjobs"]
+    assert [c["metadata"]["name"] for c in crs] == ["e2e-mnist"]
+    capsys.readouterr()  # drop the kubectl apply echo
+
+    # controller: watch sees the CR, creates trainer Job + coordinator,
+    # autoscaler grows the elastic job toward max on the idle cluster
+    assert (
+        cli_main(
+            [
+                "controller",
+                "--kubectl",
+                kubectl,
+                "--iterations",
+                "6",
+                "--interval",
+                "0",
+            ]
+        )
+        == 0
+    )
+    statuses = json.loads(capsys.readouterr().out)
+    assert statuses[0]["name"] == "e2e-mnist"
+    assert statuses[0]["state"] == "Running"
+
+    st = _state(fake_cluster)
+    workloads = {w["name"]: w for w in st["workloads"]}
+    assert "e2e-mnist-trainer" in workloads
+    assert "e2e-mnist-coordinator" in workloads
+    assert [s["metadata"]["name"] for s in st["services"]] == [
+        "e2e-mnist-coordinator"
+    ]
+    # Idle cluster, elastic 1..4, 4 chips/trainer on 4x4-chip pools:
+    # the dry-run fixed point must fill the cluster (BASELINE config 2).
+    assert workloads["e2e-mnist-trainer"]["parallelism"] == 4
+    trainer_pods = [
+        p for p in st["pods"] if p["job_name"] == "e2e-mnist"
+    ]
+    assert len(trainer_pods) == 4
+    assert all(p["phase"] == "Running" for p in trainer_pods)
+
+    # kill: CR deleted; the next controller pass destroys the objects
+    assert cli_main(["kill", "e2e-mnist", "--kubectl", kubectl]) == 0
+    capsys.readouterr()
+    assert (
+        cli_main(
+            [
+                "controller",
+                "--kubectl",
+                kubectl,
+                "--iterations",
+                "2",
+                "--interval",
+                "0",
+            ]
+        )
+        == 0
+    )
+    st = _state(fake_cluster)
+    assert st["workloads"] == []
+    assert st["trainingjobs"] == []
+    assert st["services"] == []
+
+
+def test_kubectl_api_surface(fake_cluster):
+    """KubectlAPI parsing against the kubectl-shaped responses."""
+    from edl_tpu.cluster.kube import KubectlAPI, WorkloadInfo
+
+    api = KubectlAPI(kubectl=fake_cluster["kubectl"])
+    nodes = api.list_nodes()
+    assert len(nodes) == 4
+    assert nodes[0].cpu_milli == 16000
+    assert nodes[0].tpu_chips == 4
+    assert nodes[0].tpu_topology == "2x2"
+
+    api.apply_manifests(
+        [
+            {
+                "apiVersion": "batch/v1",
+                "kind": "Job",
+                "metadata": {"name": "t-trainer", "labels": {"edl-job": "t"}},
+                "spec": {
+                    "parallelism": 2,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "resources": {
+                                        "requests": {
+                                            "cpu": "500m",
+                                            "memory": "1Gi",
+                                        },
+                                        "limits": {"google.com/tpu": "4"},
+                                    }
+                                }
+                            ]
+                        }
+                    },
+                },
+            }
+        ]
+    )
+    w = api.get_workload("t-trainer")
+    assert w is not None and w.parallelism == 2 and w.tpu_limit == 4
+
+    w.parallelism = 3
+    api.update_workload(w)
+    assert api.get_workload("t-trainer").parallelism == 3
+
+    pods = api.list_pods()
+    assert sum(1 for p in pods if p.job_name == "t") == 3
+
+    # stale resourceVersion maps to ConflictError
+    from edl_tpu.cluster.kube import ConflictError
+
+    stale = WorkloadInfo(
+        name="t-trainer", job_name="t", parallelism=5, resource_version=1
+    )
+    with pytest.raises(ConflictError):
+        api.update_workload(stale)
+
+    assert api.delete_workload("t-trainer") is True
+    assert api.get_workload("t-trainer") is None

@@ -59,7 +59,14 @@ class Autoscaler:
         cluster: Cluster,
         max_load_desired: float = DEFAULT_MAX_LOAD_DESIRED,
         loop_seconds: float = DEFAULT_LOOP_SECONDS,
+        coord_client_factory=None,
     ):
+        """``coord_client_factory``: job -> coordinator client (the
+        actuation handshake's transport); defaults to the HTTP client
+        resolved from the job's coordinator Service.  Injectable so
+        tests can point it at an in-process coordinator."""
+        from edl_tpu.controller.coordclient import make_coord_client
+
         self.cluster = cluster
         self.max_load_desired = max_load_desired
         self.loop_seconds = loop_seconds
@@ -67,6 +74,7 @@ class Autoscaler:
         self._events: "queue.Queue[_Event]" = queue.Queue()
         self._stop = threading.Event()
         self.plans: List[ScalePlan] = []
+        self._coord_client = coord_client_factory or make_coord_client
 
     # -- event intake (ref OnAdd/OnUpdate/OnDel, :158-171) -------------------
     def on_add(self, job: TrainingJob):
@@ -109,7 +117,7 @@ class Autoscaler:
             w = self.cluster.get_trainer_workload(job)
             if w is None:
                 continue  # not created yet (ref tryToRetrieve..., :424-447)
-            total, running, pending = pods_by_job.get(job.name, (0, 0, 0))
+            total, running, pending, _ = pods_by_job.get(job.name, (0, 0, 0, 0))
             if total > 0 and total == pending:
                 # every pod pending: the job cannot start (ref
                 # findPendingJob, :406-422).  Its min-instance needs
@@ -141,7 +149,7 @@ class Autoscaler:
         for v in candidates:
             if diff.get(v.name):
                 targets[v.name] = v.parallelism + diff[v.name]
-        self._actuate(targets)
+        self._actuate(targets, diff)
         plan = ScalePlan(
             targets=targets,
             diff=diff,
@@ -151,14 +159,33 @@ class Autoscaler:
         self.plans.append(plan)
         return plan
 
-    def _actuate(self, targets: Dict[str, int]):
+    def _actuate(self, targets: Dict[str, int], diff: Dict[str, int]):
         """ref scaleAllJobs (:339-376); the 5-retry conflict loop lives
-        in Cluster.update_parallelism."""
+        in Cluster.update_parallelism.  Beyond the reference: each PUT
+        is paired with the coordinator handshake (SURVEY §7.1 row 4) —
+        **retarget-then-PUT on scale-down** so survivors re-form the
+        world before the kube Job controller kills pods, PUT-then-
+        retarget on scale-up so the target grows once pods can exist."""
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
                 continue
+            scale_down = diff.get(name, 0) < 0
+            if scale_down:
+                self._retarget(job, parallelism)
             self.cluster.update_parallelism(job, parallelism)
+            if not scale_down:
+                self._retarget(job, parallelism)
+
+    def _retarget(self, job: TrainingJob, world: int):
+        """POST the new target world to the job's coordinator.  Failure
+        is tolerated (the coordinator may still be scheduling): the
+        controller's level-triggered ``reconcile_targets`` converges the
+        handshake on a later tick."""
+        try:
+            self._coord_client(job).set_target_world(world)
+        except Exception:
+            pass
 
     # -- the loop (ref Run, :451-485) ----------------------------------------
     def run(self):

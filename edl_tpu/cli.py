@@ -206,7 +206,12 @@ def cmd_controller(args) -> int:
             break
         time.sleep(args.interval)
     if args.iterations:
-        print(json.dumps(ctrl.job_statuses(), indent=2))
+        print(
+            json.dumps(
+                {"jobs": ctrl.job_statuses(), "cluster": ctrl.cluster_metrics()},
+                indent=2,
+            )
+        )
     return 0
 
 
@@ -238,7 +243,12 @@ def cmd_local_sim(args) -> int:
         ctrl.run_once()
         kube.retry_scheduling()
     ctrl.reconcile_status()
-    print(json.dumps(ctrl.job_statuses(), indent=2))
+    print(
+        json.dumps(
+            {"jobs": ctrl.job_statuses(), "cluster": ctrl.cluster_metrics()},
+            indent=2,
+        )
+    )
     return 0
 
 

@@ -92,27 +92,29 @@ class Cluster:
         return False
 
     # -- pod counting (ref JobPods) -----------------------------------------
-    def job_pods(self, job: TrainingJob) -> Tuple[int, int, int]:
-        """(total, running, pending) over the job's non-deleting pods
-        (ref ``pkg/cluster.go:117-136``: label-selected, honoring
-        DeletionTimestamp)."""
-        return self.job_pods_map().get(job.name, (0, 0, 0))
+    def job_pods(self, job: TrainingJob) -> Tuple[int, int, int, int]:
+        """(total, running, pending, succeeded) over the job's
+        non-deleting pods (ref ``pkg/cluster.go:117-136``:
+        label-selected, honoring DeletionTimestamp)."""
+        return self.job_pods_map().get(job.name, (0, 0, 0, 0))
 
-    def job_pods_map(self) -> Dict[str, Tuple[int, int, int]]:
-        """(total, running, pending) for every job in ONE pod list —
-        the autoscaler loop uses this so a tick costs one list call,
-        not one per job."""
+    def job_pods_map(self) -> Dict[str, Tuple[int, int, int, int]]:
+        """(total, running, pending, succeeded) for every job in ONE
+        pod list — the autoscaler loop uses this so a tick costs one
+        list call, not one per job."""
         out: Dict[str, List[int]] = {}
         for p in self.kube.list_pods():
             if not p.job_name or p.deleting:
                 continue
-            c = out.setdefault(p.job_name, [0, 0, 0])
+            c = out.setdefault(p.job_name, [0, 0, 0, 0])
             c[0] += 1
             if p.phase == "Running":
                 c[1] += 1
             elif p.phase == "Pending":
                 c[2] += 1
-        return {k: (v[0], v[1], v[2]) for k, v in out.items()}
+            elif p.phase == "Succeeded":
+                c[3] += 1
+        return {k: tuple(v) for k, v in out.items()}
 
     # -- CRUD (ref :245-291) -------------------------------------------------
     def create_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:

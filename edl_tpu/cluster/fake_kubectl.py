@@ -103,14 +103,21 @@ def _pod_manifest(p: PodInfo) -> dict:
 
 
 def _job_manifest(w: WorkloadInfo) -> dict:
+    if w.kind == "Deployment":
+        labels = {"edl-owner": w.owner} if w.owner else {}
+        knob = {"replicas": w.parallelism}
+    else:
+        labels = {"edl-job": w.owner or w.job_name}
+        knob = {"parallelism": w.parallelism}
     return {
+        "kind": w.kind,
         "metadata": {
             "name": w.name,
-            "labels": {"edl-job": w.job_name},
+            "labels": labels,
             "resourceVersion": str(w.resource_version),
         },
         "spec": {
-            "parallelism": w.parallelism,
+            **knob,
             "template": {
                 "spec": {
                     "containers": [
@@ -134,6 +141,7 @@ def main(argv: List[str]) -> int:
     # Strip flags KubectlAPI interleaves; record the ones that matter.
     args: List[str] = []
     out_json = False
+    selector = None
     i = 0
     while i < len(argv):
         a = argv[i]
@@ -142,6 +150,10 @@ def main(argv: List[str]) -> int:
             continue
         if a == "-o":
             out_json = argv[i + 1] == "json"
+            i += 2
+            continue
+        if a == "-l":
+            selector = argv[i + 1]
             i += 2
             continue
         if a in ("-A", "--ignore-not-found"):
@@ -161,6 +173,17 @@ def main(argv: List[str]) -> int:
             print(json.dumps({"items": [_pod_manifest(p) for p in kube.list_pods()]}))
         elif kind == "trainingjobs":
             print(json.dumps({"items": raw.get("trainingjobs", [])}))
+        elif kind in ("jobs", "deployments"):
+            want = "Deployment" if kind == "deployments" else "Job"
+            items = []
+            for w in kube.list_workloads():
+                if w.kind != want:
+                    continue
+                m = _job_manifest(w)
+                if selector and selector not in m["metadata"]["labels"]:
+                    continue
+                items.append(m)
+            print(json.dumps({"items": items}))
         elif kind == "job":
             w = kube.get_workload(args[2])
             if w is None:
@@ -179,6 +202,14 @@ def main(argv: List[str]) -> int:
         rest = []
         for m in items:
             if m.get("kind") == "TrainingJob":
+                # A real API server assigns the object UID on creation;
+                # ownerReferences on rendered workloads depend on it.
+                prior = crs.get(m["metadata"]["name"])
+                m["metadata"].setdefault(
+                    "uid",
+                    (prior or {}).get("metadata", {}).get("uid")
+                    or f"uid-{m['metadata']['name']}",
+                )
                 crs[m["metadata"]["name"]] = m
             else:
                 rest.append(m)

@@ -61,6 +61,12 @@ class WorkloadInfo:
     memory_request_mega: int = 0
     tpu_limit: int = 0
     resource_version: int = 0
+    #: k8s kind ("Job" for trainers, "Deployment" for coordinators)
+    kind: str = "Job"
+    #: owning TrainingJob name (from the edl-job / edl-owner label);
+    #: empty for workloads the framework does not own.  Drives the
+    #: controller's level-triggered orphan GC.
+    owner: str = ""
 
 
 class ConflictError(RuntimeError):
@@ -102,6 +108,8 @@ def _workload_from_manifest(m: dict) -> WorkloadInfo:
         cpu_request_milli=cpu,
         memory_request_mega=mem,
         tpu_limit=tpu,
+        kind=kind,
+        owner=labels.get("edl-job", labels.get("edl-owner", "")),
     )
 
 
@@ -118,6 +126,12 @@ class KubeAPI:
 
     # trainer workload CRUD (ref pkg/cluster.go:91-113, 245-291)
     def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+        raise NotImplementedError
+
+    def list_workloads(self) -> List[WorkloadInfo]:
+        """All framework-owned workloads (trainer Jobs + coordinator
+        Deployments), for level-triggered reconciliation: the controller
+        compares them against the live CR set and GCs orphans."""
         raise NotImplementedError
 
     def apply_manifests(self, manifests: List[dict]) -> None:
@@ -168,6 +182,10 @@ class FakeKube(KubeAPI):
         with self._lock:
             w = self.workloads.get(name)
             return WorkloadInfo(**vars(w)) if w else None
+
+    def list_workloads(self) -> List[WorkloadInfo]:
+        with self._lock:
+            return [WorkloadInfo(**vars(w)) for w in self.workloads.values()]
 
     def create_workload(self, w: WorkloadInfo) -> WorkloadInfo:
         with self._lock:
@@ -284,6 +302,14 @@ class FakeKube(KubeAPI):
                     break
 
     # -- test helpers --------------------------------------------------------
+    def complete_pods(self, job_name: str):
+        """Test knob: all of a job's pods run to completion (the kube
+        Job controller leaves Succeeded pods in place)."""
+        with self._lock:
+            for p in self.pods.values():
+                if p.job_name == job_name:
+                    p.phase = "Succeeded"
+
     def kill_pod(self, name: str):
         """Simulate a pod death (node failure, preemption)."""
         with self._lock:
@@ -385,15 +411,50 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
 
         req = tmpl.get("resources", {}).get("requests", {})
         lim = tmpl.get("resources", {}).get("limits", {})
+        labels = it["metadata"].get("labels", {})
         return WorkloadInfo(
             name=name,
-            job_name=it["metadata"].get("labels", {}).get("edl-job", name),
+            job_name=labels.get("edl-job", name),
             parallelism=spec.get("parallelism", 0),
             cpu_request_milli=parse_cpu_milli(req.get("cpu", 0)),
             memory_request_mega=parse_memory_mega(req.get("memory", 0)),
             tpu_limit=parse_count(lim.get("google.com/tpu", 0)),
             resource_version=int(it["metadata"]["resourceVersion"]),
+            kind=it.get("kind", "Job"),
+            owner=labels.get("edl-job", labels.get("edl-owner", "")),
         )
+
+    def list_workloads(self) -> List[WorkloadInfo]:
+        """Framework-owned workloads via label selectors: trainer Jobs
+        carry ``edl-job``, coordinator Deployments ``edl-owner``."""
+        out: List[WorkloadInfo] = []
+        for kind_plural, kind, selector in (
+            ("jobs", "Job", "edl-job"),
+            ("deployments", "Deployment", "edl-owner"),
+        ):
+            try:
+                items = self._run("get", kind_plural, "-l", selector)["items"]
+            except subprocess.CalledProcessError:
+                continue
+            for it in items:
+                labels = it["metadata"].get("labels", {})
+                out.append(
+                    WorkloadInfo(
+                        name=it["metadata"]["name"],
+                        job_name=labels.get("edl-job", it["metadata"]["name"]),
+                        parallelism=it["spec"].get(
+                            "parallelism", it["spec"].get("replicas", 1)
+                        ),
+                        resource_version=int(
+                            it["metadata"].get("resourceVersion", 0)
+                        ),
+                        kind=kind,
+                        owner=labels.get(
+                            "edl-job", labels.get("edl-owner", "")
+                        ),
+                    )
+                )
+        return out
 
     def update_workload(self, w: WorkloadInfo) -> WorkloadInfo:
         # Include resourceVersion in the merge patch so the API server

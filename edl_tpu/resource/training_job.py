@@ -230,10 +230,16 @@ class TrainingJobStatus:
     submitted_at: float = 0.0
     started_at: float = 0.0
 
-    def pending_seconds(self) -> float:
+    def pending_seconds(self, now: Optional[float] = None) -> float:
+        """Seconds from submit to first running pod.  ``now`` must come
+        from the same clock that wrote the timestamps (the controller
+        passes its injected clock); defaults to wall time."""
         if self.submitted_at <= 0:
             return 0.0
-        end = self.started_at if self.started_at > 0 else time.time()
+        if self.started_at > 0:
+            end = self.started_at
+        else:
+            end = now if now is not None else time.time()
         return max(0.0, end - self.submitted_at)
 
 
@@ -243,6 +249,10 @@ class TrainingJob:
 
     name: str = ""
     namespace: str = "default"
+    #: API-server-assigned object UID; stamps ownerReferences on every
+    #: rendered workload manifest so Kubernetes garbage-collects them
+    #: when the CR is deleted (the ref delegated GC to k8s ownership).
+    uid: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
     spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
     status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
@@ -376,14 +386,17 @@ class TrainingJob:
         spec = asdict(self.spec)
         status = asdict(self.status)
         status["state"] = self.status.state.value
+        metadata = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "labels": dict(self.labels),
+        }
+        if self.uid:
+            metadata["uid"] = self.uid
         return {
             "apiVersion": f"{GROUP}/{VERSION}",
             "kind": KIND,
-            "metadata": {
-                "name": self.name,
-                "namespace": self.namespace,
-                "labels": dict(self.labels),
-            },
+            "metadata": metadata,
             "spec": spec,
             "status": status,
         }
@@ -400,6 +413,7 @@ class TrainingJob:
             job = TrainingJob(
                 name=meta.get("name", ""),
                 namespace=meta.get("namespace", "default"),
+                uid=meta.get("uid", ""),
                 labels=dict(meta.get("labels", {}) or {}),
                 spec=TrainingJobSpec.from_dict(d.get("spec")),
             )

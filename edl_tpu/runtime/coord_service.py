@@ -75,6 +75,10 @@ class CoordinatorServer:
                     self._reply({"plan": _plan_to_dict(coord.plan())})
                 elif self.path == "/members":
                     self._reply({"members": coord.members()})
+                elif self.path == "/target":
+                    self._reply({"world": coord.target_world()})
+                elif self.path == "/metrics":
+                    self._reply(coord.metrics())
                 elif self.path == "/healthz":
                     self._reply({"ok": True})
                 else:
@@ -103,6 +107,9 @@ class CoordinatorServer:
                         self._reply({"ok": True})
                     elif self.path == "/checkpoint":
                         coord.report_checkpoint(req["step"])
+                        self._reply({"ok": True})
+                    elif self.path == "/complete":
+                        coord.report_complete(req.get("step", -1))
                         self._reply({"ok": True})
                     elif self.path == "/evict_dead":
                         self._reply({"evicted": coord.evict_dead()})
@@ -176,7 +183,8 @@ class HTTPCoordinator:
                 raise  # the server answered; not transient
             except Exception as e:  # URLError, timeout, connection reset
                 last = e
-                _time.sleep(0.2 * (2**attempt))
+                if attempt + 1 < self.retries:  # no sleep after the last try
+                    _time.sleep(0.2 * (2**attempt))
         raise ConnectionError(
             f"coordinator unreachable after {self.retries} tries"
         ) from last
@@ -220,8 +228,20 @@ class HTTPCoordinator:
     def set_target_world(self, n: int):
         self._post("/target", world=n)
 
+    def get_target_world(self) -> int:
+        return self._get("/target")["world"]
+
     def report_checkpoint(self, step: int):
         self._post("/checkpoint", step=step)
+
+    def report_complete(self, step: int = -1):
+        self._post("/complete", step=step)
+
+    def completed(self) -> bool:
+        return bool(self._get("/metrics")["completed"])
+
+    def metrics(self) -> dict:
+        return self._get("/metrics")
 
     def evict_dead(self) -> List[str]:
         return self._post("/evict_dead")["evicted"]
@@ -240,6 +260,13 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
     p.add_argument("--min-world", type=int, default=1)
     p.add_argument("--max-world", type=int, default=1)
     p.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    p.add_argument(
+        "--target-steps",
+        type=int,
+        default=0,
+        help="steps after which the job is complete (0 = open-ended; "
+        "trainers may still POST /complete explicitly)",
+    )
     p.add_argument(
         "--legal-sizes",
         default=None,
@@ -260,6 +287,8 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
         heartbeat_timeout=args.heartbeat_timeout,
         legal_sizes=legal,
     )
+    if args.target_steps:
+        coord.set_target_steps(args.target_steps)
     server = CoordinatorServer(coord, host=args.host, port=args.port)
     server.start(evict=True)
     print(f"edl-tpu coordinator listening on {args.host}:{server.port}")

@@ -98,6 +98,11 @@ class LocalCoordinator:
         self._latest_checkpoint_step = -1
         self._plan: Optional[ElasticPlan] = None
         self._resize_log: List[dict] = []
+        #: target training steps (passes x batches-per-pass); 0 = open-ended
+        self._target_steps = 0
+        #: set when a trainer reports the job finished its passes
+        self._completed = False
+        self._completed_step = -1
 
     # -- membership (trainer-facing) ----------------------------------------
     def register(self, trainer_id: str, address: str = "") -> ElasticPlan:
@@ -173,11 +178,58 @@ class LocalCoordinator:
         with self._lock:
             if step > self._latest_checkpoint_step:
                 self._latest_checkpoint_step = step
+            if self._target_steps and step >= self._target_steps:
+                self._completed = True
+                self._completed_step = max(self._completed_step, step)
+
+    def report_complete(self, step: int = -1):
+        """A trainer finished the job's passes (launcher's end-of-run
+        signal).  The controller polls ``completed`` and fires
+        ``mark_succeeded`` -> ``lifecycle.complete`` (ref ``Complete``,
+        ``pkg/trainingjober.go:126-132`` — which nothing ever called)."""
+        with self._lock:
+            self._completed = True
+            self._completed_step = max(self._completed_step, step)
+            self._lock.notify_all()
+
+    def set_target_steps(self, n: int):
+        with self._lock:
+            self._target_steps = max(0, n)
 
     # -- queries ------------------------------------------------------------
     def plan(self) -> Optional[ElasticPlan]:
         with self._lock:
             return self._plan
+
+    def target_world(self) -> int:
+        """Current actuation target — lets the controller reconcile the
+        handshake level-triggered (POST a new target only on drift)."""
+        with self._lock:
+            return self._target_world
+
+    def completed(self) -> bool:
+        with self._lock:
+            return self._completed
+
+    def metrics(self) -> dict:
+        """Observability snapshot (served at the coordinator's /metrics)."""
+        with self._lock:
+            return {
+                "generation": self._generation,
+                "world_size": self._plan.world_size if self._plan else 0,
+                "members": len(self._members),
+                "standby": max(
+                    0,
+                    len(self._members)
+                    - (self._plan.world_size if self._plan else 0),
+                ),
+                "target_world": self._target_world,
+                "target_steps": self._target_steps,
+                "latest_checkpoint_step": self._latest_checkpoint_step,
+                "resizes": len(self._resize_log),
+                "completed": self._completed,
+                "completed_step": self._completed_step,
+            }
 
     def generation(self) -> int:
         with self._lock:

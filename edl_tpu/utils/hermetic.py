@@ -1,0 +1,44 @@
+"""Hermetic virtual-CPU child provisioning.
+
+Multi-chip code paths are validated on an n-device virtual CPU mesh in
+a fresh subprocess (SURVEY.md §4's "multi-host TPU simulation").  The
+recipe has two halves, and both are needed in THIS environment:
+
+1. the parent builds a child env pinning ``JAX_PLATFORMS=cpu`` and the
+   forced device count (env vars are read at backend init), and
+2. the child re-pins via ``jax.config.update`` — the image's
+   sitecustomize imports jax (TPU plugin) at interpreter start, before
+   the env is consulted, so the config update is the authoritative pin.
+
+Used by ``__graft_entry__.dryrun_multichip`` and ``bench.py``'s
+cross-size resize child; keep them on this one helper so the recipe
+cannot diverge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def virtual_cpu_env(
+    n_devices: int, base_env: Optional[Dict[str, str]] = None
+) -> Dict[str, str]:
+    """Child environment forcing an ``n_devices`` virtual-CPU platform."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split() if not f.startswith(_COUNT_FLAG)
+    ]
+    flags.append(f"{_COUNT_FLAG}={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def pin_cpu_platform() -> None:
+    """Child-side platform pin; call before any jax op or device query."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

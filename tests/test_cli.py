@@ -70,9 +70,12 @@ def test_local_sim(spec, capsys):
         == 0
     )
     out = json.loads(capsys.readouterr().out)
-    assert out[0]["name"] == "cli-demo"
-    assert out[0]["state"] in ("Running", "Scaling")
-    assert out[0]["parallelism"] >= 1
+    jobs = out["jobs"]
+    assert jobs[0]["name"] == "cli-demo"
+    assert jobs[0]["state"] in ("Running", "Scaling")
+    assert jobs[0]["parallelism"] >= 1
+    assert "tpu_utilization" in out["cluster"]
+    assert "pending_p50_s" in out["cluster"]
 
 
 def test_local_run_with_resize(spec, capsys):

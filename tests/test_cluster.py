@@ -51,11 +51,11 @@ def test_fake_kube_reconciles_parallelism_to_pods():
     cluster = Cluster(kube)
     job = make_job()
     cluster.create_trainer_workload(job)
-    assert cluster.job_pods(job) == (1, 1, 0)
+    assert cluster.job_pods(job) == (1, 1, 0, 0)
     assert cluster.update_parallelism(job, 3)
-    assert cluster.job_pods(job) == (3, 3, 0)
+    assert cluster.job_pods(job) == (3, 3, 0, 0)
     assert cluster.update_parallelism(job, 1)
-    assert cluster.job_pods(job) == (1, 1, 0)
+    assert cluster.job_pods(job) == (1, 1, 0, 0)
 
 
 def test_fake_kube_leaves_unschedulable_pods_pending():
@@ -64,7 +64,7 @@ def test_fake_kube_leaves_unschedulable_pods_pending():
     job = make_job(mx=4)
     cluster.create_trainer_workload(job)
     cluster.update_parallelism(job, 4)  # wants 16 chips
-    total, running, pending = cluster.job_pods(job)
+    total, running, pending, _ = cluster.job_pods(job)
     assert (total, running, pending) == (4, 2, 2)
 
 
@@ -131,7 +131,7 @@ def test_autoscaler_grows_job_into_idle_cluster():
     for _ in range(4):
         a.run_once()
     assert cluster.get_trainer_workload(job).parallelism == 4
-    assert cluster.job_pods(job) == (4, 4, 0)
+    assert cluster.job_pods(job) == (4, 4, 0, 0)
 
 
 def test_autoscaler_holds_non_elastic_job():
@@ -158,13 +158,13 @@ def test_autoscaler_sheds_elastic_job_for_pending_job():
 
     newbie = make_job("newbie", mn=1, mx=2)
     cluster.create_trainer_workload(newbie)  # pod stays Pending: 0 free chips
-    assert cluster.job_pods(newbie) == (1, 0, 1)
+    assert cluster.job_pods(newbie) == (1, 0, 1, 0)
     a.on_add(newbie)
     for _ in range(4):
         a.run_once()
         kube.retry_scheduling()
     assert cluster.get_trainer_workload(greedy).parallelism == 3
-    assert cluster.job_pods(newbie) == (1, 1, 0)  # newbie runs
+    assert cluster.job_pods(newbie) == (1, 1, 0, 0)  # newbie runs
 
 
 def test_autoscaler_event_removal_stops_management():

@@ -105,14 +105,22 @@ def test_submit_controller_scale_kill(fake_cluster, tmp_path, capsys):
         )
         == 0
     )
-    statuses = json.loads(capsys.readouterr().out)
+    out = json.loads(capsys.readouterr().out)
+    statuses = out["jobs"]
     assert statuses[0]["name"] == "e2e-mnist"
     assert statuses[0]["state"] == "Running"
+    # north-star metrics ride the statuses JSON (BASELINE.md)
+    assert out["cluster"]["tpu_utilization"] == 1.0  # 16/16 chips in use
+    assert "pending_p50_s" in out["cluster"]
 
     st = _state(fake_cluster)
     workloads = {w["name"]: w for w in st["workloads"]}
     assert "e2e-mnist-trainer" in workloads
     assert "e2e-mnist-coordinator" in workloads
+    # workloads carry the CR's owner identity for GC (labels + k8s
+    # ownerReferences via the CR uid fake-kubectl assigned on apply)
+    assert workloads["e2e-mnist-trainer"]["owner"] == "e2e-mnist"
+    assert workloads["e2e-mnist-coordinator"]["owner"] == "e2e-mnist"
     assert [s["metadata"]["name"] for s in st["services"]] == [
         "e2e-mnist-coordinator"
     ]
@@ -146,6 +154,131 @@ def test_submit_controller_scale_kill(fake_cluster, tmp_path, capsys):
     assert st["workloads"] == []
     assert st["trainingjobs"] == []
     assert st["services"] == []
+
+
+def test_actuation_handshake_e2e(fake_cluster, tmp_path, capsys, monkeypatch):
+    """The two halves form a system: submitting an elastic job and
+    running ``edl controller`` grows the *coordinator's plan* to world
+    4 — no test code calls ``set_target_world`` (VERDICT r2 #1).  The
+    coordinator is a real ``CoordinatorServer``; the controller finds
+    it through ``EDL_COORD_ADDR_TEMPLATE`` (the cluster-DNS stand-in)."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=4, heartbeat_timeout=60)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(evict=False)
+    try:
+        monkeypatch.setenv(
+            "EDL_COORD_ADDR_TEMPLATE", f"127.0.0.1:{server.port}"
+        )
+        # the job's 4 trainer pods come up and register
+        for i in range(4):
+            coord.register(f"t{i}")
+        assert coord.plan().world_size == 1  # capped by the initial target
+
+        spec = tmp_path / "job.yaml"
+        spec.write_text(JOB_YAML)
+        kubectl = fake_cluster["kubectl"]
+        assert cli_main(["submit", str(spec), "--kubectl", kubectl]) == 0
+        assert (
+            cli_main(
+                [
+                    "controller",
+                    "--kubectl",
+                    kubectl,
+                    "--iterations",
+                    "6",
+                    "--interval",
+                    "0",
+                ]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        # the autoscaler's PUT reached parallelism 4 AND the handshake
+        # retargeted the coordinator: the plan itself is world 4
+        assert coord.target_world() == 4
+        assert coord.plan().world_size == 4
+    finally:
+        server.stop()
+
+
+FIT_A_LINE_YAML = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: fit-a-line}
+spec:
+  trainer:
+    entrypoint: fit_a_line
+    min_instance: 1
+    max_instance: 1
+    slice_topology: v5e-1
+    resources:
+      requests: {cpu: "1", memory: 1Gi}
+"""
+
+
+def test_completion_via_coordinator(monkeypatch):
+    """BASELINE config 1 (min=max=1, run to completion): the trainer
+    reports completion through the coordinator; the controller marks
+    Succeed and tears the coordinator down, keeping the trainer
+    workload (ref Complete, pkg/trainingjober.go:126-132 — which the
+    reference never wired; VERDICT r2 #6)."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.kube import FakeKube, NodeInfo
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.resource.training_job import TrainingJob
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=1)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(evict=False)
+    try:
+        monkeypatch.setenv(
+            "EDL_COORD_ADDR_TEMPLATE", f"127.0.0.1:{server.port}"
+        )
+        kube = FakeKube(
+            [NodeInfo(name="pool-0", cpu_milli=8000, memory_mega=32768, tpu_chips=4)]
+        )
+        cluster = Cluster(kube)
+        ctrl = Controller(cluster, Autoscaler(cluster))
+        ctrl.on_add(TrainingJob.from_yaml(FIT_A_LINE_YAML))
+        ctrl.run_once()
+        assert ctrl.jobs["fit-a-line"].status.state.value == "Running"
+
+        # the launcher finishes the job's passes -> reports completion
+        coord.report_complete(step=100)
+        ctrl.run_once()
+        assert ctrl.jobs["fit-a-line"].status.state.value == "Succeed"
+        # coordinator gone, trainer workload kept (ref Complete semantics)
+        assert kube.get_workload("fit-a-line-coordinator") is None
+        assert kube.get_workload("fit-a-line-trainer") is not None
+    finally:
+        server.stop()
+
+
+def test_completion_via_terminal_pods():
+    """Completion without a reachable coordinator: every trainer pod
+    ran to completion (RestartPolicy Never) -> Succeed."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.kube import FakeKube, NodeInfo
+    from edl_tpu.controller.controller import Controller
+    from edl_tpu.resource.training_job import TrainingJob
+
+    kube = FakeKube(
+        [NodeInfo(name="pool-0", cpu_milli=8000, memory_mega=32768, tpu_chips=4)]
+    )
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, Autoscaler(cluster))
+    ctrl.on_add(TrainingJob.from_yaml(FIT_A_LINE_YAML))
+    ctrl.run_once()
+    assert ctrl.jobs["fit-a-line"].status.state.value == "Running"
+
+    kube.complete_pods("fit-a-line")
+    ctrl.run_once()
+    assert ctrl.jobs["fit-a-line"].status.state.value == "Succeed"
 
 
 def test_kubectl_api_surface(fake_cluster):

@@ -1,0 +1,31 @@
+# Trainer/controller image: the manifests rendered by
+# edl_tpu/controller/jobparser.py reference edl-tpu/trainer:latest
+# (resource/training_job.py DEFAULT_IMAGE).  Build with:
+#
+#   docker build -t edl-tpu/trainer:latest .
+#
+# One image serves every role — trainer pods (launcher), coordinator
+# Deployments (cli coordinator), and the controller daemon — selected
+# by the command the manifest sets (ref analog: a single Go binary
+# image, /root/reference/Dockerfile:1-9).
+#
+# Base: upstream JAX TPU image keeps libtpu/jax in lockstep; swap the
+# tag to pin versions.
+FROM python:3.12-slim
+
+WORKDIR /opt/edl-tpu
+
+# TPU wheels live on the libtpu index; CPU-only builds (CI, controller
+# nodes) work with the same install because jax[tpu] degrades to CPU
+# when no TPU is attached.
+RUN pip install --no-cache-dir "jax[tpu]" flax optax \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+COPY pyproject.toml README.md ./
+COPY edl_tpu ./edl_tpu
+RUN pip install --no-cache-dir .
+
+# Trainer pods override via the TrainingJob spec entrypoint; default is
+# the CLI (controller/coordinator roles pass their subcommand).
+ENTRYPOINT ["edl"]
+CMD ["--help"]

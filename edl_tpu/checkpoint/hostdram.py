@@ -117,7 +117,12 @@ class HostDRAMStore:
                 return l
             if not l.is_fully_addressable:
                 if l.is_fully_replicated:
-                    return l  # device_get fetches the local replica
+                    # Owned copy under the leaf's own sharding: returning
+                    # ``l`` itself races the step loop, which donates the
+                    # buffer into the next step while the background
+                    # device_get is still in flight (the copy is a fresh
+                    # buffer XLA cannot alias — no donation was declared).
+                    return jax.jit(lambda a: a, out_shardings=l.sharding)(l)
                 mesh = l.sharding.mesh
                 return jax.jit(
                     lambda a: a,

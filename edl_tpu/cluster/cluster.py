@@ -41,6 +41,9 @@ class Cluster:
                 cpu_idle_milli={n.name: n.cpu_milli for n in nodes},
                 memory_free_mega={n.name: n.memory_mega for n in nodes},
                 tpu_free={n.name: n.tpu_chips for n in nodes},
+                pool_topology={
+                    n.name: n.tpu_topology for n in nodes if n.tpu_topology
+                },
             ),
         )
         for n in nodes:

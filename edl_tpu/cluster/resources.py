@@ -27,6 +27,11 @@ class Nodes:
     cpu_idle_milli: Dict[str, int] = field(default_factory=dict)
     memory_free_mega: Dict[str, int] = field(default_factory=dict)
     tpu_free: Dict[str, int] = field(default_factory=dict)
+    #: Slice topology each pool schedules (e.g. "v5e-8", from the GKE
+    #: node label) — empty/absent = untyped chip pool (tests, CPU).  A
+    #: replica's slice must match the pool's topology: 16 free chips
+    #: spread over two v5e-8 pools cannot host one v5e-16 replica.
+    pool_topology: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

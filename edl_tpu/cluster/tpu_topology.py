@@ -63,6 +63,23 @@ def legal_topologies() -> List[str]:
     return sorted(_TOPOLOGIES, key=lambda n: _TOPOLOGIES[n].chips)
 
 
+def normalize_topology(name: str):
+    """Resolve either a framework topology name ("v5e-8") or a GKE
+    ``cloud.google.com/gke-tpu-topology`` label value ("2x4") to a
+    SliceTopology; None if unrecognized."""
+    if name in _TOPOLOGIES:
+        return _TOPOLOGIES[name]
+    if "x" in name:
+        try:
+            mesh = tuple(int(p) for p in name.split("x"))
+        except ValueError:
+            return None
+        for t in _TOPOLOGIES.values():
+            if t.ici_mesh == mesh:
+                return t
+    return None
+
+
 def largest_topology_fitting(chips: int) -> SliceTopology:
     """Largest legal slice with at most ``chips`` chips."""
     best = _TOPOLOGIES["cpu"]

@@ -26,7 +26,11 @@ from edl_tpu.resource.training_job import TrainingJob
 
 #: env override for the coordinator address template
 ADDR_TEMPLATE_ENV = "EDL_COORD_ADDR_TEMPLATE"
-DEFAULT_ADDR_TEMPLATE = "{name}:{port}"
+#: Namespace-qualified Service DNS: the controller watches CRs
+#: cluster-wide (``kubectl get -A``), so a bare ``{name}`` would
+#: resolve against the controller pod's own namespace and the
+#: handshake would silently never reach jobs elsewhere.
+DEFAULT_ADDR_TEMPLATE = "{name}.{namespace}:{port}"
 
 
 def coordinator_address(job: TrainingJob) -> str:

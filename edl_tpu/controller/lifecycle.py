@@ -70,6 +70,34 @@ class JobLifecycle:
                 self._sleep(ENSURE_PAUSE_SECONDS)
         return False
 
+    # -- spec update --------------------------------------------------------
+    def refresh(self, job: TrainingJob) -> bool:
+        """Spec changed on a live job: re-render and re-apply its
+        manifests so image/resource/env changes actually reach the
+        running workload (the reference applied spec updates to the
+        autoscaler's view only).  The actuated parallelism is preserved
+        (clamped into the new [min, max]) so a spec edit doesn't stomp
+        the autoscaler's plan."""
+        from edl_tpu.controller.jobparser import (
+            parse_to_coordinator,
+            parse_to_trainer,
+        )
+
+        try:
+            cur = self.cluster.get_trainer_workload(job)
+            trainer = parse_to_trainer(job)
+            if cur is not None:
+                p = max(
+                    job.spec.trainer.min_instance,
+                    min(cur.parallelism, job.spec.trainer.max_instance),
+                )
+                trainer["spec"]["parallelism"] = p
+            self.cluster.kube.apply_manifests([trainer])
+            self.cluster.kube.apply_manifests(parse_to_coordinator(job))
+            return True
+        except Exception:
+            return False
+
     # -- teardown -----------------------------------------------------------
     def complete(self, job: TrainingJob) -> None:
         """Job finished: drop the coordinator, keep the trainer workload

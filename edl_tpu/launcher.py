@@ -67,18 +67,40 @@ def force_platform(platform: str) -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
-def make_world_builder(trainer_id: str) -> Callable:
+#: Per-generation coordination ports rotate through this window above
+#: the pod's base port.  Wide enough that a port recurs only after
+#: hundreds of generations (no TIME_WAIT collisions on fast churn);
+#: bounded so the k8s container port range stays declarable.
+_PORT_WINDOW = 2048
+#: Formation attempts per generation.  Every member derives the SAME
+#: port sequence (f(generation, attempt)), so a bind failure on the new
+#: rank 0 (stray listener, straggler socket) resolves by all members
+#: timing out in lockstep and retrying on the next port — agreement
+#: with no extra round-trip.
+_FORMATION_ATTEMPTS = 3
+_FORMATION_TIMEOUT_S = 30
+
+
+def make_world_builder(
+    trainer_id: str, formation_log: Optional[Callable] = None
+) -> Callable:
     """Build the multi-pod world (re)formation hook.
 
     Each generation's process group is a fresh ``jax.distributed``
     world: coordinator = new rank 0's advertised host, port derived
-    deterministically from the generation so every member picks the
-    same one with no extra round-trip.  Teardown before re-init is what
-    makes elasticity possible — XLA collectives cannot span worlds, so
-    membership change means "re-form the world", the direct analog of
-    the reference trainers re-registering through master/etcd
+    deterministically from (generation, attempt) so every member picks
+    the same one with no extra round-trip.  Teardown before re-init is
+    what makes elasticity possible — XLA collectives cannot span
+    worlds, so membership change means "re-form the world", the direct
+    analog of the reference trainers re-registering through master/etcd
     (``pkg/jobparser.go:174-191``).
+
+    ``formation_log``: optional callback receiving a timing dict per
+    formation (teardown/init breakdown — the <60s resize budget's
+    dominant unknown at scale, BASELINE.md).
     """
+    import time as _time
+
     import jax
 
     def teardown():
@@ -102,7 +124,9 @@ def make_world_builder(trainer_id: str) -> Callable:
             clear_backends()
 
     def build(plan):
+        t0 = _time.perf_counter()
         teardown()
+        t_teardown = _time.perf_counter() - t0
         if trainer_id not in plan.members:
             return None  # standby: not part of this generation's world
         if not plan.addresses or not all(plan.addresses):
@@ -113,18 +137,41 @@ def make_world_builder(trainer_id: str) -> Callable:
             )
         rank = plan.members.index(trainer_id)
         host, base = plan.addresses[0].rsplit(":", 1)
-        port = int(base) + 1 + (plan.generation % 64)
-        jax.distributed.initialize(
-            coordinator_address=f"{host}:{port}",
-            num_processes=plan.world_size,
-            process_id=rank,
-            initialization_timeout=120,
-            # Keep the teardown barrier short: scale-down peers leave
-            # at their own pace, and a standby pod must not block 300s
-            # (the default) in shutdown before it can hold.
-            shutdown_timeout_seconds=10,
-        )
-        return jax.devices()
+        t1 = _time.perf_counter()
+        for attempt in range(_FORMATION_ATTEMPTS):
+            port = int(base) + 1 + (
+                (plan.generation * _FORMATION_ATTEMPTS + attempt)
+                % _PORT_WINDOW
+            )
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=f"{host}:{port}",
+                    num_processes=plan.world_size,
+                    process_id=rank,
+                    initialization_timeout=_FORMATION_TIMEOUT_S,
+                    # Keep the teardown barrier short: scale-down peers
+                    # leave at their own pace, and a standby pod must
+                    # not block 300s (the default) in shutdown before
+                    # it can hold.
+                    shutdown_timeout_seconds=10,
+                )
+                break
+            except Exception:
+                teardown()  # drop any half-initialized state
+                if attempt == _FORMATION_ATTEMPTS - 1:
+                    raise
+        devices = jax.devices()
+        if formation_log is not None:
+            formation_log(
+                {
+                    "generation": plan.generation,
+                    "world_size": plan.world_size,
+                    "rank": rank,
+                    "teardown_s": round(t_teardown, 4),
+                    "init_s": round(_time.perf_counter() - t1, 4),
+                }
+            )
+        return devices
 
     return build
 
@@ -177,13 +224,26 @@ def run(
     heartbeat_ids = [trainer_id]
     sigterm_handler = [None]
 
+    hist_f = None
+    if history_file:
+        hist_f = open(history_file, "a", buffering=1)
+
     if addr:
         coordinator = HTTPCoordinator(addr)
         if pod_address:
             # Multi-pod: each generation re-forms the JAX process group
             # from the plan's rank-ordered addresses.  Device queries
             # must wait for world formation.
-            raw_builder = make_world_builder(trainer_id)
+            formation_log = None
+            if hist_f is not None:
+                def formation_log(stats):
+                    import json
+
+                    hist_f.write(json.dumps({"formation": stats}) + "\n")
+
+            raw_builder = make_world_builder(
+                trainer_id, formation_log=formation_log
+            )
 
             def world_builder(plan):
                 devs = raw_builder(plan)
@@ -262,8 +322,7 @@ def run(
     prev_term = signal.signal(signal.SIGTERM, _graceful_leave)
 
     on_step = None
-    if history_file:
-        hist_f = open(history_file, "a", buffering=1)
+    if hist_f is not None:
 
         def on_step(rec):
             import json

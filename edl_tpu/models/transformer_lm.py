@@ -84,7 +84,10 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, tokens):  # [B, T] int32
+    def __call__(self, tokens, return_features: bool = False):
+        """tokens: [B, T] int32.  Returns [B, T, V] logits, or the
+        pre-projection [B, T, D] features when ``return_features``
+        (the chunked-loss path, ``ops/losses.tied_vocab_xent``)."""
         T = tokens.shape[1]
         embed = nn.Embed(
             self.vocab_size,
@@ -108,7 +111,17 @@ class TransformerLM(nn.Module):
                 name=f"layer_{i}",
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
-        return embed.attend(x.astype(jnp.float32))
+        if return_features:
+            return x
+        # Weight-tied projection in bf16 with f32 MXU accumulation (an
+        # f32 [*, vocab] matmul runs far below bf16 peak; see
+        # models/transformer.py).
+        return jnp.einsum(
+            "btd,vd->btv",
+            x.astype(self.dtype),
+            embed.embedding.astype(self.dtype),
+            preferred_element_type=jnp.float32,
+        )
 
 
 def _partition_rules(params) -> Any:
@@ -165,13 +178,16 @@ def transformer_lm(
         return module.init(rng, sample)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        from edl_tpu.ops.losses import tied_vocab_xent
+
         tokens = batch["tokens"]
-        logits = module.apply({"params": params}, tokens[:, :-1])
         labels = tokens[:, 1:]
-        mask = (labels != 0).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        x = module.apply(
+            {"params": params}, tokens[:, :-1], return_features=True
+        )
+        loss, _ = tied_vocab_xent(
+            x, params["embed"]["embedding"], labels, labels != 0
+        )
         return loss, {"loss": loss}
 
     def synth_batch(rng: np.random.RandomState, n: int):

@@ -5,22 +5,31 @@ from edl_tpu.ops.flash_attention import flash_attention
 from edl_tpu.ops.ring_attention import reference_attention, ring_attention
 
 
-#: Below this sequence length XLA's own attention fusion wins on TPU
-#: (measured on v5e: reference faster at T<=1024, flash 2.2x faster at
-#: 4096 and 45x at 8192 where the [T,T] scores thrash HBM).
+#: At or above this sequence length attention dispatches to the Pallas
+#: flash kernel on TPU.  Re-measured on v5e with the blockwise
+#: backward: XLA's fused attention is slightly faster fwd+bwd up
+#: through T=1024 (both are softmax/VPU-bound at head_dim 64), but its
+#: [B, H, T, T] f32 score tensor OOMs 16G HBM from T=2048 at training
+#: batch sizes — the crossover is *memory*, and flash is the only
+#: path that scales long-context.
 FLASH_MIN_SEQ_LEN = 2048
 
 
-def fused_attention(q, k, v, causal=False, scale=None):
+def fused_attention(q, k, v, causal=False, scale=None, kv_mask=None):
     """Best single-device attention for the current backend/shape: the
-    Pallas flash kernel on TPU at long context, XLA's fused reference
-    otherwise (the interpreter would be slow on CPU for no accuracy
-    gain, and XLA's fusion beats the kernel at short T)."""
+    Pallas flash kernel on TPU from moderate context up, XLA's fused
+    reference otherwise (the interpreter would be slow on CPU for no
+    accuracy gain, and XLA's fusion edges out the kernel at short T).
+
+    ``kv_mask``: optional [B, Tk] bool (True = attend), the padded-batch
+    contract shared by both implementations."""
     import jax
 
     if jax.default_backend() == "tpu" and q.shape[1] >= FLASH_MIN_SEQ_LEN:
-        return flash_attention(q, k, v, causal=causal, scale=scale)
-    return reference_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               kv_mask=kv_mask)
+    return reference_attention(q, k, v, causal=causal, scale=scale,
+                               kv_mask=kv_mask)
 
 
 __all__ = [

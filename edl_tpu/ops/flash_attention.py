@@ -1,4 +1,4 @@
-"""Flash attention as a Pallas TPU kernel.
+"""Flash attention as Pallas TPU kernels — forward AND backward.
 
 The single-device attention hot path: blockwise online-softmax so the
 [T, T] score matrix never materializes in HBM — scores live in VMEM one
@@ -10,11 +10,21 @@ distributes the sequence *across chips*; this kernel optimizes the
 *within-chip* block loop.  They compose: the ring's per-step local
 attention is exactly this computation.
 
-Backward: ``jax.custom_vjp`` with a recompute backward (standard
-flash-attention practice — residuals are O(T) stats, not O(T^2)
-scores); the backward math is expressed in plain jnp and fuses under
-XLA.  On non-TPU backends the kernel runs in Pallas interpret mode, so
-tests validate the identical code path on the CPU mesh.
+Backward: true blockwise kernels with saved residuals — the forward
+emits per-row logsumexp (O(T) stats in a 128-lane-broadcast layout, the
+standard TPU trick for per-row scalars), and two Pallas kernels
+recompute probabilities tile-by-tile to produce dQ and dK/dV.  The
+softmax-correction term delta = rowsum(dO * O) is computed in-kernel
+from the O/dO tiles, so nothing O(T^2) — and no extra stats array —
+ever hits HBM in either direction.
+
+Masking: ``causal`` masks by absolute position inside the kernel (and
+skips fully-masked K tiles); ``kv_mask`` ([B, Tk] bool, True = valid)
+handles padded batches so the kernel can serve the padded-seq2seq
+models (``models/transformer.py``) and not just LM stacks.
+
+On non-TPU backends the kernels run in Pallas interpret mode, so tests
+validate the identical code path on the CPU mesh.
 """
 
 from __future__ import annotations
@@ -24,6 +34,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:
@@ -32,6 +43,11 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+
+#: Trailing-lane width for per-row stats (TPU vector lane count): a
+#: [T] stat is stored [T, 128] broadcast so block shapes satisfy the
+#: (8, 128) tiling constraint (same layout as jax's own TPU kernels).
+LANES = 128
 
 
 def _pick_block(t: int, want: int) -> int:
@@ -42,11 +58,41 @@ def _pick_block(t: int, want: int) -> int:
     return b
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
-    qb = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+def _safe(m):
+    """Replace NEG_INF row-maxima with 0 so fully-masked rows produce
+    p == exp(NEG_INF - 0) == 0 instead of exp(0) == 1."""
+    return jnp.where(m <= NEG_INF / 2, 0.0, m)
+
+
+def _with_optional_mask(kernel, has_mask, n_in):
+    """Adapt a kernel written with a mask_ref slot to a pallas_call that
+    may not pass one (mask absent -> mask_ref=None)."""
+    if has_mask:
+        return kernel
+
+    def wrapped(*refs):
+        ins, outs = refs[: n_in - 1], refs[n_in - 1 :]
+        return kernel(*ins, None, *outs)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+    *, scale, causal, block_k, kv_len, has_mask,
+):
+    # Dots take the refs' native dtype (bf16 in production) with f32 MXU
+    # accumulation — f32 operands would fall off the fast MXU path and
+    # run several times slower.  Scale applies to the f32 product.
+    qb = q_ref[0]  # [block_q, D]
     block_q = qb.shape[0]
     i = pl.program_id(1)
-    num_k = seq_len // block_k
+    num_k = kv_len // block_k
 
     q_pos = i * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, 1), 0
@@ -54,9 +100,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
 
     def body(j, carry):
         acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
             qb,
             kb,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -67,12 +113,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
                 jnp.int32, (1, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_mask:
+            valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0  # [1, bk]
+            s = jnp.where(valid, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [bq,1]
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)  # [bq,1]
+        m_use = _safe(m_new)
+        p = jnp.exp(s - m_use)
+        alpha = jnp.exp(_safe(m) - m_use)  # [bq,1]
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p,
+            p.astype(vb.dtype),
             vb,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -92,68 +142,313 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k, seq_len):
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse = _safe(m) + jnp.log(l_safe)  # [bq, 1]
+    lse_ref[0] = jax.lax.broadcast_in_dim(
+        lse.reshape(block_q), (block_q, LANES), (0,)
+    )
 
 
-def _flash_fwd_3d(q, k, v, causal, scale, block_q, block_k, interpret):
-    """q, k, v: [BH, T, D] -> [BH, T, D]."""
-    bh, t, d = q.shape
+def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    """q: [BH, Tq, D]; k, v: [BH, Tk, D]; mask: [B, Tk] int32 or None.
+
+    Returns (o [BH, Tq, D], lse [BH, Tq, LANES] f32, lane-broadcast)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    has_mask = mask is not None
     kernel = functools.partial(
         _fwd_kernel,
         scale=scale,
         causal=causal,
         block_k=block_k,
-        seq_len=t,
+        kv_len=tk,
+        has_mask=has_mask,
     )
-    grid = (bh, t // block_q)
+    grid = (bh, tq // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+    ]
+    args = [q, k, v]
+    if has_mask:
+        heads = bh // mask.shape[0]
+        in_specs.append(
+            pl.BlockSpec((1, 1, tk), lambda b, i, h=heads: (b // h, 0, 0))
+        )
+        args.append(mask)
     return pl.pallas_call(
-        kernel,
+        _with_optional_mask(kernel, has_mask, n_in=4),
         grid=grid,
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
         ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, tq, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _row_stat(ref2d):
+    """Collapse a lane-broadcast [rows, LANES] stat tile to [rows, 1]
+    (all lanes hold the same value; a lane reduction is the portable
+    way to read one back)."""
+    return jnp.max(ref2d, axis=-1, keepdims=True)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref, dq_ref,
+    *, scale, causal, block_k, kv_len, has_mask,
+):
+    qb = q_ref[0]  # [bq, D] — native dtype into the dots (see _fwd_kernel)
+    ob = o_ref[0].astype(jnp.float32)
+    dob = do_ref[0]
+    dob_f32 = dob.astype(jnp.float32)
+    block_q = qb.shape[0]
+    i = pl.program_id(1)
+    num_k = kv_len // block_k
+    lse = _row_stat(lse_ref[0])  # [bq, 1]
+    delta = jnp.sum(dob_f32 * ob, axis=-1, keepdims=True)  # [bq, 1]
+
+    q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            qb, kb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_mask:
+            valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]; masked -> exp(NEG_INF - lse) == 0
+        dp = jax.lax.dot_general(
+            dob, vb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = (p * (dp - delta)).astype(kb.dtype)
+        return acc + jax.lax.dot_general(
+            ds, kb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
+    else:
+        upper = num_k
+    d = q_ref.shape[-1]
+    acc = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
+    dk_ref, dv_ref,
+    *, scale, causal, block_q, q_len, has_mask,
+):
+    kb = k_ref[0]  # [bk, D] — native dtype into the dots (see _fwd_kernel)
+    vb = v_ref[0]
+    block_k = kb.shape[0]
+    j = pl.program_id(1)
+    num_q = q_len // block_q
+
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    if has_mask:
+        valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0  # [1, bk]
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :]
+        ob = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = _row_stat(lse_ref[0, pl.ds(i * block_q, block_q), :])
+        delta = jnp.sum(
+            dob.astype(jnp.float32) * ob, axis=-1, keepdims=True
+        )  # [bq, 1]
+        s = scale * jax.lax.dot_general(
+            qb, kb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if has_mask:
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [bq, bk]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(dob.dtype), dob,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dp = jax.lax.dot_general(
+            dob, vb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        ds = (p * (dp - delta)).astype(qb.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qb,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        return dk_acc, dv_acc
+
+    if causal:
+        # Q blocks strictly before this K block's first position are
+        # fully masked (q_pos < k_pos everywhere): skip them.
+        lower = (j * block_k) // block_q
+    else:
+        lower = 0
+    d = k_ref.shape[-1]
+    zeros = jnp.zeros((block_k, d), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(lower, num_q, body, (zeros, zeros))
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _flash_bwd_3d(
+    q, k, v, o, lse, do, mask, causal, scale, block_q, block_k, interpret
+):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    has_mask = mask is not None
+    heads = bh // mask.shape[0] if has_mask else 1
+    mask_spec_full = pl.BlockSpec((1, 1, tk), lambda b, i, h=heads: (b // h, 0, 0))
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel,
+        scale=scale, causal=causal, block_k=block_k, kv_len=tk,
+        has_mask=has_mask,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # q
+        pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),           # k
+        pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),           # v
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # o
+        pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # do
+        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),  # lse
+    ]
+    args = [q, k, v, o, do, lse]
+    if has_mask:
+        in_specs.append(mask_spec_full)
+        args.append(mask)
+    dq = pl.pallas_call(
+        _with_optional_mask(dq_kernel, has_mask, n_in=7),
+        grid=(bh, tq // block_q),
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
 
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _run(q, k, v, causal, scale, block_q, block_k, interpret)
-
-
-def _run(q, k, v, causal, scale, block_q, block_k, interpret):
-    b, t, h, d = q.shape
-    to3 = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out = _flash_fwd_3d(
-        to3(q), to3(k), to3(v), causal, scale, block_q, block_k, interpret
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel,
+        scale=scale, causal=causal, block_q=block_q, q_len=tq,
+        has_mask=has_mask,
     )
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    in_specs = [
+        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # q
+        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),      # k
+        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),      # v
+        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # o
+        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # do
+        pl.BlockSpec((1, tq, LANES), lambda b, j: (b, 0, 0)),       # lse
+    ]
+    args = [q, k, v, o, do, lse]
+    if has_mask:
+        in_specs.append(mask_spec_full)
+        args.append(mask)
+    dk, dv = pl.pallas_call(
+        _with_optional_mask(dkv_kernel, has_mask, n_in=7),
+        grid=(bh, tk // block_k),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(*args)
+    return dq, dk, dv
 
 
-def _flash_ref(q, k, v, causal, scale):
-    """Recompute oracle for the backward pass (plain jnp; XLA fuses)."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    w = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing
+# ---------------------------------------------------------------------------
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _run(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    out, _ = _run(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+    return out
+
+
+def _to3(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from3(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _run(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    out3, lse = _flash_fwd_3d(
+        _to3(q), _to3(k), _to3(v), mask, causal, scale, block_q, block_k,
+        interpret,
+    )
+    return _from3(out3, b, h), (out3, lse)
+
+
+def _flash_fwd_rule(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+    out, (out3, lse) = _run(
+        q, k, v, mask, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out3, lse, mask)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _flash_ref(q, k, v, causal, scale), q, k, v)
-    return vjp(g.astype(jnp.float32) if g.dtype != q.dtype else g)
+    q, k, v, out3, lse, mask = res
+    b, t, h, d = q.shape
+    dq3, dk3, dv3 = _flash_bwd_3d(
+        _to3(q), _to3(k), _to3(v), out3, lse, _to3(g.astype(q.dtype)),
+        mask, causal, scale, block_q, block_k, interpret,
+    )
+    dmask = (
+        None
+        if mask is None
+        else np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    )
+    return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h), dmask
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -165,19 +460,25 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [B, T, H, D] tensors.
 
-    ``interpret=None`` auto-selects: real kernel on TPU, Pallas
-    interpreter elsewhere (tests on the CPU mesh take this path)."""
+    ``kv_mask``: optional [B, Tk] bool (True = attend) for padded
+    batches.  ``interpret=None`` auto-selects: real kernel on TPU,
+    Pallas interpreter elsewhere (tests on the CPU mesh take this
+    path)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    t = q.shape[1]
-    block_q = _pick_block(t, block_q)
-    block_k = _pick_block(t, block_k)
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret)
+    tq, tk = q.shape[1], k.shape[1]
+    if causal and tq != tk:
+        raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
+    block_q = _pick_block(tq, block_q)
+    block_k = _pick_block(tk, block_k)
+    mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
+    return _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret)

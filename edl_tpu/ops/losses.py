@@ -1,0 +1,88 @@
+"""Memory-fused losses for large-vocabulary models.
+
+The naive tied-softmax cross entropy materializes [B, T, V] logits in
+HBM (f32: gigabytes at training batch sizes) and then reads them twice
+more (logsumexp + gather) — at transformer-base scale that HBM traffic,
+not FLOPs, dominates the step.  ``tied_vocab_xent`` computes the same
+loss in row chunks under ``jax.checkpoint``: the vocab projection, the
+logsumexp and the label gather happen per chunk and the logits of a
+chunk die in registers/VMEM before the next chunk starts.  Backward
+rematerializes each chunk's logits (one extra vocab matmul — FLOPs are
+cheap here, bytes are not) and accumulates dE across chunks via the
+scan's closed-over embedding.
+
+The reference has no loss code at all (training was external to the
+controller repo, SURVEY.md §0); this is trainer-half infrastructure the
+TPU rebuild owns.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tied_vocab_xent(
+    features: jax.Array,
+    embedding: jax.Array,
+    labels: jax.Array,
+    valid: jax.Array,
+    chunk_rows: int = 8192,
+    compute_dtype=jnp.bfloat16,
+    with_accuracy: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Softmax cross entropy against a weight-tied vocab projection.
+
+    features:  [B, T, D] pre-projection activations (any float dtype).
+    embedding: [V, D] tied embedding table.
+    labels:    [B, T] int32 target ids.
+    valid:     [B, T] bool/float — 1 where the token counts.
+
+    Returns (mean_nll, mean_accuracy) over valid tokens.  The vocab
+    matmul runs with ``compute_dtype`` operands and f32 MXU
+    accumulation (an f32 [*, V] matmul runs far below bf16 peak).
+    """
+    b, t, d = features.shape
+    n = b * t
+    y = features.reshape(n, d)
+    lab = labels.reshape(n).astype(jnp.int32)
+    val = valid.reshape(n).astype(jnp.float32)
+
+    c = min(chunk_rows, n)
+    pad = (-n) % c
+    if pad:
+        y = jnp.pad(y, ((0, pad), (0, 0)))
+        lab = jnp.pad(lab, (0, pad))
+        val = jnp.pad(val, (0, pad))  # pads are invalid -> contribute 0
+    chunks = (n + pad) // c
+    y = y.reshape(chunks, c, d)
+    lab = lab.reshape(chunks, c)
+    val = val.reshape(chunks, c)
+
+    emb = embedding.astype(compute_dtype)
+
+    def one_chunk(carry, xs):
+        loss_sum, correct_sum = carry
+        yc, lc, vc = xs  # [c, D], [c], [c]
+        logits = jnp.einsum(
+            "cd,vd->cv",
+            yc.astype(compute_dtype),
+            emb,
+            preferred_element_type=jnp.float32,
+        )  # [c, V] — lives only inside this chunk
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        label_logit = jnp.take_along_axis(logits, lc[:, None], axis=-1)[:, 0]
+        loss_sum = loss_sum + ((lse - label_logit) * vc).sum()
+        if with_accuracy:
+            correct = (jnp.argmax(logits, axis=-1) == lc).astype(jnp.float32)
+            correct_sum = correct_sum + (correct * vc).sum()
+        return (loss_sum, correct_sum), None
+
+    (loss_sum, correct_sum), _ = jax.lax.scan(
+        jax.checkpoint(one_chunk), (jnp.float32(0), jnp.float32(0)),
+        (y, lab, val),
+    )
+    denom = jnp.maximum(val.sum(), 1.0)
+    return loss_sum / denom, correct_sum / denom

@@ -156,15 +156,28 @@ def ring_attention(
 
 def reference_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
-    scale: Optional[float] = None,
+    scale: Optional[float] = None, kv_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Single-device exact attention (the correctness oracle)."""
+    """Single-device exact attention (the correctness oracle).
+
+    ``kv_mask``: optional [B, Tk] bool (True = attend) for padded
+    batches — same contract as ``flash_attention``."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    # f32 MXU accumulation straight out of the dot: without it the
+    # scores materialize in the input dtype and get re-written as f32
+    # by the softmax cast — one extra full [B,H,T,T] HBM pass.
+    s = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
     if causal:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         s = jnp.where(mask[None, None], s, NEG_INF)
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :], s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)

@@ -147,6 +147,12 @@ class ElasticTrainer:
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
 
+        # Opt-in device tracing (EDL_PROFILE_DIR; SURVEY.md §5.1 —
+        # the reference had no tracing at all).
+        from edl_tpu.utils.profiling import StepProfiler
+
+        self.profiler = StepProfiler()
+
     # -- trainer cache ------------------------------------------------------
     def _trainer_for(self, world_size: int) -> Trainer:
         tr = self._trainers.get(world_size)
@@ -235,6 +241,8 @@ class ElasticTrainer:
         self._standby = True
 
     def _resize(self, plan: ElasticPlan) -> bool:
+        from edl_tpu.utils.profiling import annotate
+
         t0 = time.perf_counter()
         graceful = self.state is not None and self._can_flush_without_collectives()
 
@@ -242,35 +250,41 @@ class ElasticTrainer:
             # Flush a fresh checkpoint so no steps are lost.  Must land
             # before any world teardown: the state's device buffers die
             # with the old process group.
-            self._flush(plan.generation)
+            with annotate("resize/flush"):
+                self._flush(plan.generation)
 
         if self.world_builder is not None:
             self.state = None
-            if not self._rebuild_world(plan):
-                return False
+            with annotate("resize/world_formation"):
+                if not self._rebuild_world(plan):
+                    return False
 
-        trainer = self._trainer_for(plan.world_size)
-        self.mesh = trainer.mesh
+        with annotate("resize/remesh"):
+            trainer = self._trainer_for(plan.world_size)
+            self.mesh = trainer.mesh
 
-        if jax.process_count() > 1:
-            self.state, restored_step = self._restore_multiprocess(trainer)
-        else:
-            ckpt = self.store.latest()
-            if ckpt is None:
-                # Fresh job: initialize on the new mesh.
-                self.state = trainer.init_state()
-                restored_step = 0
+        with annotate("resize/restore"):
+            if jax.process_count() > 1:
+                self.state, restored_step = self._restore_multiprocess(trainer)
             else:
-                # Model-sharded states restore onto this mesh's actual
-                # layout (the re-sharding moment of SURVEY.md §7.4);
-                # pure-DP states replicate.
-                shardings = (
-                    trainer.state_shardings()
-                    if self.model.param_partition is not None
-                    else None
-                )
-                self.state = self.store.restore(ckpt, trainer.mesh, shardings)
-                restored_step = int(ckpt.step)
+                ckpt = self.store.latest()
+                if ckpt is None:
+                    # Fresh job: initialize on the new mesh.
+                    self.state = trainer.init_state()
+                    restored_step = 0
+                else:
+                    # Model-sharded states restore onto this mesh's
+                    # actual layout (the re-sharding moment of SURVEY.md
+                    # §7.4); pure-DP states replicate.
+                    shardings = (
+                        trainer.state_shardings()
+                        if self.model.param_partition is not None
+                        else None
+                    )
+                    self.state = self.store.restore(
+                        ckpt, trainer.mesh, shardings
+                    )
+                    restored_step = int(ckpt.step)
         replayed = max(0, self._last_completed_step - restored_step)
 
         self.generation = plan.generation
@@ -475,10 +489,13 @@ class ElasticTrainer:
             if step >= num_steps:
                 break
             trainer = self._trainers[self._world_size()]
+            self.profiler.maybe_start()
             t0 = time.perf_counter()
-            batch = self.data.device_batch(step, trainer.mesh)
-            self.state, metrics = trainer.step(self.state, batch)
-            loss = float(metrics["loss"])
+            with self.profiler.step(step):
+                batch = self.data.device_batch(step, trainer.mesh)
+                self.state, metrics = trainer.step(self.state, batch)
+                loss = float(metrics["loss"])
+            self.profiler.maybe_stop()
             rec = StepRecord(
                 step=step,
                 generation=self.generation,
@@ -497,6 +514,7 @@ class ElasticTrainer:
             ):
                 self.store.save_async(self.state, generation=self.generation)
                 self.coordinator.report_checkpoint(done_step)
+        self.profiler.stop()  # close any live trace at target step
         return self.history
 
     def _world_size(self) -> int:

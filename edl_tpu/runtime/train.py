@@ -55,11 +55,13 @@ class Trainer:
         mesh: Mesh,
         seed: int = 0,
         donate: bool = True,
+        metrics_grad_norm: bool = False,
     ):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.seed = seed
+        self.metrics_grad_norm = metrics_grad_norm
         self._base_rng = jax.random.key(seed)
 
         # Parameter shardings: model partition rules if provided, else
@@ -127,7 +129,11 @@ class Trainer:
             )
             metrics = dict(aux)
             metrics["loss"] = loss
-            metrics["grad_norm"] = optax.global_norm(grads)
+            if self.metrics_grad_norm:
+                # Off by default: a tree-wide norm is ~260 small
+                # reductions per step — measurable against the step
+                # itself (opt-in for debugging runs).
+                metrics["grad_norm"] = optax.global_norm(grads)
             return new_state, metrics
 
         donate_args = (0,) if donate else ()

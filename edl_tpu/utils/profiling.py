@@ -1,0 +1,92 @@
+"""Opt-in ``jax.profiler`` integration.
+
+The reference has no tracing at all (SURVEY.md §5.1: closest thing is
+debug logging of autoscaler dry runs).  The TPU rebuild's hot paths —
+the compiled train step and the resize window — get first-class device
+traces:
+
+- Set ``EDL_PROFILE_DIR=/some/dir`` (or pass ``profile_dir``) and the
+  elastic runtime captures a TensorBoard-loadable trace of the first
+  ``EDL_PROFILE_STEPS`` (default 10) steps after startup, with each
+  step wrapped in a ``StepTraceAnnotation`` and each resize phase in a
+  named ``TraceAnnotation`` so the trace viewer separates
+  flush/re-mesh/restore from stepping.
+- ``annotate(name)`` is a no-op-cheap context manager usable anywhere
+  in the runtime (it only touches the profiler when a trace is live).
+
+Nothing here activates unless the env var / argument is set: the
+default path adds one attribute check per step.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Optional
+
+
+class StepProfiler:
+    """Captures a bounded device trace of the training hot loop."""
+
+    def __init__(
+        self,
+        profile_dir: Optional[str] = None,
+        max_steps: Optional[int] = None,
+    ):
+        self.profile_dir = profile_dir or os.environ.get("EDL_PROFILE_DIR", "")
+        self.max_steps = (
+            max_steps
+            if max_steps is not None
+            else int(os.environ.get("EDL_PROFILE_STEPS", "10"))
+        )
+        self._live = False
+        self._steps_seen = 0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.profile_dir)
+
+    def maybe_start(self) -> None:
+        if not self.enabled or self._live or self._steps_seen > 0:
+            return
+        import jax
+
+        os.makedirs(self.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(self.profile_dir)
+        self._live = True
+
+    def step(self, step_num: int):
+        """Context for one train step; stops the trace after max_steps."""
+        if not self._live:
+            return _null_ctx()
+        import jax
+
+        self._steps_seen += 1
+        return jax.profiler.StepTraceAnnotation("train", step_num=step_num)
+
+    def maybe_stop(self) -> None:
+        if self._live and self._steps_seen >= self.max_steps:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._live:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._live = False
+
+
+@contextmanager
+def _null_ctx():
+    yield
+
+
+def annotate(name: str):
+    """Named trace region (resize phases, checkpoint flush, ...).
+    Free when no trace is live — jax's TraceMe is a no-op then."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -416,3 +416,52 @@ def test_jobview_from_trainingjob_defaults():
     assert v.mem_request_mega == 8192
     assert v.tpu_per_trainer == 8
     assert v.elastic
+
+
+# ---- slice-aware placement (SURVEY.md §7.1 row 2) ---------------------------
+
+
+def test_slice_shape_refuses_split_across_pools():
+    """16 free chips across two v5e-8 pools cannot host one v5e-16
+    replica: chips are only interchangeable within a slice's ICI."""
+    r = roomy_cluster(n_nodes=2, tpu=8)
+    r.nodes.pool_topology = {"node-0": "v5e-8", "node-1": "v5e-8"}
+    j = make_view(tpu=16, mn=1, mx=2)
+    j.slice_topology = "v5e-16"
+    assert search_assignable_node(r, j) is None
+    # A matching v5e-16 pool takes it.
+    r2 = roomy_cluster(n_nodes=1, tpu=16)
+    r2.nodes.pool_topology = {"node-0": "v5e-16"}
+    assert search_assignable_node(r2, j) == "node-0"
+
+
+def test_slice_topology_match_by_chip_count_for_untyped_jobs():
+    """A JobView without a declared topology still refuses pools whose
+    slice unit differs from its per-replica chip count."""
+    r = roomy_cluster(n_nodes=1, tpu=16)
+    r.nodes.pool_topology = {"node-0": "v5e-8"}
+    j8 = make_view(tpu=8, mn=1, mx=2)
+    j4 = make_view(tpu=4, mn=1, mx=2)
+    assert search_assignable_node(r, j8) == "node-0"
+    assert search_assignable_node(r, j4) is None  # 4-chip replica, 8-chip slices
+
+
+def test_slice_aware_dry_run_refuses_cross_pool_growth():
+    """End-to-end through scale_dry_run: the step is refused when no
+    single pool can host the replica's slice, even with enough total
+    free chips."""
+    r = roomy_cluster(n_nodes=2, tpu=8)
+    r.nodes.pool_topology = {"node-0": "v5e-8", "node-1": "v5e-8"}
+    j = make_view(tpu=16, mn=1, mx=2, parallelism=1)
+    j.slice_topology = "v5e-16"
+    assert scale_dry_run(r, j, 0) == 0
+
+
+def test_over_max_clamp_lands_on_legal_size():
+    """An over-max job clamps to the largest LEGAL size, not bare
+    max_instance (which may not be in legal_sizes)."""
+    r = roomy_cluster()
+    j = make_view(mn=1, mx=6, parallelism=8, legal=[1, 2, 4])
+    # scale-up pass clamps over-max plans
+    delta = scale_dry_run(r, j, 0, scale_down=False)
+    assert j.parallelism + delta == 4  # not 6

@@ -273,3 +273,37 @@ def test_heartbeats_keep_members_alive_under_eviction():
     _t.sleep(0.3)
     et.run(12)
     assert coord.evict_dead() == ["tr1"]
+
+
+def test_step_profiler_captures_trace(tmp_path, monkeypatch):
+    """EDL_PROFILE_DIR triggers a bounded jax.profiler trace of the hot
+    loop (SURVEY.md §5.1 — tracing the reference never had)."""
+    import os
+
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    monkeypatch.setenv("EDL_PROFILE_DIR", str(tmp_path / "trace"))
+    monkeypatch.setenv("EDL_PROFILE_STEPS", "3")
+    model = get_model("fit_a_line")
+    coord = LocalCoordinator(target_world=1, max_world=1)
+    coord.register("t0")
+    et = ElasticTrainer(
+        model,
+        optax.sgd(0.01),
+        ShardedDataIterator(
+            synthetic_dataset(model.synth_batch, 64), global_batch_size=8
+        ),
+        coord,
+        checkpoint_interval=0,
+    )
+    assert et.profiler.enabled
+    et.run(5)
+    produced = []
+    for root, _dirs, files in os.walk(tmp_path / "trace"):
+        produced += files
+    assert any(f.endswith(".xplane.pb") for f in produced), produced

@@ -26,7 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STEPS = 200_000
 
 
-def _read_history(path):
+def _read_lines(path):
     if not os.path.exists(path):
         return []
     out = []
@@ -39,6 +39,15 @@ def _read_history(path):
                 except json.JSONDecodeError:
                     pass  # partially written tail line
     return out
+
+
+def _read_history(path):
+    """Step records only (the launcher also logs formation timings)."""
+    return [r for r in _read_lines(path) if "step" in r]
+
+
+def _read_formations(path):
+    return [r["formation"] for r in _read_lines(path) if "formation" in r]
 
 
 def _wait_for(pred, timeout, what, procs=()):
@@ -173,6 +182,22 @@ def test_multipod_elastic_1_2_1(tmp_path):
         head = sum(r["loss"] for r in h1[:5]) / 5
         tail = sum(r["loss"] for r in h1[-5:]) / 5
         assert tail < head * 0.5, f"no convergence: head={head} tail={tail}"
+
+        # World formation is timed and bounded: every teardown+init must
+        # fit well inside the <60s resize budget (BASELINE.md) — the
+        # multi-pod formation path is its dominant unknown at scale.
+        formations = _read_formations(hist["w1"]) + _read_formations(
+            hist["w2"]
+        )
+        assert formations, "no formation timings recorded"
+        for f in formations:
+            total = f["teardown_s"] + f["init_s"]
+            print(
+                f"formation gen={f['generation']} world={f['world_size']} "
+                f"rank={f['rank']}: teardown={f['teardown_s']}s "
+                f"init={f['init_s']}s"
+            )
+            assert total < 15.0, f"world formation took {total}s: {f}"
 
         # The two pods agree on the overlapping (world=2) steps' losses:
         # one world, one loss stream — proof of a shared process group

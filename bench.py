@@ -97,57 +97,87 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
     }
 
 
-def bench_transformer_throughput(steps: int = 20) -> dict:
-    """Flagship transformer-base training-step throughput on the local
-    device(s): tokens/s and MFU vs v5e bf16 peak (197 TFLOP/s/chip)."""
+V5E_BF16_PEAK_PER_CHIP = 197e12
+
+
+def _timed_train_loop(model, batch_size: int, seq_len: int, steps: int) -> dict:
+    """Shared measurement harness: compile-warm, pre-staged device
+    batches, float(loss) sync at the timing boundaries.
+
+    Pre-staging matters on a tunneled platform where each
+    host->device transfer blocks ~15ms and would pollute the compute
+    number (production pipelines prefetch/overlap; the resize bench
+    covers the data path separately).  The float(loss) sync matters
+    because block_until_ready returns before device completion on the
+    tunnel and wildly under-measures."""
     import time
 
     import jax
     import optax
 
-    from edl_tpu.models.base import get_model
     from edl_tpu.parallel.mesh import dp_mesh
     from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
     from edl_tpu.runtime.train import Trainer
 
     n_dev = len(jax.devices())
-    on_tpu = jax.default_backend() == "tpu"
-    model = get_model("transformer_base", tiny=not on_tpu)
     mesh = dp_mesh(n_dev)
     trainer = Trainer(model, optax.adamw(1e-4), mesh)
     state = trainer.init_state()
-    batch_size = 64 * n_dev if on_tpu else 2 * n_dev
     data = ShardedDataIterator(
         synthetic_dataset(model.synth_batch, max(64, 2 * batch_size)),
         global_batch_size=batch_size,
     )
-    # Pre-stage the measured batches on device: host->device transfer
-    # on a tunneled platform blocks ~15ms per call and would pollute
-    # the compute number (production pipelines prefetch/overlap; the
-    # resize bench covers the data path separately).
     batches = [data.device_batch(s, mesh) for s in range(steps + 1)]
     jax.block_until_ready(batches)
-    # Warm up compile.  NOTE: timing boundaries force a device->host
-    # read (float(loss)) — on tunneled platforms block_until_ready
-    # returns before device completion and wildly under-measures.
-    state, metrics = trainer.step(state, batches[0])
+    state, metrics = trainer.step(state, batches[0])  # compile warm-up
     float(metrics["loss"])
     t0 = time.perf_counter()
     for s in range(1, steps + 1):
         state, metrics = trainer.step(state, batches[s])
     float(metrics["loss"])  # sync: the whole chain must have executed
     dt = (time.perf_counter() - t0) / steps
-    seq_len = data.dataset["src"].shape[1]
-    tokens_per_s = batch_size * seq_len / dt
-    flops_per_s = model.flops_per_example * batch_size / dt
-    peak = 197e12 * n_dev  # v5e bf16 peak per chip
+    on_tpu = jax.default_backend() == "tpu"
+    peak = V5E_BF16_PEAK_PER_CHIP * n_dev
     return {
         "step_s": dt,
-        "tokens_per_s": tokens_per_s,
-        "mfu": flops_per_s / peak if on_tpu else 0.0,
+        "tokens_per_s": batch_size * seq_len / dt,
+        "mfu": model.flops_per_example * batch_size / dt / peak
+        if on_tpu
+        else 0.0,
         "batch": batch_size,
         "seq_len": seq_len,
     }
+
+
+def bench_transformer_throughput(steps: int = 20) -> dict:
+    """Flagship transformer-base training-step throughput on the local
+    device(s): tokens/s and MFU vs v5e bf16 peak (197 TFLOP/s/chip)."""
+    import jax
+
+    from edl_tpu.models.base import get_model
+
+    n_dev = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    model = get_model("transformer_base", tiny=not on_tpu)
+    batch_size = 64 * n_dev if on_tpu else 2 * n_dev
+    seq_len = 256 if on_tpu else 32
+    return _timed_train_loop(model, batch_size, seq_len, steps)
+
+
+def bench_longcontext_lm(seq_len: int = 2048, batch: int = 8, steps: int = 8) -> dict:
+    """Decoder-only LM at long context on the Pallas flash-attention
+    path (XLA's fused attention OOMs here: its [B, H, T, T] f32 scores
+    alone exceed HBM at training batch sizes).  Evidence for the
+    long-context capability bar (SURVEY.md §5.7 — absent in the 2018
+    reference; first-class in the rebuild)."""
+    import jax
+
+    from edl_tpu.models.base import get_model
+
+    if jax.default_backend() != "tpu":
+        return {"skipped": "flash path is TPU-only"}
+    model = get_model("transformer_lm", seq_len=seq_len)
+    return _timed_train_loop(model, batch, seq_len, steps)
 
 
 def bench_cpu_cross_size(n_devices: int = 8) -> dict:
@@ -200,6 +230,7 @@ def _attempt(fn, label: str, retries: int = 1):
 def main():
     r = _attempt(bench_resize, "resize")
     thr = _attempt(bench_transformer_throughput, "transformer_base")
+    lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
     cross = _attempt(bench_cpu_cross_size, "cpu_cross_size", retries=0)
     if "error" in r:
         # The headline section itself died: emit an explicit error record
@@ -212,7 +243,7 @@ def main():
                     "unit": "s",
                     "vs_baseline": None,
                     "detail": {"error": r["error"], "transformer_base": thr,
-                               "cpu_cross_size": cross},
+                               "longcontext_lm": lc, "cpu_cross_size": cross},
                 }
             )
         )
@@ -240,6 +271,17 @@ def main():
                             "mfu": round(thr["mfu"], 4),
                             "batch": thr["batch"],
                             "seq_len": thr["seq_len"],
+                        }
+                    ),
+                    "longcontext_lm": (
+                        lc
+                        if ("error" in lc or "skipped" in lc)
+                        else {
+                            "step_s": round(lc["step_s"], 5),
+                            "tokens_per_s": round(lc["tokens_per_s"]),
+                            "mfu": round(lc["mfu"], 4),
+                            "batch": lc["batch"],
+                            "seq_len": lc["seq_len"],
                         }
                     ),
                     "cpu_cross_size": (

@@ -50,6 +50,8 @@ class Controller:
         self._coord_client = (
             coord_client_factory or self.autoscaler._coord_client
         )
+        #: spec updates whose manifest re-apply failed; retried per tick
+        self._pending_refresh: set = set()
 
     # -- event handlers (ref onAdd/onUpdate/onDelete, :110-147) --------------
     def on_add(self, job: TrainingJob) -> TrainingJob:
@@ -84,7 +86,13 @@ class Controller:
         if spec_changed:
             # Re-render + re-apply so image/resource changes reach the
             # running workload (parallelism preserved; VERDICT r2 weak #9).
-            self.lifecycle.refresh(job)
+            # A failed apply queues for level-triggered retry each tick —
+            # the next watch event carries the same spec, so the edge
+            # alone would lose the update forever.
+            if not self.lifecycle.refresh(job):
+                self._pending_refresh.add(job.name)
+            else:
+                self._pending_refresh.discard(job.name)
 
     def on_delete(self, job: TrainingJob) -> None:
         self.autoscaler.on_del(job)
@@ -150,7 +158,9 @@ class Controller:
             if w is None:
                 continue
             try:
-                coord = self._coord_client(job, timeout=1.0)
+                # Factory contract is job -> client (scaler.py docstring);
+                # keyword extras would break injected factories.
+                coord = self._coord_client(job)
                 m = coord.metrics()
                 if m.get("completed"):
                     self.mark_succeeded(job.name)
@@ -200,6 +210,10 @@ class Controller:
         # One pod-list snapshot serves both reconcile passes this tick.
         pods_by_job = self.cluster.job_pods_map()
         self.reconcile_status(pods_by_job)
+        for name in list(self._pending_refresh):
+            job = self.jobs.get(name)
+            if job is None or self.lifecycle.refresh(job):
+                self._pending_refresh.discard(name)
         self.autoscaler.run_once()
         self.reconcile_targets(pods_by_job)
 

@@ -96,6 +96,9 @@ class JobLifecycle:
             self.cluster.kube.apply_manifests(parse_to_coordinator(job))
             return True
         except Exception:
+            import traceback
+
+            traceback.print_exc()
             return False
 
     # -- teardown -----------------------------------------------------------

@@ -270,7 +270,7 @@ def _make(
         return module.init(rng, sample, sample)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        from edl_tpu.ops.losses import tied_vocab_xent
+        from edl_tpu.ops.losses import best_vocab_xent
 
         src, tgt = batch["src"], batch["tgt"]
         # Decoder consumes the full-length tgt (position i predicts
@@ -281,7 +281,7 @@ def _make(
         y = module.apply(
             {"params": params}, src, tgt, method=Transformer.features
         )
-        loss, acc = tied_vocab_xent(
+        loss, acc = best_vocab_xent(
             y[:, :-1], params["embed"]["embedding"], labels, labels != 0
         )
         return loss, {"loss": loss, "token_accuracy": acc}
@@ -303,10 +303,22 @@ def _make(
             tgt[i, 1 : ln + 1] = mapped[: L - 1]
         return {"src": src, "tgt": tgt}
 
-    # ~6 * active params * tokens (fwd+bwd), params dominated by layers
-    params_per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
-    approx_params = 2 * num_layers * params_per_layer + vocab_size * d_model
-    flops = 6 * approx_params * seq_len
+    # True executed matmul FLOPs per example, fwd+bwd (6 per MAC —
+    # 2 fwd, 4 bwd), PaLM-style: matmul params x tokens PLUS the
+    # attention score/PV terms (12*T^2*d per attention op).  The naive
+    # "6 * params * T" undercounts: decoder layers carry TWO attention
+    # blocks (self + cross = 8d^2/token, not 4d^2) and the T^2 terms
+    # are real MXU work.
+    T = seq_len
+    enc_tok = 4 * d_model * d_model + 2 * d_model * d_ff
+    dec_tok = 8 * d_model * d_model + 2 * d_model * d_ff
+    layer_flops = 6 * T * num_layers * (enc_tok + dec_tok)
+    # Attention score/PV terms: enc self + dec cross at full T^2
+    # (12*T^2*d each fwd+bwd), dec self CAUSAL at half (6*T^2*d) —
+    # consistent with transformer_lm's causal accounting.
+    attn_flops = (12 * 2 + 6) * num_layers * T * T * d_model
+    logits_flops = 6 * T * vocab_size * d_model
+    flops = layer_flops + attn_flops + logits_flops
 
     return ModelDef(
         name=name,

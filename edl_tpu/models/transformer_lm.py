@@ -178,14 +178,14 @@ def transformer_lm(
         return module.init(rng, sample)["params"]
 
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-        from edl_tpu.ops.losses import tied_vocab_xent
+        from edl_tpu.ops.losses import best_vocab_xent
 
         tokens = batch["tokens"]
         labels = tokens[:, 1:]
         x = module.apply(
             {"params": params}, tokens[:, :-1], return_features=True
         )
-        loss, _ = tied_vocab_xent(
+        loss, _ = best_vocab_xent(
             x, params["embed"]["embedding"], labels, labels != 0
         )
         return loss, {"loss": loss}
@@ -197,8 +197,14 @@ def transformer_lm(
         tokens = 3 + ((start - 3) + t) % (vocab - 3)
         return {"tokens": tokens.astype(np.int32)}
 
+    # True executed matmul FLOPs per example (see models/transformer.py):
+    # per-token layer matmuls + causal attention score/PV terms
+    # (causal halves the T^2 work) + the tied vocab projection.
     params_per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
-    flops = 6 * (layers * params_per_layer + vocab * d_model) * L
+    flops = (
+        6 * (layers * params_per_layer + vocab * d_model) * L
+        + 12 * layers * L * L * d_model // 2
+    )
     return ModelDef(
         name="transformer_lm",
         init_params=init_params,

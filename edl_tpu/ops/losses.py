@@ -86,3 +86,20 @@ def tied_vocab_xent(
     )
     denom = jnp.maximum(val.sum(), 1.0)
     return loss_sum / denom, correct_sum / denom
+
+
+def best_vocab_xent(
+    features: jax.Array,
+    embedding: jax.Array,
+    labels: jax.Array,
+    valid: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Best tied-vocab cross entropy for the current backend: the fused
+    Pallas kernels on TPU (logits never leave VMEM — ~2x faster at 32k
+    vocab), this module's chunked jnp path elsewhere (it doubles as the
+    correctness oracle in tests)."""
+    if jax.default_backend() == "tpu":
+        from edl_tpu.ops.fused_xent import fused_vocab_xent
+
+        return fused_vocab_xent(features, embedding, labels, valid)
+    return tied_vocab_xent(features, embedding, labels, valid)

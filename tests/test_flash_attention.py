@@ -61,3 +61,41 @@ def test_flash_under_jit_and_vmapless_batching():
     got = f(q, k, v)
     want = reference_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_backward_multi_tile_scratch_accumulation():
+    """Gradients with num_i > 1 Q tiles: exercises the merged backward's
+    cross-grid-step dK/dV scratch (i==0 zero-init, += across Q tiles,
+    flush at i == num_i - 1), with causal + kv_mask composed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from edl_tpu.ops.flash_attention import flash_attention
+    from edl_tpu.ops.ring_attention import reference_attention
+
+    rng = np.random.RandomState(3)
+    B, T, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    lens = rng.randint(T // 2, T, size=B)
+    kv_mask = jnp.asarray(np.arange(T)[None, :] < lens[:, None])
+
+    # block 64 on T=256 -> 4 Q tiles per (b, h): scratch accumulates
+    # across grid steps instead of living within one.
+    def loss_flash(q, k, v):
+        out = flash_attention(
+            q, k, v, causal=True, kv_mask=kv_mask, block_q=64, block_k=64
+        )
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out = reference_attention(q, k, v, causal=True, kv_mask=kv_mask)
+        return (out.astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, ("dq", "dk", "dv")):
+        err = float(jnp.max(jnp.abs(a - b)))
+        assert err < 1e-3, f"{name} mismatch: {err}"

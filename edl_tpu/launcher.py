@@ -67,6 +67,34 @@ def force_platform(platform: str) -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 
+def _install_nonfatal_heartbeat_callback() -> None:
+    """Patch the distributed-client factory to log coordination-service
+    failures instead of terminating the process (idempotent)."""
+    import sys
+
+    from jax._src import distributed as _dist
+
+    jaxlib = _dist._jax
+    if getattr(jaxlib, "_edl_nonfatal_heartbeats", False):
+        return
+
+    orig = jaxlib.get_distributed_runtime_client
+
+    def _log_only(status, *rest):
+        print(
+            f"[edl] coordination service reported failure (peer death?): "
+            f"{status}",
+            file=sys.stderr,
+        )
+
+    def patched(*args, **kwargs):
+        kwargs.setdefault("missed_heartbeat_callback", _log_only)
+        return orig(*args, **kwargs)
+
+    jaxlib.get_distributed_runtime_client = patched
+    jaxlib._edl_nonfatal_heartbeats = True
+
+
 #: Per-generation coordination ports rotate through this window above
 #: the pod's base port.  Wide enough that a port recurs only after
 #: hundreds of generations (no TIME_WAIT collisions on fast churn);
@@ -102,6 +130,17 @@ def make_world_builder(
     import time as _time
 
     import jax
+
+    # Defuse the coordination service's poison pill.  By default the
+    # distributed client's missed-heartbeat callback LOG(QFATAL)s the
+    # process when the service reports a peer failure OR when a
+    # disconnect can't reach the service — so one ungracefully-dead pod
+    # kills every survivor, and a torn-down generation can kill a
+    # leaver.  Elastic worlds must outlive their members: inject a
+    # log-only callback, so peer death surfaces as a *catchable*
+    # collective error in the step (handled by ElasticTrainer's
+    # broken-world path) instead of process termination.
+    _install_nonfatal_heartbeat_callback()
 
     def teardown():
         from jax._src import distributed

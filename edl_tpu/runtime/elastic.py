@@ -143,6 +143,13 @@ class ElasticTrainer:
         self._last_heartbeat = 0.0
         self._hb_thread = None
         self._hb_stop = None
+        #: set when the live process group broke mid-step (ungraceful
+        #: peer death): hold until the coordinator bumps the generation
+        self._await_new_generation = False
+        #: consecutive broken-world recoveries with no completed step:
+        #: above this the error is deterministic, not membership churn
+        self.max_world_failures: int = 3
+        self._world_failures = 0
 
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
@@ -251,7 +258,16 @@ class ElasticTrainer:
             # before any world teardown: the state's device buffers die
             # with the old process group.
             with annotate("resize/flush"):
-                self._flush(plan.generation)
+                try:
+                    self._flush(plan.generation)
+                except Exception:
+                    # State poisoned by a peer death between the last
+                    # step and this resize: degrade to the non-graceful
+                    # path (last interval checkpoint + replay).
+                    import traceback
+
+                    traceback.print_exc()
+                    graceful = False
 
         if self.world_builder is not None:
             self.state = None
@@ -408,6 +424,15 @@ class ElasticTrainer:
         )
         self._hb_thread.start()
 
+    def _world_broken(self) -> None:
+        """The live process group failed mid-step.  Drop every handle to
+        it and hold for a fresh generation (see maybe_resize)."""
+        self.state = None
+        self._trainers.clear()
+        self.mesh = None
+        self._await_new_generation = True
+        self._holding = True
+
     def stop_heartbeat(self):
         """Stop beating before deregistering.  Marks the trainer as
         leaving (an in-flight beat must not resurrect the membership)
@@ -428,10 +453,20 @@ class ElasticTrainer:
             # member's devices.
             self._holding = plan is not None and plan.generation != self.generation
             return False
+        if plan.generation != self.generation:
+            # A fresh generation supersedes any broken-world hold.
+            self._await_new_generation = False
         if plan.generation == self.generation and (
-            self.state is not None or self._standby
+            self.state is not None
+            or self._standby
+            or self._await_new_generation
         ):
-            self._holding = self._standby
+            # _await_new_generation: the current generation's process
+            # group broke under us (peer died mid-collective).  Re-forming
+            # the SAME plan would block on the dead member's address;
+            # hold cheaply until the lease reaper evicts it and bumps
+            # the generation.
+            self._holding = self._standby or self._await_new_generation
             return False
         if self.heartbeat_ids and not self._my_member_ids(plan):
             # Multi-pod scale-down: this pod dropped out of the world's
@@ -485,35 +520,69 @@ class ElasticTrainer:
             hold_started = None
             if self.state is None:
                 raise RuntimeError("no plan with world_size >= 1 available")
-            step = int(self.state.step)
-            if step >= num_steps:
-                break
-            trainer = self._trainers[self._world_size()]
-            self.profiler.maybe_start()
-            t0 = time.perf_counter()
-            with self.profiler.step(step):
-                batch = self.data.device_batch(step, trainer.mesh)
-                self.state, metrics = trainer.step(self.state, batch)
-                loss = float(metrics["loss"])
-            self.profiler.maybe_stop()
-            rec = StepRecord(
-                step=step,
-                generation=self.generation,
-                world_size=self._world_size(),
-                loss=loss,
-                seconds=time.perf_counter() - t0,
-            )
-            self.history.append(rec)
-            if on_step is not None:
-                on_step(rec)
-            done_step = step + 1
-            self._last_completed_step = max(self._last_completed_step, done_step)
-            if (
-                self.checkpoint_interval > 0
-                and done_step % self.checkpoint_interval == 0
-            ):
-                self.store.save_async(self.state, generation=self.generation)
-                self.coordinator.report_checkpoint(done_step)
+            try:
+                # The whole body is guarded: an async collective poisoned
+                # by a peer's ungraceful death can surface at ANY device
+                # access here (step read, the step itself, the loss sync,
+                # the checkpoint's device fetch) — not just inside
+                # trainer.step.
+                step = int(self.state.step)
+                if step >= num_steps:
+                    break
+                trainer = self._trainers[self._world_size()]
+                self.profiler.maybe_start()
+                t0 = time.perf_counter()
+                with self.profiler.step(step):
+                    batch = self.data.device_batch(step, trainer.mesh)
+                    self.state, metrics = trainer.step(self.state, batch)
+                    loss = float(metrics["loss"])
+                self.profiler.maybe_stop()
+                rec = StepRecord(
+                    step=step,
+                    generation=self.generation,
+                    world_size=self._world_size(),
+                    loss=loss,
+                    seconds=time.perf_counter() - t0,
+                )
+                self.history.append(rec)
+                if on_step is not None:
+                    on_step(rec)
+                done_step = step + 1
+                self._last_completed_step = max(
+                    self._last_completed_step, done_step
+                )
+                if (
+                    self.checkpoint_interval > 0
+                    and done_step % self.checkpoint_interval == 0
+                ):
+                    self.store.save_async(
+                        self.state, generation=self.generation
+                    )
+                    self.coordinator.report_checkpoint(done_step)
+                self._world_failures = 0  # a completed step resets the cap
+            except Exception:
+                if (
+                    self.world_builder is not None
+                    and self._world_size() > 1
+                    and self._world_failures < self.max_world_failures
+                ):
+                    # A peer died mid-collective (SIGKILL, preemption):
+                    # the process group is unusable but THIS process is
+                    # fine.  Survive it: drop the world, await the
+                    # eviction-bumped generation, resume from the last
+                    # checkpoint with deterministic replay (SURVEY.md
+                    # §5.3 — the reference delegated exactly this to
+                    # master/etcd re-registration).  Capped: repeated
+                    # failures with no completed step in between are a
+                    # deterministic bug, not churn — re-raise rather
+                    # than masking it behind a barrier hold.
+                    import traceback
+
+                    traceback.print_exc()
+                    self._world_failures += 1
+                    self._world_broken()
+                    continue
+                raise
         self.profiler.stop()  # close any live trace at target step
         return self.history
 

@@ -66,6 +66,41 @@ def _wait_for(pred, timeout, what, procs=()):
     raise AssertionError(f"timed out waiting for {what}")
 
 
+
+def _spawn_worker(procs, hist, name, base_port, caddr, checkpoint_interval=2):
+    """Launch one real launcher 'pod' subprocess against the HTTP
+    coordinator (shared by both multipod tests)."""
+    env = dict(os.environ)
+    env["EDL_POD_NAME"] = name
+    # The pytest process runs on 8 virtual CPU devices (conftest);
+    # each worker pod must have exactly its own 1 local device.
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
+    p = subprocess.Popen(
+        [
+            sys.executable, "-u", "-m", "edl_tpu.launcher",
+            "--entrypoint", "fit_a_line",
+            "--steps", str(STEPS),
+            "--coordinator", caddr,
+            "--address", f"127.0.0.1:{base_port}",
+            "--platform", "cpu",
+            "--global-batch-size", "8",
+            "--checkpoint-interval", str(checkpoint_interval),
+            "--history-file", str(hist[name]),
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    procs.append(p)
+    return p
+
+
 def test_multipod_elastic_1_2_1(tmp_path):
     from edl_tpu.runtime.coord_service import CoordinatorServer
     from edl_tpu.runtime.coordinator import LocalCoordinator
@@ -79,46 +114,7 @@ def test_multipod_elastic_1_2_1(tmp_path):
     procs = []
 
     def spawn(name, base_port):
-        env = dict(os.environ)
-        env["EDL_POD_NAME"] = name
-        # The pytest process runs on 8 virtual CPU devices (conftest);
-        # each worker pod must have exactly its own 1 local device.
-        env["XLA_FLAGS"] = " ".join(
-            f
-            for f in env.get("XLA_FLAGS", "").split()
-            if not f.startswith("--xla_force_host_platform_device_count")
-        )
-        p = subprocess.Popen(
-            [
-                sys.executable,
-                "-u",
-                "-m",
-                "edl_tpu.launcher",
-                "--entrypoint",
-                "fit_a_line",
-                "--steps",
-                str(STEPS),
-                "--coordinator",
-                caddr,
-                "--address",
-                f"127.0.0.1:{base_port}",
-                "--platform",
-                "cpu",
-                "--global-batch-size",
-                "8",
-                "--checkpoint-interval",
-                "2",
-                "--history-file",
-                str(hist[name]),
-            ],
-            env=env,
-            cwd=REPO,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        procs.append(p)
-        return p
+        return _spawn_worker(procs, hist, name, base_port, caddr)
 
     try:
         w1 = spawn("w1", 10100)
@@ -221,6 +217,73 @@ def test_multipod_elastic_1_2_1(tmp_path):
             assert abs(a["loss"] - b["loss"]) < 1e-5, (
                 f"step {a['step']}: w1 loss {a['loss']} != w2 loss {b['loss']}"
             )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def test_multipod_ungraceful_kill_evicts_and_reforms(tmp_path):
+    """Failure detection end-to-end with real processes: SIGKILL (no
+    graceful handshake) one member of a 2-pod world.  The survivor must
+    hold at the resize barrier until the dead pod's heartbeat lease
+    expires, get readmitted by the eviction-bumped generation, re-form
+    a world-1 process group, and keep training with step continuity —
+    the reference delegated all of this to master/etcd re-registration
+    (SURVEY.md §5.3)."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(
+        target_world=1, max_world=2, heartbeat_timeout=8.0, legal_sizes=[1, 2]
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {w: tmp_path / f"{w}.jsonl" for w in ("k1", "k2")}
+    procs = []
+
+    def spawn(name, base_port):
+        return _spawn_worker(procs, hist, name, base_port, caddr)
+
+    try:
+        k1 = spawn("k1", 10300)
+        _wait_for(
+            lambda: len(_read_history(hist["k1"])) >= 3,
+            180, "k1 stepping at world 1", procs,
+        )
+        k2 = spawn("k2", 10360)
+        coord.set_target_world(2)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 2 for r in _read_history(hist["k1"])
+            ),
+            240, "the 2-pod world to step", procs,
+        )
+
+        # Hard kill: no SIGTERM handshake, no deregister, no flush.
+        mark = len(_read_history(hist["k1"]))
+        k2.kill()
+        k2.wait(timeout=30)
+        procs.remove(k2)
+        assert "k2" in coord.members(), "kill must NOT deregister"
+
+        # Lease reaper evicts k2; k1 re-forms alone and keeps stepping.
+        _wait_for(lambda: "k2" not in coord.members(), 60, "k2 evicted")
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 1
+                for r in _read_history(hist["k1"])[mark:]
+            ),
+            240, "k1 training again at world 1", procs,
+        )
+
+        h1 = _read_history(hist["k1"])
+        # Step stream is contiguous: the replayed window after the
+        # ungraceful loss re-runs the same deterministic steps.
+        steps_done = sorted(set(r["step"] for r in h1))
+        assert steps_done == list(range(steps_done[-1] + 1))
+        assert all(math.isfinite(r["loss"]) for r in h1)
     finally:
         for p in procs:
             if p.poll() is None:

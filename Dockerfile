@@ -15,6 +15,14 @@ FROM python:3.12-slim
 
 WORKDIR /opt/edl-tpu
 
+# kubectl: the controller's cluster I/O layer (KubectlAPI) shells out
+# to it; without this binary `edl controller` cannot run in-cluster.
+RUN apt-get update && apt-get install -y --no-install-recommends curl ca-certificates \
+    && KVER="$(curl -Ls https://dl.k8s.io/release/stable.txt)" \
+    && curl -Lo /usr/local/bin/kubectl "https://dl.k8s.io/release/${KVER}/bin/linux/amd64/kubectl" \
+    && chmod +x /usr/local/bin/kubectl \
+    && apt-get purge -y curl && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
+
 # TPU wheels live on the libtpu index; CPU-only builds (CI, controller
 # nodes) work with the same install because jax[tpu] degrades to CPU
 # when no TPU is attached.

@@ -80,6 +80,26 @@ def cmd_crd(args) -> int:
     return 0
 
 
+def cmd_deploy(args) -> int:
+    """Print (or apply) the full control-plane install: namespace, CRD,
+    RBAC, controller Deployment — `kubectl apply -f <(edl deploy)`."""
+    from edl_tpu.controller.deploy import deploy_manifests
+
+    objs = deploy_manifests(
+        **({"image": args.image} if args.image else {})
+    )
+    if args.apply:
+        return _kubectl(
+            ["apply", "-f", "-"],
+            input=json.dumps(
+                {"apiVersion": "v1", "kind": "List", "items": objs}
+            ),
+            kubectl=args.kubectl,
+        )
+    print(_dump_yaml(objs))
+    return 0
+
+
 def cmd_list(args) -> int:
     return _kubectl(["get", "trainingjobs", "-A"], kubectl=args.kubectl)
 
@@ -269,6 +289,14 @@ def build_parser() -> argparse.ArgumentParser:
     s = sub.add_parser("crd", help="print the TrainingJob CRD")
     s.set_defaults(fn=cmd_crd)
 
+    s = sub.add_parser(
+        "deploy", help="print/apply the control-plane install (CRD+RBAC+controller)"
+    )
+    s.add_argument("--image", default=None, help="controller image override")
+    s.add_argument("--apply", action="store_true", help="kubectl apply it")
+    s.add_argument("--kubectl", default="kubectl")
+    s.set_defaults(fn=cmd_deploy)
+
     s = sub.add_parser("list", help="list TrainingJobs")
     s.add_argument("--kubectl", default="kubectl", help="kubectl binary")
     s.set_defaults(fn=cmd_list)
@@ -330,7 +358,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe: normal CLI etiquette.
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

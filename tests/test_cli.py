@@ -164,3 +164,32 @@ def test_local_sim_multi_job_mix(tmp_path, capsys):
     assert pa + pb == 4  # 16 chips / 4 per replica, fully used
     assert abs(pa - pb) <= 1, f"unfair split: {pa} vs {pb}"
     assert out["cluster"]["tpu_utilization"] == 1.0
+
+
+def test_deploy_manifests(capsys):
+    """`edl deploy` renders a complete control-plane install: namespace,
+    CRD, least-privilege RBAC, controller Deployment."""
+    assert main(["deploy"]) == 0
+    docs = list(yaml.safe_load_all(capsys.readouterr().out))
+    kinds = [d["kind"] for d in docs]
+    assert kinds == [
+        "Namespace",
+        "CustomResourceDefinition",
+        "ServiceAccount",
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "Deployment",
+    ]
+    role = next(d for d in docs if d["kind"] == "ClusterRole")
+    by_group = {
+        g: r["verbs"]
+        for r in role["rules"]
+        for g in r["apiGroups"]
+        if "trainingjobs" in r["resources"] or g in ("batch", "apps")
+    }
+    assert "watch" in by_group["edl.tpu.dev"]  # the informer analog
+    assert {"create", "delete"} <= set(by_group["batch"])  # trainer Jobs
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    spec = dep["spec"]["template"]["spec"]
+    assert spec["serviceAccountName"] == "edl-controller"
+    assert spec["containers"][0]["args"] == ["controller"]

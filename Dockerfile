@@ -17,9 +17,13 @@ WORKDIR /opt/edl-tpu
 
 # kubectl: the controller's cluster I/O layer (KubectlAPI) shells out
 # to it; without this binary `edl controller` cannot run in-cluster.
+# -f fails the build on HTTP errors (never bake an error page in as
+# the binary); TARGETARCH keeps arm64 builds runnable.
+ARG TARGETARCH=amd64
 RUN apt-get update && apt-get install -y --no-install-recommends curl ca-certificates \
-    && KVER="$(curl -Ls https://dl.k8s.io/release/stable.txt)" \
-    && curl -Lo /usr/local/bin/kubectl "https://dl.k8s.io/release/${KVER}/bin/linux/amd64/kubectl" \
+    && KVER="$(curl -fsSL https://dl.k8s.io/release/stable.txt)" \
+    && curl -fsSL -o /usr/local/bin/kubectl \
+        "https://dl.k8s.io/release/${KVER}/bin/linux/${TARGETARCH}/kubectl" \
     && chmod +x /usr/local/bin/kubectl \
     && apt-get purge -y curl && apt-get autoremove -y && rm -rf /var/lib/apt/lists/*
 

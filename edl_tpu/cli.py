@@ -84,10 +84,9 @@ def cmd_deploy(args) -> int:
     """Print (or apply) the full control-plane install: namespace, CRD,
     RBAC, controller Deployment — `kubectl apply -f <(edl deploy)`."""
     from edl_tpu.controller.deploy import deploy_manifests
+    from edl_tpu.resource.training_job import DEFAULT_IMAGE
 
-    objs = deploy_manifests(
-        **({"image": args.image} if args.image else {})
-    )
+    objs = deploy_manifests(image=args.image or DEFAULT_IMAGE)
     if args.apply:
         return _kubectl(
             ["apply", "-f", "-"],

@@ -222,9 +222,21 @@ def main(argv: List[str]) -> int:
         return 0
 
     if verb == "patch":
-        # patch job <name> --type=merge -p <json>
+        # patch job|trainingjob <name> [--subresource=status] --type=merge -p <json>
         name = args[2]
         patch = json.loads(args[args.index("-p") + 1])
+        if args[1] == "trainingjob":
+            for m in raw.get("trainingjobs", []):
+                if m["metadata"]["name"] == name:
+                    m.setdefault("status", {}).update(patch.get("status", {}))
+                    _save(kube, raw)
+                    print(f"trainingjob/{name} patched")
+                    return 0
+            print(
+                f'Error from server (NotFound): trainingjobs "{name}" not found',
+                file=sys.stderr,
+            )
+            return 1
         w = kube.get_workload(name)
         if w is None:
             print(f'Error from server (NotFound): jobs "{name}" not found', file=sys.stderr)

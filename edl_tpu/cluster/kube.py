@@ -146,6 +146,16 @@ class KubeAPI:
     def delete_workload(self, name: str) -> bool:
         raise NotImplementedError
 
+    def update_training_job_status(
+        self, name: str, status: dict, namespace: Optional[str] = None
+    ) -> bool:
+        """Write the controller's status view to the CR's status
+        subresource so ``kubectl get trainingjobs`` tells the truth —
+        the reference declared ``TrainingJobStatus`` and never wrote it
+        (SURVEY.md §5.5).  Default no-op: backends without CR storage
+        (in-memory FakeKube) simply skip it."""
+        return False
+
 
 class FakeKube(KubeAPI):
     """In-memory cluster with a synchronous Job-controller + scheduler
@@ -486,6 +496,27 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
                 raise ConflictError(msg.strip())
             raise RuntimeError(f"kubectl patch failed: {msg.strip()}")
         return self.get_workload(w.name)
+
+    def update_training_job_status(
+        self, name: str, status: dict, namespace: Optional[str] = None
+    ) -> bool:
+        r = subprocess.run(
+            [
+                self.kubectl,
+                "-n",
+                namespace or self.namespace,
+                "patch",
+                "trainingjob",
+                name,
+                "--subresource=status",
+                "--type=merge",
+                "-p",
+                json.dumps({"status": status}),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0
 
     def list_training_jobs(self) -> List[dict]:
         """All TrainingJob CRs across namespaces (the watch source,

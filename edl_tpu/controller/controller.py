@@ -52,6 +52,8 @@ class Controller:
         )
         #: spec updates whose manifest re-apply failed; retried per tick
         self._pending_refresh: set = set()
+        #: last status payload pushed to each CR (avoid a PATCH per tick)
+        self._pushed_status: Dict[str, str] = {}
 
     # -- event handlers (ref onAdd/onUpdate/onDelete, :110-147) --------------
     def on_add(self, job: TrainingJob) -> TrainingJob:
@@ -98,6 +100,10 @@ class Controller:
         self.autoscaler.on_del(job)
         self.lifecycle.destroy(job)
         self.jobs.pop(job.name, None)
+        # A resubmitted job with an identical status must hit the fresh
+        # CR: drop the dedup key with the job.
+        self._pushed_status.pop(job.name, None)
+        self._pending_refresh.discard(job.name)
 
     # -- status reconciliation (what the reference never did) ----------------
     def reconcile_status(self, pods_by_job: Optional[Dict] = None) -> None:
@@ -134,6 +140,34 @@ class Controller:
                 job.status.state = JobState.SCALING
             elif job.status.state == JobState.SCALING and pending == 0:
                 job.status.state = JobState.RUNNING
+        self.push_statuses()
+
+    def push_statuses(self) -> None:
+        """Write each job's status to its CR's status subresource (only
+        when it changed) so ``kubectl get trainingjobs`` reflects the
+        controller's state machine — the reference declared
+        ``TrainingJobStatus`` and never wrote it (SURVEY.md §5.5)."""
+        import json
+
+        for job in self.jobs.values():
+            s = job.status
+            payload = {
+                "state": s.state.value,
+                "parallelism": s.parallelism,
+                "running": s.running,
+                "pending": s.pending,
+                "message": s.message,
+            }
+            key = json.dumps(payload, sort_keys=True)
+            if self._pushed_status.get(job.name) == key:
+                continue
+            try:
+                if self.cluster.kube.update_training_job_status(
+                    job.name, payload, namespace=job.namespace
+                ):
+                    self._pushed_status[job.name] = key
+            except Exception:
+                continue  # next tick retries (level-triggered)
 
     # -- actuation handshake + completion (coordinator-facing) ---------------
     def reconcile_targets(self, pods_by_job: Optional[Dict] = None) -> None:

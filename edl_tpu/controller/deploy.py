@@ -40,11 +40,18 @@ def rbac_manifests() -> List[Dict[str, Any]]:
             "metadata": {"name": SERVICE_ACCOUNT},
             "rules": [
                 {
-                    # Read-only: the watcher polls CRs; nothing writes
-                    # CR objects back (status lives controller-side).
+                    # Read-only on the CR objects themselves (the
+                    # watcher polls; specs belong to users)...
                     "apiGroups": ["edl.tpu.dev"],
                     "resources": ["trainingjobs"],
                     "verbs": ["get", "list", "watch"],
+                },
+                {
+                    # ...but the controller owns the status subresource
+                    # (state machine writeback, SURVEY.md §5.5).
+                    "apiGroups": ["edl.tpu.dev"],
+                    "resources": ["trainingjobs/status"],
+                    "verbs": ["update", "patch"],
                 },
                 {
                     "apiGroups": ["batch"],

@@ -114,6 +114,12 @@ def test_submit_controller_scale_kill(fake_cluster, tmp_path, capsys):
     assert "pending_p50_s" in out["cluster"]
 
     st = _state(fake_cluster)
+    # Status writeback: the CR's status subresource carries the state
+    # machine (the reference declared TrainingJobStatus and never wrote
+    # it) — `kubectl get trainingjobs` tells the truth.
+    cr = next(c for c in st["trainingjobs"] if c["metadata"]["name"] == "e2e-mnist")
+    assert cr.get("status", {}).get("state") == "Running"
+    assert cr["status"]["parallelism"] == 4
     workloads = {w["name"]: w for w in st["workloads"]}
     assert "e2e-mnist-trainer" in workloads
     assert "e2e-mnist-coordinator" in workloads

@@ -95,6 +95,39 @@ def _install_nonfatal_heartbeat_callback() -> None:
     jaxlib._edl_nonfatal_heartbeats = True
 
 
+def _check_slice_topology(topology: str, devices) -> None:
+    """Cross-check the formed world against the declared slice topology.
+
+    A trainer pod owns one host's worth of its slice (ref trainer spec
+    ``pkg/resource/training_job.go:128-134``: a replica is a whole
+    slice), so on TPU the local device count must equal the topology's
+    chips-per-host.  The mesh itself is derived from the *actual*
+    formed world (``ElasticTrainer._rebuild_world``); this check only
+    surfaces spec/deployment drift loudly instead of letting a
+    mis-labeled nodepool silently train at the wrong scale."""
+    import sys
+
+    import jax
+
+    local = [d for d in devices if d.process_index == jax.process_index()]
+    if not local or local[0].platform != "tpu":
+        return  # CPU smoke/test worlds force arbitrary device counts
+    from edl_tpu.cluster.tpu_topology import get_topology
+
+    try:
+        topo = get_topology(topology)
+    except ValueError:
+        return
+    per_host = topo.chips // max(1, topo.hosts)
+    if topo.chips and len(local) != per_host:
+        print(
+            f"[edl] slice topology {topology} expects {per_host} "
+            f"chips/host but this pod sees {len(local)} local devices; "
+            "check the nodepool's tpu-topology labels",
+            file=sys.stderr,
+        )
+
+
 #: Per-generation coordination ports rotate through this window above
 #: the pod's base port.  Wide enough that a port recurs only after
 #: hundreds of generations (no TIME_WAIT collisions on fast churn);
@@ -206,6 +239,8 @@ def make_world_builder(
                     "generation": plan.generation,
                     "world_size": plan.world_size,
                     "rank": rank,
+                    "devices": len(devices),
+                    "local_devices": jax.local_device_count(),
                     "teardown_s": round(t_teardown, 4),
                     "init_s": round(_time.perf_counter() - t1, 4),
                 }
@@ -291,6 +326,8 @@ def run(
                 # handler back or scale-down pods can never deregister.
                 if sigterm_handler[0] is not None:
                     signal.signal(signal.SIGTERM, sigterm_handler[0])
+                if devs is not None:
+                    _check_slice_topology(cfg["slice_topology"], devs)
                 return devs
 
             gbs = gbs or 64

@@ -354,31 +354,45 @@ class TrainingJob:
         if s.global_batch_size < 0:
             raise ValidationError("global_batch_size must be >= 0")
         if s.global_batch_size:
-            # Fixed-global-batch elasticity (SURVEY.md §7.4): per-replica
-            # batch = global_batch_size / world_size, so the runtime only
-            # resizes to world sizes that divide the global batch (see
-            # legal_world_sizes()).  The endpoints must themselves be legal
-            # or the job could neither start at min nor reach max.
-            if s.global_batch_size % t.min_instance != 0:
+            # Fixed-global-batch elasticity (SURVEY.md §7.4): the batch
+            # dim shards over the world's FULL device mesh (world x
+            # chips-per-replica — a trainer replica owns a whole slice,
+            # ref pkg/resource/training_job.go:128-134), so the runtime
+            # only resizes to world sizes whose device count divides the
+            # global batch (see legal_world_sizes()).  The endpoints
+            # must themselves be legal or the job could neither start
+            # at min nor reach max.
+            chips = max(1, topo_chips)
+            if s.global_batch_size % (t.min_instance * chips) != 0:
                 raise ValidationError(
-                    "global_batch_size must be divisible by trainer.min_instance"
+                    "global_batch_size must be divisible by "
+                    f"trainer.min_instance x slice chips "
+                    f"({t.min_instance} x {chips})"
                 )
-            if s.global_batch_size % t.max_instance != 0:
+            if s.global_batch_size % (t.max_instance * chips) != 0:
                 raise ValidationError(
-                    "global_batch_size must be divisible by trainer.max_instance"
+                    "global_batch_size must be divisible by "
+                    f"trainer.max_instance x slice chips "
+                    f"({t.max_instance} x {chips})"
                 )
         return self
 
     def legal_world_sizes(self) -> List[int]:
         """World sizes the elastic runtime may resize to: every w in
-        [min_instance, max_instance] with an integral per-replica batch.
-        With no global_batch_size set, every size in range is legal."""
+        [min_instance, max_instance] whose full device mesh
+        (w x chips-per-replica) divides the global batch — the batch
+        dim shards over every chip of every replica, not one row per
+        pod.  With no global_batch_size set, every size in range is
+        legal."""
+        from edl_tpu.cluster.tpu_topology import topology_chips
+
         t = self.spec.trainer
         sizes = range(t.min_instance, t.max_instance + 1)
         gbs = self.spec.global_batch_size
         if not gbs:
             return list(sizes)
-        return [w for w in sizes if gbs % w == 0]
+        chips = max(1, topology_chips(t.slice_topology))
+        return [w for w in sizes if gbs % (w * chips) == 0]
 
     # -- (de)serialization --------------------------------------------------
     def to_manifest(self) -> Dict[str, Any]:

@@ -92,23 +92,43 @@ class ShardedDataIterator:
 
         Single-process path: materialize the global batch and let
         ``jax.device_put`` scatter it.  Multi-process path: each process
-        materializes only its addressable shard and assembles the global
-        array via ``jax.make_array_from_process_local_data`` (the
+        materializes only the rows its addressable devices shard, served
+        per-device via ``jax.make_array_from_callback`` — driven by the
+        sharding itself, so it stays correct for any devices-per-process
+        (multi-chip pods, multi-host slices), where slicing by
+        ``process_index`` would only cover the 1-chip-per-pod case (the
         multi-host analog of the reference's per-trainer data streams)."""
         axes = tuple(a for a in batch_axes if a in mesh.axis_names)
         lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        extent = 1
+        for a in axes:
+            extent *= sizes[a]
+        if self.global_batch_size % extent != 0:
+            # Fail with the real cause here, not an opaque XLA sharding
+            # error inside the step (which the elastic loop would
+            # misread as membership churn).
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by "
+                f"the mesh's {extent}-device batch extent (axes {axes})"
+            )
 
         def spec_for(ndim: int) -> P:
             return P(lead, *([None] * (ndim - 1)))
 
         if jax.process_count() > 1:  # pragma: no cover - needs real multi-host
-            world = jax.process_count()
-            local = self.host_batch(step, world, jax.process_index())
+            idx = self.global_indices(step)
             out = {}
-            for k, v in local.items():
+            for k, v in self.dataset.items():
                 sharding = NamedSharding(mesh, spec_for(v.ndim))
                 gshape = (self.global_batch_size,) + v.shape[1:]
-                out[k] = jax.make_array_from_process_local_data(sharding, v, gshape)
+
+                def cb(index, v=v):
+                    # index: per-device global-slice tuple; rows of the
+                    # deterministic global batch this device holds.
+                    return v[idx[index[0]]]
+
+                out[k] = jax.make_array_from_callback(gshape, sharding, cb)
             return out
         gb = {k: v[self.global_indices(step)] for k, v in self.dataset.items()}
         return {

@@ -219,7 +219,15 @@ class ElasticTrainer:
     def _rebuild_world(self, plan: ElasticPlan) -> bool:
         """Invoke the world_builder for ``plan``.  Returns False when
         world formation failed (caller holds and retries on the next,
-        possibly fresher, plan)."""
+        possibly fresher, plan).
+
+        On success, ``devices_per_trainer`` is re-derived from the
+        actual formed world: a trainer replica owns a whole TPU slice
+        (ref trainer spec ``pkg/resource/training_job.go:128-134``), so
+        a world of ``world_size`` pods with ``c`` chips each must mesh
+        over all ``world_size * c`` global devices — not the first
+        ``world_size`` (which would exclude every pod but rank 0's
+        chips whenever pods carry more than one device)."""
         self._trainers.clear()
         self.mesh = None
         try:
@@ -228,7 +236,18 @@ class ElasticTrainer:
             return False
         if devs is None:
             return False
+        if len(devs) % plan.world_size != 0:
+            import sys
+
+            print(
+                f"[edl] world of {plan.world_size} pods formed with "
+                f"{len(devs)} devices (not divisible): heterogeneous "
+                "pod device counts are unsupported; holding",
+                file=sys.stderr,
+            )
+            return False
         self.devices = list(devs)
+        self.devices_per_trainer = len(devs) // plan.world_size
         return True
 
     def _enter_standby(self, plan: ElasticPlan) -> None:
@@ -278,6 +297,20 @@ class ElasticTrainer:
         with annotate("resize/remesh"):
             trainer = self._trainer_for(plan.world_size)
             self.mesh = trainer.mesh
+            # Surface batch/mesh mismatch HERE, outside the step loop's
+            # broken-world guard: a global batch the full device mesh
+            # can't shard is a configuration error (legal-size metadata
+            # disagreeing with chips-per-trainer), not peer churn.
+            gbs = self.data.global_batch_size
+            if gbs % trainer.mesh.devices.size != 0:
+                raise RuntimeError(
+                    f"global batch {gbs} not divisible by the "
+                    f"{trainer.mesh.devices.size}-device mesh "
+                    f"(world {plan.world_size} x "
+                    f"{self.devices_per_trainer} chips/trainer); the "
+                    "coordinator's legal sizes must quantize on "
+                    "world x chips (TrainingJob.legal_world_sizes)"
+                )
 
         with annotate("resize/restore"):
             if jax.process_count() > 1:

@@ -54,6 +54,25 @@ class HostCheckpoint:
     def nbytes(self) -> int:
         return sum(x.nbytes for x in self.leaves)
 
+    def digest(self) -> int:
+        """Content fingerprint (crc32 chained over all leaves), cached.
+
+        Lets multi-pod members agree that they hold the *identical*
+        checkpoint — same step AND same bytes — so a graceful resize can
+        skip the full-state broadcast (joiner-only restore).  One host
+        memory pass on first call; O(1) after."""
+        if self._digest is None:
+            import zlib
+
+            crc = 0
+            for leaf in self.leaves:
+                arr = np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
+                crc = zlib.crc32(arr, crc)
+            self._digest = crc
+        return self._digest
+
+    _digest: Optional[int] = field(default=None, repr=False, compare=False)
+
 
 class HostDRAMStore:
     """Always-warm checkpoint store in host DRAM.

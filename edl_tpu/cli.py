@@ -146,7 +146,13 @@ def cmd_local_run(args) -> int:
     coord = LocalCoordinator(
         target_world=start_world,
         max_world=min(t.max_instance, n_dev),
-        legal_sizes=[w for w in job.legal_world_sizes() if w <= n_dev],
+        # Local sim runs one-device trainers: quantize on w, not on the
+        # deployed topology's w x chips.
+        legal_sizes=[
+            w
+            for w in job.legal_world_sizes(chips_per_replica=1)
+            if w <= n_dev
+        ],
     )
     for i in range(min(t.max_instance, n_dev)):
         coord.register(f"local-{i}")

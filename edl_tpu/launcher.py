@@ -375,6 +375,16 @@ def run(
     )
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
+    if hist_f is not None:
+        def on_resize(ev):
+            import dataclasses
+            import json
+
+            hist_f.write(
+                json.dumps({"resize": dataclasses.asdict(ev)}) + "\n"
+            )
+
+        et.on_resize = on_resize
 
     # Graceful scale-down handshake: on SIGTERM (k8s pod deletion),
     # deregister + flush synchronously so the survivors' resize window

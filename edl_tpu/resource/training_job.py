@@ -377,13 +377,20 @@ class TrainingJob:
                 )
         return self
 
-    def legal_world_sizes(self) -> List[int]:
+    def legal_world_sizes(
+        self, chips_per_replica: Optional[int] = None
+    ) -> List[int]:
         """World sizes the elastic runtime may resize to: every w in
         [min_instance, max_instance] whose full device mesh
         (w x chips-per-replica) divides the global batch — the batch
         dim shards over every chip of every replica, not one row per
         pod.  With no global_batch_size set, every size in range is
-        legal."""
+        legal.
+
+        ``chips_per_replica`` defaults to the spec's slice topology;
+        pass 1 when the runtime simulates one-device trainers (the CLI
+        local modes), where the deployed topology's chip count would
+        wrongly disqualify sizes the actual mesh shards fine."""
         from edl_tpu.cluster.tpu_topology import topology_chips
 
         t = self.spec.trainer
@@ -391,7 +398,9 @@ class TrainingJob:
         gbs = self.spec.global_batch_size
         if not gbs:
             return list(sizes)
-        chips = max(1, topology_chips(t.slice_topology))
+        if chips_per_replica is None:
+            chips_per_replica = topology_chips(t.slice_topology)
+        chips = max(1, chips_per_replica)
         return [w for w in sizes if gbs % (w * chips) == 0]
 
     # -- (de)serialization --------------------------------------------------

@@ -85,6 +85,30 @@ class ShardedDataIterator:
         sl = idx[rank * per : (rank + 1) * per]
         return {k: v[sl] for k, v in self.dataset.items()}
 
+    def batch_extent(self, mesh: Mesh, batch_axes=("dp",)) -> int:
+        """Number of batch-dim shards ``device_batch`` will cut on
+        ``mesh``: the product of the present batch axes' sizes (NOT the
+        total device count — a tp/sp-bearing mesh replicates the batch
+        over its non-batch axes)."""
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        extent = 1
+        for a in batch_axes:
+            if a in sizes:
+                extent *= sizes[a]
+        return extent
+
+    def validate_mesh(self, mesh: Mesh, batch_axes=("dp",)) -> None:
+        """Raise the real cause when the global batch can't shard on
+        ``mesh`` — callers on the resize path check this BEFORE the
+        step loop, whose broken-world guard would misread an XLA
+        sharding error as membership churn."""
+        extent = self.batch_extent(mesh, batch_axes)
+        if self.global_batch_size % extent != 0:
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by "
+                f"the mesh's {extent}-way batch extent (axes {batch_axes})"
+            )
+
     # -- device placement ---------------------------------------------------
     def device_batch(self, step: int, mesh: Mesh, batch_axes=("dp",)) -> Dict[str, Any]:
         """Global batch placed on ``mesh``, batch dim sharded over
@@ -100,18 +124,7 @@ class ShardedDataIterator:
         multi-host analog of the reference's per-trainer data streams)."""
         axes = tuple(a for a in batch_axes if a in mesh.axis_names)
         lead = axes if len(axes) > 1 else (axes[0] if axes else None)
-        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        extent = 1
-        for a in axes:
-            extent *= sizes[a]
-        if self.global_batch_size % extent != 0:
-            # Fail with the real cause here, not an opaque XLA sharding
-            # error inside the step (which the elastic loop would
-            # misread as membership churn).
-            raise ValueError(
-                f"global batch {self.global_batch_size} not divisible by "
-                f"the mesh's {extent}-device batch extent (axes {axes})"
-            )
+        self.validate_mesh(mesh, batch_axes)
 
         def spec_for(ndim: int) -> P:
             return P(lead, *([None] * (ndim - 1)))

@@ -54,6 +54,10 @@ class ResizeEvent:
     restored_step: int
     replayed_steps: int
     graceful: bool
+    #: how this process got its state: "init" (fresh), "local" (own
+    #: store, no cross-pod traffic), "broadcast" (full-state broadcast
+    #: because some member lacked the agreed checkpoint)
+    restore_source: str = ""
 
 
 @dataclass
@@ -153,6 +157,9 @@ class ElasticTrainer:
 
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
+        #: optional observer called with each ResizeEvent (the launcher
+        #: logs them to the history file for observability/tests)
+        self.on_resize: Optional[Callable[[ResizeEvent], None]] = None
 
         # Opt-in device tracing (EDL_PROFILE_DIR; SURVEY.md §5.1 —
         # the reference had no tracing at all).
@@ -298,29 +305,32 @@ class ElasticTrainer:
             trainer = self._trainer_for(plan.world_size)
             self.mesh = trainer.mesh
             # Surface batch/mesh mismatch HERE, outside the step loop's
-            # broken-world guard: a global batch the full device mesh
-            # can't shard is a configuration error (legal-size metadata
-            # disagreeing with chips-per-trainer), not peer churn.
-            gbs = self.data.global_batch_size
-            if gbs % trainer.mesh.devices.size != 0:
+            # broken-world guard: a global batch the mesh can't shard
+            # is a configuration error (legal-size metadata disagreeing
+            # with chips-per-trainer), not peer churn.
+            try:
+                self.data.validate_mesh(trainer.mesh)
+            except ValueError as e:
                 raise RuntimeError(
-                    f"global batch {gbs} not divisible by the "
-                    f"{trainer.mesh.devices.size}-device mesh "
-                    f"(world {plan.world_size} x "
-                    f"{self.devices_per_trainer} chips/trainer); the "
-                    "coordinator's legal sizes must quantize on "
-                    "world x chips (TrainingJob.legal_world_sizes)"
-                )
+                    f"resize to world {plan.world_size} "
+                    f"(x {self.devices_per_trainer} chips/trainer) is "
+                    f"unsatisfiable: {e}; the coordinator's legal sizes "
+                    "must quantize on world x chips "
+                    "(TrainingJob.legal_world_sizes)"
+                ) from None
 
         with annotate("resize/restore"):
             if jax.process_count() > 1:
-                self.state, restored_step = self._restore_multiprocess(trainer)
+                self.state, restored_step, restore_source = (
+                    self._restore_multiprocess(trainer)
+                )
             else:
                 ckpt = self.store.latest()
                 if ckpt is None:
                     # Fresh job: initialize on the new mesh.
                     self.state = trainer.init_state()
                     restored_step = 0
+                    restore_source = "init"
                 else:
                     # Model-sharded states restore onto this mesh's
                     # actual layout (the re-sharding moment of SURVEY.md
@@ -334,21 +344,24 @@ class ElasticTrainer:
                         ckpt, trainer.mesh, shardings
                     )
                     restored_step = int(ckpt.step)
+                    restore_source = "local"
         replayed = max(0, self._last_completed_step - restored_step)
 
         self.generation = plan.generation
         self._standby = False
         seconds = time.perf_counter() - t0
-        self.resize_events.append(
-            ResizeEvent(
-                generation=plan.generation,
-                world_size=plan.world_size,
-                seconds=seconds,
-                restored_step=restored_step,
-                replayed_steps=replayed,
-                graceful=graceful,
-            )
+        event = ResizeEvent(
+            generation=plan.generation,
+            world_size=plan.world_size,
+            seconds=seconds,
+            restored_step=restored_step,
+            replayed_steps=replayed,
+            graceful=graceful,
+            restore_source=restore_source,
         )
+        self.resize_events.append(event)
+        if self.on_resize is not None:
+            self.on_resize(event)
         # Ack only the members this process owns: via the HTTP
         # coordinator, acking on behalf of peers would release the
         # barrier before the world actually re-formed (ADVICE r1).
@@ -359,29 +372,64 @@ class ElasticTrainer:
     def _restore_multiprocess(self, trainer: Trainer):
         """Agree on one state across the (re-formed) process group.
 
-        Rank 0 is the oldest surviving member (plan order is join
-        order), so its checkpoint is authoritative; joiners arrive with
-        empty stores and receive the state by broadcast — the TPU-native
-        replacement for the reference joiners' pserver parameter pull.
-        Runs collectives: every member process must call this inside
-        the same generation's resize."""
+        Members first agree on what they hold via a tiny all-gather of
+        (have, step, digest).  When every member already holds the
+        identical checkpoint — the common case for a graceful resize,
+        where each survivor flushed the same replicated state — everyone
+        restores from its *local* store and no cross-pod state moves
+        (joiner-only restore: a full-model DCN broadcast per resize
+        would dominate the <60s budget at scale, VERDICT r3 weak-1).
+        Only when some member lacks the agreed bytes (a joiner, a
+        diverged store) does the newest-checkpoint holder broadcast —
+        the TPU-native replacement for the reference joiners' pserver
+        parameter pull.  Runs collectives: every member process must
+        call this inside the same generation's resize.
+
+        Returns (state, restored_step, restore_source)."""
         from jax.experimental import multihost_utils
 
         ckpt = self.store.latest()
-        source = jax.process_index() == 0
-        have = np.asarray(1 if (source and ckpt is not None) else 0, np.int32)
-        have = int(multihost_utils.broadcast_one_to_all(have))
-        if not have:
-            # Rank 0 has nothing (fresh job): deterministic same-seed
-            # init everywhere — no broadcast needed.
-            return trainer.init_state(), 0
+        summary = np.asarray(
+            [
+                1 if ckpt is not None else 0,
+                ckpt.step if ckpt is not None else -1,
+                ckpt.digest() if ckpt is not None else 0,
+            ],
+            np.int64,
+        )
+        world = multihost_utils.process_allgather(summary)
+        haves, steps, digests = world[:, 0], world[:, 1], world[:, 2]
+        shardings = (
+            trainer.state_shardings()
+            if self.model.param_partition is not None
+            else None
+        )
 
+        if not haves.any():
+            # Nobody has state (fresh job): deterministic same-seed
+            # init everywhere — nothing to move.
+            return trainer.init_state(), 0, "init"
+
+        if haves.all() and len({(int(s), int(d)) for s, d in zip(steps, digests)}) == 1:
+            # Identical bytes everywhere: restore locally, skip the
+            # broadcast entirely.
+            state = self.store.restore(ckpt, trainer.mesh, shardings)
+            return state, int(ckpt.step), "local"
+
+        # The source is the newest-checkpoint holder (ties: lowest
+        # rank) — computed identically on every member from the shared
+        # gather, so no extra agreement round-trip is needed.
+        src = max(
+            range(len(haves)), key=lambda r: (int(haves[r]), int(steps[r]), -r)
+        )
+        source = jax.process_index() == src
         if source:
             leaves = list(ckpt.leaves)
             treedef = ckpt.treedef
         else:
-            # Joiner: build a shape/dtype-congruent template (structure
-            # comes from the model, not from any local checkpoint).
+            # Receiver: build a shape/dtype-congruent template
+            # (structure comes from the model, not from any local
+            # checkpoint, which may be stale or absent).
             abstract = jax.eval_shape(
                 trainer._init_fn, jax.random.key(trainer.seed)
             )
@@ -398,15 +446,10 @@ class ElasticTrainer:
         )
         merged.step = int(np.asarray(merged.unflatten().step))
         # Adopt the broadcast checkpoint locally so this process can be
-        # the restore source after a future resize.
+        # a local-restore (or source) member after a future resize.
         self.store.put(merged)
-        shardings = (
-            trainer.state_shardings()
-            if self.model.param_partition is not None
-            else None
-        )
         state = self.store.restore(merged, trainer.mesh, shardings)
-        return state, merged.step
+        return state, merged.step, "broadcast"
 
     def _beat_once(self):
         if self._leaving:

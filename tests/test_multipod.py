@@ -50,6 +50,10 @@ def _read_formations(path):
     return [r["formation"] for r in _read_lines(path) if "formation" in r]
 
 
+def _read_resizes(path):
+    return [r["resize"] for r in _read_lines(path) if "resize" in r]
+
+
 def _wait_for(pred, timeout, what, procs=()):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -68,7 +72,8 @@ def _wait_for(pred, timeout, what, procs=()):
 
 
 def _spawn_worker(
-    procs, hist, name, base_port, caddr, checkpoint_interval=2, devices=1
+    procs, hist, name, base_port, caddr, checkpoint_interval=2, devices=1,
+    gbs=8,
 ):
     """Launch one real launcher 'pod' subprocess against the HTTP
     coordinator (shared by the multipod tests).  ``devices`` forces the
@@ -94,7 +99,7 @@ def _spawn_worker(
             "--coordinator", caddr,
             "--address", f"127.0.0.1:{base_port}",
             "--platform", "cpu",
-            "--global-batch-size", "8",
+            "--global-batch-size", str(gbs),
             "--checkpoint-interval", str(checkpoint_interval),
             "--history-file", str(hist[name]),
         ],
@@ -325,6 +330,107 @@ def test_multipod_multichip_pods_1_2_1(tmp_path):
         assert shared, "no overlapping world-2 steps recorded"
         for a, b in shared:
             assert abs(a["loss"] - b["loss"]) < 1e-5
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def test_multipod_joiner_only_restore(tmp_path):
+    """Graceful resizes must not broadcast the full state (VERDICT r3
+    weak-1): survivors of a scale-down all hold the identical flushed
+    checkpoint (agreed via the (step, digest) all-gather), so each
+    restores from its LOCAL store — at transformer scale a per-resize
+    full-model DCN broadcast would eat the <60s budget.  A fresh joiner
+    still receives the state by broadcast."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(
+        target_world=1,
+        max_world=3,
+        heartbeat_timeout=60.0,
+        legal_sizes=[1, 2, 3],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {w: tmp_path / f"{w}.jsonl" for w in ("a", "b", "c")}
+    procs = []
+
+    def spawn(name, base_port):
+        # gbs=12: divisible by every legal world (1, 2, 3).
+        return _spawn_worker(procs, hist, name, base_port, caddr, gbs=12)
+
+    try:
+        a = spawn("a", 10700)
+        _wait_for(
+            lambda: len(_read_history(hist["a"])) >= 3,
+            180, "a stepping at world 1", procs,
+        )
+        b = spawn("b", 10760)
+        coord.set_target_world(2)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 2 for r in _read_history(hist["b"])
+            ),
+            240, "the 2-pod world to step", procs,
+        )
+        c = spawn("c", 10820)
+        coord.set_target_world(3)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 3 for r in _read_history(hist["c"])
+            ),
+            240, "the 3-pod world to step", procs,
+        )
+        # Scale down 3 -> 2: a and b survive, c stands by.
+        coord.set_target_world(2)
+        _wait_for(
+            lambda: sum(
+                rz["world_size"] == 2 for rz in _read_resizes(hist["a"])
+            ) >= 2,
+            240, "a's scale-down resize record", procs,
+        )
+        _wait_for(
+            lambda: sum(
+                rz["world_size"] == 2 for rz in _read_resizes(hist["b"])
+            ) >= 2,
+            240, "b's scale-down resize record", procs,
+        )
+        for name, proc in (("c", c), ("b", b), ("a", a)):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+
+        # -- restore-source assertions --------------------------------------
+        ra = _read_resizes(hist["a"])
+        rb = _read_resizes(hist["b"])
+        rc = _read_resizes(hist["c"])
+        # a started the job fresh.
+        assert ra[0]["world_size"] == 1 and ra[0]["restore_source"] == "init"
+        # Joiners receive state by broadcast (b at world 2, c at world 3).
+        first_b = next(rz for rz in rb if rz["world_size"] == 2)
+        assert first_b["restore_source"] == "broadcast", rb
+        first_c = next(rz for rz in rc if rz["world_size"] == 3)
+        assert first_c["restore_source"] == "broadcast", rc
+        # The graceful scale-down (3 -> 2) moved NO state: survivors
+        # restored locally from their own flushed checkpoint.
+        down_a = [
+            rz
+            for rz in ra
+            if rz["world_size"] == 2 and rz is not ra[0]
+        ][-1]
+        down_b = [rz for rz in rb if rz["world_size"] == 2][-1]
+        assert down_a["restore_source"] == "local", ra
+        assert down_b["restore_source"] == "local", rb
+        assert down_a["graceful"] and down_b["graceful"]
+        assert down_a["replayed_steps"] == 0, down_a
+
+        # Step stream still contiguous on the rank-0 survivor.
+        h1 = _read_history(hist["a"])
+        steps_done = sorted(set(r["step"] for r in h1))
+        assert steps_done == list(range(steps_done[-1] + 1))
+        assert all(math.isfinite(r["loss"]) for r in h1)
     finally:
         for p in procs:
             if p.poll() is None:

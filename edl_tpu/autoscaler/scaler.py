@@ -165,27 +165,76 @@ class Autoscaler:
         is paired with the coordinator handshake (SURVEY §7.1 row 4) —
         **retarget-then-PUT on scale-down** so survivors re-form the
         world before the kube Job controller kills pods, PUT-then-
-        retarget on scale-up so the target grows once pods can exist."""
+        retarget on scale-up so the target grows once pods can exist.
+        Scale-down additionally deletes the *specific* pods the
+        coordinator dropped from the plan (pod name == EDL_POD_NAME ==
+        member id) before the PUT: the reference let the kube Job
+        controller choose its own victims (``pkg/autoscaler.go:
+        339-376``), which can kill an active-world member and turn a
+        graceful resize into a lease-timeout + replay."""
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
                 continue
             scale_down = diff.get(name, 0) < 0
             if scale_down:
-                self._retarget(job, parallelism)
+                client = self._retarget(job, parallelism)
+                if client is not None:
+                    self._delete_dropped_members(job, client)
             self.cluster.update_parallelism(job, parallelism)
             if not scale_down:
                 self._retarget(job, parallelism)
 
     def _retarget(self, job: TrainingJob, world: int):
-        """POST the new target world to the job's coordinator.  Failure
-        is tolerated (the coordinator may still be scheduling): the
-        controller's level-triggered ``reconcile_targets`` converges the
-        handshake on a later tick."""
+        """POST the new target world to the job's coordinator.  Returns
+        the client on success, None on failure.  Failure is tolerated
+        (the coordinator may still be scheduling) but LOGGED — a
+        persistently unreachable coordinator (bad Service, NetworkPolicy)
+        must be visible; the controller's level-triggered
+        ``reconcile_targets`` converges the handshake on a later tick."""
+        import sys
+
         try:
-            self._coord_client(job).set_target_world(world)
-        except Exception:
-            pass
+            client = self._coord_client(job)
+            client.set_target_world(world)
+            return client
+        except Exception as e:
+            print(
+                f"[edl-autoscaler] retarget {job.name} -> world {world} "
+                f"failed (coordinator unreachable?): {e}",
+                file=sys.stderr,
+            )
+            return None
+
+    def _delete_dropped_members(self, job: TrainingJob, client) -> List[str]:
+        """Delete the pods whose member ids are registered but no
+        longer in the plan's rank order (the scale-down victims the
+        coordinator just chose).  Best effort: a failure here only
+        degrades to the reference's behavior (kube picks the victim)."""
+        import sys
+
+        try:
+            plan = client.plan()
+            members = client.members()
+        except Exception as e:
+            print(
+                f"[edl-autoscaler] victim query for {job.name} failed: {e}",
+                file=sys.stderr,
+            )
+            return []
+        active = set(plan.members) if plan is not None else set()
+        victims = sorted(m for m in members if m not in active)
+        deleted = []
+        for v in victims:
+            try:
+                if self.cluster.delete_pod(v):
+                    deleted.append(v)
+            except Exception as e:
+                print(
+                    f"[edl-autoscaler] deleting victim pod {v} failed: {e}",
+                    file=sys.stderr,
+                )
+        return deleted
 
     # -- the loop (ref Run, :451-485) ----------------------------------------
     def run(self):

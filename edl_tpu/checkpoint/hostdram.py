@@ -168,6 +168,11 @@ class HostDRAMStore:
                     created_at=time.time(),
                     save_seconds=time.perf_counter() - t0,
                 )
+                # Fingerprint here, on the background thread: the
+                # multi-pod resize agreement reads digest() inside its
+                # all-gather, and a full-DRAM crc pass there would sit
+                # on the <60s critical path the digest exists to cut.
+                ckpt.digest()
                 with self._lock:
                     self._checkpoints[step_val] = ckpt
                     extra = sorted(self._checkpoints)[: -self.keep]
@@ -211,6 +216,9 @@ class HostDRAMStore:
     def put(self, ckpt: HostCheckpoint) -> None:
         """Adopt an externally produced checkpoint (e.g. one received by
         broadcast when joining a multi-pod world)."""
+        # Fingerprint now (we are already on the slow broadcast path)
+        # so the NEXT resize's agreement round reads a cached digest.
+        ckpt.digest()
         with self._lock:
             self._checkpoints[ckpt.step] = ckpt
             extra = sorted(self._checkpoints)[: -self.keep]

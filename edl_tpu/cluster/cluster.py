@@ -131,3 +131,10 @@ class Cluster:
 
     def delete_trainer_workload(self, job: TrainingJob) -> bool:
         return self.kube.delete_workload(job.trainer_job_name())
+
+    def delete_pod(self, name: str) -> bool:
+        """Graceful named-pod delete (scale-down victim coordination:
+        the autoscaler deletes exactly the pods the coordinator dropped
+        from the plan, so the kube Job controller never picks its own
+        victim)."""
+        return self.kube.delete_pod(name)

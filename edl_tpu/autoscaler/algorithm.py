@@ -112,6 +112,10 @@ class JobView:
     #: ascending legal world sizes within [min, max]; empty = every size
     legal_sizes: List[int] = field(default_factory=list)
     elastic: bool = True
+    #: host pods per replica (>1 for multi-host slices: the replica's
+    #: pods land on `hosts` DISTINCT nodes of the slice's pool, each
+    #: consuming per-pod cpu/mem and chips-per-host)
+    hosts: int = 1
 
     @staticmethod
     def from_job(job: TrainingJob, parallelism: Optional[int] = None) -> "JobView":
@@ -130,7 +134,23 @@ class JobView:
             slice_topology=t.slice_topology if job.tpu_per_trainer() else "",
             legal_sizes=job.legal_world_sizes(),
             elastic=job.elastic(),
+            hosts=job.hosts_per_replica(),
         )
+
+    # -- per-pod / per-replica views ----------------------------------------
+    @property
+    def tpu_per_pod(self) -> int:
+        """Chips one POD consumes (a replica's chips split over hosts)."""
+        return self.tpu_per_trainer // max(1, self.hosts)
+
+    @property
+    def cpu_per_replica(self) -> int:
+        """cpu_request_milli is per POD; a replica runs ``hosts`` pods."""
+        return self.cpu_request_milli * max(1, self.hosts)
+
+    @property
+    def mem_per_replica(self) -> int:
+        return self.mem_request_mega * max(1, self.hosts)
 
     # -- legal-size stepping ------------------------------------------------
     def _sizes(self) -> List[int]:
@@ -223,41 +243,78 @@ def _slice_fits_pool(r: ClusterResource, name: str, j: JobView) -> bool:
     return j.tpu_per_trainer == pool.chips
 
 
-def search_assignable_node(r: ClusterResource, j: JobView) -> Optional[str]:
-    """First node/pool whose idle CPU, free memory, and free chips fit
-    one replica — *slice*-aware on the chip axis (ref
-    ``searchAssignableNode``, ``pkg/autoscaler.go:191-199``, extended:
-    the chip check requires a whole slice of the replica's topology
-    from one pool, not loose chips).  Deterministic order so plans are
-    reproducible (the reference iterated a Go map)."""
-    for name in sorted(r.nodes.cpu_idle_milli):
+def search_assignable_nodes(
+    r: ClusterResource, j: JobView
+) -> Optional[List[str]]:
+    """Nodes for ONE replica's pods — ``j.hosts`` DISTINCT nodes, each
+    with room for one pod (per-pod cpu/mem/chips) on a pool of the
+    replica's slice topology.  Single-host replicas reduce to the
+    reference's one-node check (``searchAssignableNode``,
+    ``pkg/autoscaler.go:191-199``, extended: the chip check requires
+    slice-shaped capacity, not loose chips).
+
+    Multi-host replicas must take ALL their nodes from ONE nodepool
+    (one physical slice — ICI does not span pools): free host-nodes on
+    two different slices are not a slice, and admitting them would plan
+    replicas GKE can never schedule.  Nodes without a pool identity
+    cannot prove slice co-location, so a hosts>1 replica refuses them.
+    Deterministic order so plans are reproducible (the reference
+    iterated a Go map)."""
+    hosts = max(1, j.hosts)
+
+    def fits(name: str) -> bool:
         if j.cpu_request_milli > r.nodes.cpu_idle_milli[name]:
-            continue
+            return False
         if j.mem_request_mega > r.nodes.memory_free_mega.get(name, 0):
-            continue
+            return False
         if j.tpu_per_trainer > 0:
-            if j.tpu_per_trainer > r.nodes.tpu_free.get(name, 0):
-                continue
+            if j.tpu_per_pod > r.nodes.tpu_free.get(name, 0):
+                return False
             if not _slice_fits_pool(r, name, j):
-                continue
-        return name
+                return False
+        return True
+
+    if hosts == 1:
+        for name in sorted(r.nodes.cpu_idle_milli):
+            if fits(name):
+                return [name]
+        return None
+
+    by_pool: Dict[str, List[str]] = {}
+    for name in sorted(r.nodes.cpu_idle_milli):
+        pool = r.nodes.node_pool.get(name, "")
+        if not pool:
+            continue  # co-location unprovable without pool identity
+        if fits(name):
+            by_pool.setdefault(pool, []).append(name)
+    for pool in sorted(by_pool):
+        if len(by_pool[pool]) >= hosts:
+            return by_pool[pool][:hosts]
     return None
+
+
+def search_assignable_node(r: ClusterResource, j: JobView) -> Optional[str]:
+    """Single-node view of ``search_assignable_nodes`` (the reference's
+    shape; still the right call for hosts == 1 replicas)."""
+    nodes = search_assignable_nodes(r, j)
+    return nodes[0] if nodes else None
 
 
 def _apply(r: ClusterResource, j: JobView, delta_replicas: int, nodes: Sequence[str]):
     """Mutate the simulated inventory for ``delta_replicas`` more (or
     fewer) replicas of ``j`` (the reference did this in a defer,
     ``pkg/autoscaler.go:209-217`` — with the idle-adjustment sign
-    inverted, which we fix)."""
+    inverted, which we fix).  ``nodes``: per-POD placements (one entry
+    per host pod)."""
     r.tpu_limit += j.tpu_per_trainer * delta_replicas
-    r.cpu_request_milli += j.cpu_request_milli * delta_replicas
-    r.memory_request_mega += j.mem_request_mega * delta_replicas
+    r.cpu_request_milli += j.cpu_per_replica * delta_replicas
+    r.memory_request_mega += j.mem_per_replica * delta_replicas
     for name in nodes:
         r.nodes.cpu_idle_milli[name] -= j.cpu_request_milli
         r.nodes.memory_free_mega[name] -= j.mem_request_mega
         if j.tpu_per_trainer > 0:
             r.nodes.tpu_free[name] = (
-                r.nodes.tpu_free.get(name, 0) - j.tpu_per_trainer
+                r.nodes.tpu_free.get(name, 0) - j.tpu_per_pod
             )
 
 
@@ -330,14 +387,14 @@ def scale_dry_run(
     # the fixed point would grow/shed in a loop).
     if (
         r.memory_total_mega - r.memory_request_mega - pending.mem_mega
-        < j.mem_request_mega * step
+        < j.mem_per_replica * step
     ):
         return 0  # insufficient memory (ref ``:259-263``)
     if (
         r.cpu_total_milli * max_load_desired
         - r.cpu_request_milli
         - pending.cpu_milli
-        < j.cpu_request_milli * step
+        < j.cpu_per_replica * step
     ):
         return 0  # would push CPU above max_load_desired (ref ``:269-273``)
     if j.tpu_per_trainer > 0 and (
@@ -347,24 +404,28 @@ def scale_dry_run(
         return 0  # not enough free chips; chips may go to 100% (ref ``:275-278``)
 
     # Per-replica node placement (ref ``:264-267`` checked one replica
-    # on one node; a quantized step places each new replica).
+    # on one node; a quantized step places each new replica — `hosts`
+    # pods on distinct nodes for multi-host slices).
     placed: List[str] = []
     for _ in range(step):
-        node = search_assignable_node(r, j)
-        if node is None:
+        nodes = search_assignable_nodes(r, j)
+        if nodes is None:
             # Roll back trial placements and refuse the step.
             for n in placed:
                 r.nodes.cpu_idle_milli[n] += j.cpu_request_milli
                 r.nodes.memory_free_mega[n] += j.mem_request_mega
                 if j.tpu_per_trainer > 0:
-                    r.nodes.tpu_free[n] += j.tpu_per_trainer
+                    r.nodes.tpu_free[n] += j.tpu_per_pod
             return 0
-        # Reserve on the node map immediately so the next replica sees it.
-        r.nodes.cpu_idle_milli[node] -= j.cpu_request_milli
-        r.nodes.memory_free_mega[node] -= j.mem_request_mega
-        if j.tpu_per_trainer > 0:
-            r.nodes.tpu_free[node] = r.nodes.tpu_free.get(node, 0) - j.tpu_per_trainer
-        placed.append(node)
+        # Reserve on the node map immediately so the next pod sees it.
+        for node in nodes:
+            r.nodes.cpu_idle_milli[node] -= j.cpu_request_milli
+            r.nodes.memory_free_mega[node] -= j.mem_request_mega
+            if j.tpu_per_trainer > 0:
+                r.nodes.tpu_free[node] = (
+                    r.nodes.tpu_free.get(node, 0) - j.tpu_per_pod
+                )
+            placed.append(node)
 
     # Cluster-level totals (node maps already adjusted above).
     _apply(r, j, step, ())

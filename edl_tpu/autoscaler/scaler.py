@@ -124,9 +124,14 @@ class Autoscaler:
                 # become explicit demand on every axis it consumes.
                 have_pending = True
                 t = job.spec.trainer
+                hosts = job.hosts_per_replica()  # pods per replica
                 demand.tpu_chips += t.min_instance * job.tpu_per_trainer()
-                demand.cpu_milli += t.min_instance * t.resources.cpu_request_milli()
-                demand.mem_mega += t.min_instance * t.resources.mem_request_mega()
+                demand.cpu_milli += (
+                    t.min_instance * hosts * t.resources.cpu_request_milli()
+                )
+                demand.mem_mega += (
+                    t.min_instance * hosts * t.resources.mem_request_mega()
+                )
                 continue  # a fully-pending job is demand, not a candidate
             views.append((JobView.from_job(job, parallelism=w.parallelism), total, running))
 

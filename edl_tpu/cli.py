@@ -67,10 +67,13 @@ def cmd_submit(args) -> int:
 
 
 def cmd_manifests(args) -> int:
-    from edl_tpu.controller.jobparser import parse_to_coordinator, parse_to_trainer
+    from edl_tpu.controller.jobparser import (
+        parse_to_coordinator,
+        parse_to_trainer_manifests,
+    )
 
     job = _load_job(args.spec)
-    objs = [parse_to_trainer(job)] + parse_to_coordinator(job)
+    objs = parse_to_trainer_manifests(job) + parse_to_coordinator(job)
     print(_dump_yaml(objs))
     return 0
 

@@ -44,6 +44,7 @@ class Cluster:
                 pool_topology={
                     n.name: n.tpu_topology for n in nodes if n.tpu_topology
                 },
+                node_pool={n.name: n.pool for n in nodes if n.pool},
             ),
         )
         for n in nodes:
@@ -72,15 +73,86 @@ class Cluster:
         return r
 
     # -- trainer workload (ref GetTrainerJob / UpdateTrainerJob) ------------
+    def _slice_jobs(self, job: TrainingJob) -> List[WorkloadInfo]:
+        """The per-replica Indexed Jobs of a multi-host-topology job,
+        sorted by replica index (workload name ``<job>-trainer-<r>``)."""
+        prefix = job.trainer_job_name() + "-"
+        out = []
+        for w in self.kube.list_workloads():
+            if w.kind != "Job" or not w.name.startswith(prefix):
+                continue
+            suffix = w.name[len(prefix):]
+            if suffix.isdigit():
+                out.append((int(suffix), w))
+        return [w for _, w in sorted(out, key=lambda t: t[0])]
+
     def get_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:
-        return self.kube.get_workload(job.trainer_job_name())
+        """The job's trainer workload view.  Single-host: the batch Job
+        itself.  Multi-host: a virtual aggregate over the per-replica
+        Indexed Jobs — ``parallelism`` counts REPLICAS (slice groups),
+        the unit every control-plane decision is made in."""
+        if job.hosts_per_replica() == 1:
+            return self.kube.get_workload(job.trainer_job_name())
+        slices = self._slice_jobs(job)
+        if not slices:
+            return None
+        return WorkloadInfo(
+            name=job.trainer_job_name(),
+            job_name=job.name,
+            parallelism=len(slices),
+            cpu_request_milli=slices[0].cpu_request_milli,
+            memory_request_mega=slices[0].memory_request_mega,
+            tpu_limit=slices[0].tpu_limit,
+            kind="Job",
+            owner=slices[0].owner,
+        )
 
     def update_parallelism(self, job: TrainingJob, parallelism: int, retries: int = 5) -> bool:
-        """Set the trainer workload's parallelism with optimistic-
-        concurrency retries (ref ``scaleAllJobs``'s 5-retry loop,
-        ``pkg/autoscaler.go:346-370``, moved down here so the decision
-        plane stays pure)."""
+        """Set the trainer replica count.
+
+        Single-host: rewrite the batch Job's parallelism with
+        optimistic-concurrency retries (ref ``scaleAllJobs``'s 5-retry
+        loop, ``pkg/autoscaler.go:346-370``, moved down here so the
+        decision plane stays pure).  Multi-host: a replica is a whole
+        Indexed Job, so scaling creates the missing ``<job>-trainer-<r>``
+        Jobs (r ascending) or deletes the highest-indexed extras — the
+        same highest-index-first order the coordinator's replica
+        grouping drops, so control plane and world agree on victims."""
         from edl_tpu.cluster.kube import ConflictError
+
+        if job.hosts_per_replica() > 1:
+            from edl_tpu.controller.jobparser import parse_to_trainer_slice
+
+            have = {  # replica index -> workload
+                int(w.name.rsplit("-", 1)[1]): w
+                for w in self._slice_jobs(job)
+            }
+            ok = True
+            # Keep the LOWEST-indexed EXISTING replicas (the coordinator's
+            # replica grouping keeps lowest complete replicas on
+            # scale-down — deleting "every r >= parallelism" would kill
+            # live survivors whenever indexes are non-contiguous, e.g.
+            # after an external deletion of replica 0).
+            existing = sorted(have)
+            keep = existing[:parallelism]
+            for r in existing[parallelism:]:
+                if not self.kube.delete_workload(have[r].name):
+                    ok = False
+            # Fill the remainder with fresh Jobs on the smallest unused
+            # indexes.
+            missing = parallelism - len(keep)
+            idx = 0
+            while missing > 0:
+                if idx not in have:
+                    try:
+                        self.kube.apply_manifests(
+                            [parse_to_trainer_slice(job, idx)]
+                        )
+                    except Exception:
+                        ok = False
+                    missing -= 1
+                idx += 1
+            return ok
 
         for _ in range(retries):
             w = self.kube.get_workload(job.trainer_job_name())
@@ -121,15 +193,23 @@ class Cluster:
 
     # -- CRUD (ref :245-291) -------------------------------------------------
     def create_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:
-        """Create the trainer workload by applying the jobparser's real
-        manifest — one creation path for FakeKube and a live cluster
-        (the reference's TODO at ``pkg/controller.go:115-133``, wired)."""
-        from edl_tpu.controller.jobparser import parse_to_trainer
+        """Create the trainer workload(s) by applying the jobparser's
+        real manifests — one creation path for FakeKube and a live
+        cluster (the reference's TODO at ``pkg/controller.go:115-133``,
+        wired)."""
+        from edl_tpu.controller.jobparser import parse_to_trainer_manifests
 
-        self.kube.apply_manifests([parse_to_trainer(job)])
-        return self.kube.get_workload(job.trainer_job_name())
+        self.kube.apply_manifests(parse_to_trainer_manifests(job))
+        return self.get_trainer_workload(job)
 
     def delete_trainer_workload(self, job: TrainingJob) -> bool:
+        if job.hosts_per_replica() > 1:
+            deleted = False
+            for w in self._slice_jobs(job):
+                deleted = self.kube.delete_workload(w.name) or deleted
+            # the headless per-pod-DNS Service shares the trainer name
+            self.kube.delete_workload(job.trainer_job_name())
+            return deleted
         return self.kube.delete_workload(job.trainer_job_name())
 
     def delete_pod(self, name: str) -> bool:

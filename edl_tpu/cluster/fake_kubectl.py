@@ -66,6 +66,8 @@ def _node_manifest(n: NodeInfo) -> dict:
     labels = {}
     if n.tpu_topology:
         labels["cloud.google.com/gke-tpu-topology"] = n.tpu_topology
+    if n.pool:
+        labels["cloud.google.com/gke-nodepool"] = n.pool
     return {
         "metadata": {"name": n.name, "labels": labels},
         "status": {

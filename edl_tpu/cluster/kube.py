@@ -35,6 +35,10 @@ class NodeInfo:
     memory_mega: int = 0
     tpu_chips: int = 0
     tpu_topology: str = ""  # e.g. "v5e-4": this pool schedules whole slices
+    #: nodepool identity (GKE ``cloud.google.com/gke-nodepool``): the
+    #: host nodes of ONE multi-host slice share it — a hosts>1 replica
+    #: must place all its pods inside a single pool
+    pool: str = ""
 
 
 @dataclass
@@ -47,6 +51,9 @@ class PodInfo:
     memory_request_mega: int = 0
     tpu_limit: int = 0
     deleting: bool = False  # DeletionTimestamp set (ref pkg/cluster.go:127-131)
+    #: owning workload name — distinct from job_name when a job renders
+    #: several per-replica slice Jobs sharing one edl-job label
+    workload: str = ""
 
 
 @dataclass
@@ -244,11 +251,19 @@ class FakeKube(KubeAPI):
 
     def delete_workload(self, name: str) -> bool:
         with self._lock:
-            self.services.pop(name, None)
+            svc = self.services.pop(name, None)
             w = self.workloads.pop(name, None)
             if w is None:
-                return False
-            for pname in [p for p, pod in self.pods.items() if pod.job_name == w.job_name]:
+                return svc is not None
+            for pname in [
+                p
+                for p, pod in self.pods.items()
+                if (
+                    pod.workload == name
+                    if pod.workload
+                    else pod.job_name == w.job_name
+                )
+            ]:
                 del self.pods[pname]
             return True
 
@@ -286,11 +301,15 @@ class FakeKube(KubeAPI):
                     self._reconcile(cur)
 
     # -- controller + scheduler emulation ------------------------------------
-    def _job_pods(self, job_name: str) -> List[PodInfo]:
+    def _workload_pods(self, w: WorkloadInfo) -> List[PodInfo]:
+        """Live (non-Terminating) pods owned by one workload.  Matching
+        by workload name, not job label: a multi-host job's per-replica
+        slice Jobs share the edl-job label but reconcile separately."""
         return [
             p
             for p in self.pods.values()
-            if p.job_name == job_name and not p.deleting
+            if not p.deleting
+            and (p.workload == w.name if p.workload else p.job_name == w.job_name)
         ]
 
     def _free_on(self, node: NodeInfo) -> Tuple[int, int, int]:
@@ -319,7 +338,7 @@ class FakeKube(KubeAPI):
             if pod.job_name == w.job_name and pod.deleting
         ]:
             del self.pods[pname]
-        pods = sorted(self._job_pods(w.job_name), key=lambda p: p.name)
+        pods = sorted(self._workload_pods(w), key=lambda p: p.name)
         while len(pods) > w.parallelism:
             victim = (
                 pods.pop() if self.scale_down_victim == "newest" else pods.pop(0)
@@ -334,6 +353,7 @@ class FakeKube(KubeAPI):
                 cpu_request_milli=w.cpu_request_milli,
                 memory_request_mega=w.memory_request_mega,
                 tpu_limit=w.tpu_limit,
+                workload=w.name,
             )
             self.pods[p.name] = p
             pods.append(p)
@@ -406,15 +426,17 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
                 parse_memory_mega,
             )
 
+            labels = it["metadata"].get("labels", {})
             nodes.append(
                 NodeInfo(
                     name=it["metadata"]["name"],
                     cpu_milli=parse_cpu_milli(alloc.get("cpu", 0)),
                     memory_mega=parse_memory_mega(alloc.get("memory", 0)),
                     tpu_chips=parse_count(alloc.get("google.com/tpu", 0)),
-                    tpu_topology=it["metadata"]
-                    .get("labels", {})
-                    .get("cloud.google.com/gke-tpu-topology", ""),
+                    tpu_topology=labels.get(
+                        "cloud.google.com/gke-tpu-topology", ""
+                    ),
+                    pool=labels.get("cloud.google.com/gke-nodepool", ""),
                 )
             )
         return nodes

@@ -32,6 +32,11 @@ class Nodes:
     #: replica's slice must match the pool's topology: 16 free chips
     #: spread over two v5e-8 pools cannot host one v5e-16 replica.
     pool_topology: Dict[str, str] = field(default_factory=dict)
+    #: Nodepool identity per node (GKE ``cloud.google.com/gke-nodepool``).
+    #: A multi-host slice's host NODES share one nodepool == one
+    #: physical slice; a hosts>1 replica must take all its nodes from
+    #: ONE pool — free hosts on two different slices are not a slice.
+    node_pool: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass

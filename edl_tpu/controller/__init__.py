@@ -3,6 +3,8 @@
 from edl_tpu.controller.jobparser import (
     JobParser,
     parse_to_trainer,
+    parse_to_trainer_manifests,
+    parse_to_trainer_slice,
     parse_to_coordinator,
     pod_env,
 )
@@ -12,6 +14,8 @@ from edl_tpu.controller.controller import Controller
 __all__ = [
     "JobParser",
     "parse_to_trainer",
+    "parse_to_trainer_manifests",
+    "parse_to_trainer_slice",
     "parse_to_coordinator",
     "pod_env",
     "JobLifecycle",

@@ -25,7 +25,7 @@ envs were wrong under elasticity).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from edl_tpu.cluster.tpu_topology import get_topology
 from edl_tpu.resource.training_job import TrainingJob, TPU_RESOURCE_KEY
@@ -38,6 +38,8 @@ from edl_tpu.resource.training_job import TrainingJob, TPU_RESOURCE_KEY
 JOB_LABEL = "edl-job"
 OWNER_LABEL = "edl-owner"
 ROLE_LABEL = "edl-role"
+#: replica index label on a multi-host slice's per-replica Job/pods
+REPLICA_LABEL = "edl-replica"
 
 
 def owner_references(job: TrainingJob) -> List[Dict[str, Any]]:
@@ -109,79 +111,193 @@ def _trainer_resources(job: TrainingJob) -> Dict[str, Dict[str, Any]]:
     return {"requests": requests, "limits": limits}
 
 
-def parse_to_trainer(job: TrainingJob) -> Dict[str, Any]:
-    """Trainer batch Job manifest (ref ``ParseToTrainer``,
-    ``pkg/jobparser.go:115-158``)."""
-    t = job.spec.trainer
-    topo = get_topology(t.slice_topology)
-    labels = {JOB_LABEL: job.name, ROLE_LABEL: "trainer"}
-    node_selector: Dict[str, str] = {}
-    if topo.chips > 0:
-        # GKE TPU scheduling vocabulary: accelerator type + topology.
-        node_selector = {
-            "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
-            "cloud.google.com/gke-tpu-topology": "x".join(
-                str(d) for d in topo.ici_mesh
-            ),
-        }
+def _node_selector(topo) -> Dict[str, str]:
+    """GKE TPU scheduling vocabulary: accelerator type + topology."""
+    if topo.chips <= 0:
+        return {}
+    return {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "x".join(
+            str(d) for d in topo.ici_mesh
+        ),
+    }
+
+
+def _trainer_metadata(
+    job: TrainingJob, name: str, labels: Dict[str, str]
+) -> Dict[str, Any]:
     metadata: Dict[str, Any] = {
-        "name": job.trainer_job_name(),
+        "name": name,
         "namespace": job.namespace,
         "labels": labels,
     }
     refs = owner_references(job)
     if refs:
         metadata["ownerReferences"] = refs
+    return metadata
+
+
+def _trainer_pod_template(
+    job: TrainingJob,
+    labels: Dict[str, str],
+    extra_env: Optional[List[Dict[str, Any]]] = None,
+    subdomain: str = "",
+    resources: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """The one trainer pod template both renderers share (single-host
+    batch Job and multi-host per-replica Indexed Job) — env base, the
+    jaxcoord port, volumes, restartPolicy, GKE nodeSelector."""
+    topo = get_topology(job.spec.trainer.slice_topology)
+    spec: Dict[str, Any] = {
+        "restartPolicy": "Never",  # ref :153
+        "nodeSelector": _node_selector(topo),
+        "containers": [
+            {
+                "name": "trainer",
+                "image": job.spec.image,
+                "command": ["python", "-m", "edl_tpu.launcher"],
+                "env": pod_env(job) + list(extra_env or ()),
+                "resources": (
+                    resources if resources is not None else _trainer_resources(job)
+                ),
+                "ports": [
+                    # ONE port: the jax coordination service (the
+                    # reference opened ports_num + ports_num_for_sparse
+                    # pserver ports, :237-249 — none of that exists on
+                    # TPU)
+                    {"name": "jaxcoord", "containerPort": 8476}
+                ],
+            }
+        ],
+        "volumes": list(job.spec.volumes),
+    }
+    if subdomain:
+        spec["subdomain"] = subdomain
+    return {"metadata": {"labels": dict(labels)}, "spec": spec}
+
+
+#: Victim coordination depends on this field: the autoscaler gracefully
+#: deletes the coordinator-chosen victims BEFORE lowering parallelism.
+#: Under the default policy (TerminatingOrFailed) the Job controller
+#: would replace still-Terminating victims while parallelism is briefly
+#: unchanged, and the subsequent PUT could then kill an active-world
+#: member.  "Failed" defers replacement until pods are fully terminal,
+#: so active count == parallelism converges without the controller ever
+#: choosing a victim (k8s >= 1.28; older servers drop the unknown field
+#: and keep the reference's kube-chooses semantics).
+_POD_REPLACEMENT_POLICY = "Failed"
+
+
+def parse_to_trainer(job: TrainingJob) -> Dict[str, Any]:
+    """Trainer batch Job manifest for single-host topologies
+    (ref ``ParseToTrainer``, ``pkg/jobparser.go:115-158``).  Multi-host
+    topologies render per-replica Indexed Jobs instead — use
+    ``parse_to_trainer_manifests``."""
+    if job.hosts_per_replica() > 1:
+        raise ValueError(
+            f"slice topology {job.spec.trainer.slice_topology!r} spans "
+            f"{job.hosts_per_replica()} hosts; render it with "
+            "parse_to_trainer_manifests (per-replica Indexed Jobs)"
+        )
+    t = job.spec.trainer
+    labels = {JOB_LABEL: job.name, ROLE_LABEL: "trainer"}
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
-        "metadata": metadata,
+        "metadata": _trainer_metadata(job, job.trainer_job_name(), labels),
         "spec": {
             "parallelism": t.min_instance,
             # completions unset: an elastic pool, not a run-to-N batch
             "backoffLimit": 0 if not job.spec.fault_tolerant else 1000000,
-            # Victim coordination depends on this: the autoscaler
-            # gracefully deletes the coordinator-chosen victims BEFORE
-            # lowering parallelism.  Under the default policy
-            # (TerminatingOrFailed) the Job controller would replace
-            # still-Terminating victims while parallelism is briefly
-            # unchanged, and the subsequent PUT could then kill an
-            # active-world member.  "Failed" defers replacement until
-            # pods are fully terminal, so active count == parallelism
-            # converges without the controller ever choosing a victim
-            # (k8s >= 1.28; older servers drop the unknown field and
-            # keep the reference's kube-chooses semantics).
-            "podReplacementPolicy": "Failed",
-            "template": {
-                "metadata": {"labels": dict(labels)},
-                "spec": {
-                    "restartPolicy": "Never",  # ref :153
-                    "nodeSelector": node_selector,
-                    "containers": [
-                        {
-                            "name": "trainer",
-                            "image": job.spec.image,
-                            "command": [
-                                "python",
-                                "-m",
-                                "edl_tpu.launcher",
-                            ],
-                            "env": pod_env(job),
-                            "resources": _trainer_resources(job),
-                            "ports": [
-                                # ONE port: the jax coordination service
-                                # (the reference opened ports_num +
-                                # ports_num_for_sparse pserver ports,
-                                # :237-249 — none of that exists on TPU)
-                                {"name": "jaxcoord", "containerPort": 8476}
-                            ],
-                        }
-                    ],
-                    "volumes": list(job.spec.volumes),
-                },
-            },
+            "podReplacementPolicy": _POD_REPLACEMENT_POLICY,
+            "template": _trainer_pod_template(job, labels),
         },
     }
+
+
+def parse_to_trainer_slice(job: TrainingJob, replica: int) -> Dict[str, Any]:
+    """One trainer REPLICA of a multi-host slice topology: an Indexed
+    batch Job of ``hosts`` pods (completions == parallelism == hosts),
+    all landing on the same physical slice via the GKE TPU nodeSelector.
+    Pod identity inside the replica comes from the completion index
+    (k8s injects ``JOB_COMPLETION_INDEX``; the launcher registers it as
+    the host index), and the headless trainer Service
+    (``parse_to_trainer_manifests``) gives the slice's TPU runtime
+    resolvable per-pod hostnames.  The reference's trainer Job was one
+    flat pod pool (``pkg/jobparser.go:115-158``) — multi-host slices
+    need pod GROUPS, which is why scaling actuates in whole Jobs here
+    (see ``Cluster.update_parallelism``)."""
+    hosts = job.hosts_per_replica()
+    labels = {
+        JOB_LABEL: job.name,
+        ROLE_LABEL: "trainer",
+        REPLICA_LABEL: str(replica),
+    }
+    base = _trainer_resources(job)
+    # Per-POD chips = per-replica chips / hosts (GKE podslice semantics).
+    per_host = str(job.tpu_per_host())
+    resources = {
+        "requests": {**base["requests"], TPU_RESOURCE_KEY: per_host},
+        "limits": {**base["limits"], TPU_RESOURCE_KEY: per_host},
+    }
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": _trainer_metadata(
+            job, f"{job.trainer_job_name()}-{replica}", labels
+        ),
+        "spec": {
+            "completionMode": "Indexed",
+            "completions": hosts,
+            "parallelism": hosts,
+            "backoffLimit": 0 if not job.spec.fault_tolerant else 1000000,
+            "podReplacementPolicy": _POD_REPLACEMENT_POLICY,
+            "template": _trainer_pod_template(
+                job,
+                labels,
+                extra_env=[{"name": "EDL_REPLICA", "value": str(replica)}],
+                subdomain=job.trainer_job_name(),
+                resources=resources,
+            ),
+        },
+    }
+
+
+def parse_to_trainer_manifests(
+    job: TrainingJob, replicas: int = 0
+) -> List[Dict[str, Any]]:
+    """All trainer manifests for a job at ``replicas`` replicas
+    (default min_instance).  Single-host: one batch Job whose
+    parallelism is the replica count.  Multi-host: one headless Service
+    (stable per-pod DNS for the slice runtime) plus one Indexed Job per
+    replica — the unit the autoscaler's actuation creates/deletes."""
+    replicas = replicas or job.spec.trainer.min_instance
+    if job.hosts_per_replica() == 1:
+        m = parse_to_trainer(job)
+        m["spec"]["parallelism"] = replicas
+        return [m]
+    labels = {JOB_LABEL: job.name, ROLE_LABEL: "trainer"}
+    meta: Dict[str, Any] = {
+        "name": job.trainer_job_name(),
+        "namespace": job.namespace,
+        "labels": dict(labels),
+    }
+    refs = owner_references(job)
+    if refs:
+        meta["ownerReferences"] = refs
+    headless = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta,
+        "spec": {
+            "clusterIP": "None",
+            "selector": dict(labels),
+            "ports": [{"name": "jaxcoord", "port": 8476}],
+        },
+    }
+    return [headless] + [
+        parse_to_trainer_slice(job, r) for r in range(replicas)
+    ]
 
 
 def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
@@ -235,6 +351,10 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
                                 # + compile) must not outlive it
                                 "--heartbeat-timeout",
                                 "30",
+                                # multi-host slices: pods group into
+                                # replicas of this many hosts
+                                "--hosts",
+                                str(job.hosts_per_replica()),
                             ],
                             "env": [
                                 {"name": "EDL_JOB_NAME", "value": job.name},

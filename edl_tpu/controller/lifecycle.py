@@ -47,16 +47,24 @@ class JobLifecycle:
         created = []
         try:
             if self.cluster.kube.get_workload(job.coordinator_name()) is None:
-                self.cluster.kube.apply_manifests(parse_to_coordinator(job))
+                # record intent BEFORE applying: a mid-apply failure
+                # must roll back the partial creation
                 created.append(job.coordinator_name())
+                self.cluster.kube.apply_manifests(parse_to_coordinator(job))
             if self.cluster.get_trainer_workload(job) is None:
-                self.cluster.create_trainer_workload(job)
                 created.append(job.trainer_job_name())
+                self.cluster.create_trainer_workload(job)
             return True
         except Exception:
             for name in created:  # rollback partial creation
                 try:
-                    self.cluster.kube.delete_workload(name)
+                    if name == job.trainer_job_name():
+                        # enumerates per-replica slice Jobs too — a bare
+                        # delete_workload would orphan a multi-host
+                        # job's partially created mh-trainer-N Jobs
+                        self.cluster.delete_trainer_workload(job)
+                    else:
+                        self.cluster.kube.delete_workload(name)
                 except Exception:
                     pass
             return False
@@ -80,19 +88,25 @@ class JobLifecycle:
         the autoscaler's plan."""
         from edl_tpu.controller.jobparser import (
             parse_to_coordinator,
-            parse_to_trainer,
+            parse_to_trainer_manifests,
         )
 
         try:
             cur = self.cluster.get_trainer_workload(job)
-            trainer = parse_to_trainer(job)
+            p = job.spec.trainer.min_instance
             if cur is not None:
                 p = max(
                     job.spec.trainer.min_instance,
                     min(cur.parallelism, job.spec.trainer.max_instance),
                 )
-                trainer["spec"]["parallelism"] = p
-            self.cluster.kube.apply_manifests([trainer])
+            self.cluster.kube.apply_manifests(
+                parse_to_trainer_manifests(job, replicas=p)
+            )
+            if job.hosts_per_replica() > 1:
+                # re-applying manifests only covers replicas [0, p); a
+                # clamp DOWN (max_instance shrank) must also delete the
+                # excess slice Jobs — update_parallelism owns that.
+                self.cluster.update_parallelism(job, p)
             self.cluster.kube.apply_manifests(parse_to_coordinator(job))
             return True
         except Exception:

@@ -51,6 +51,22 @@ def env_config() -> dict:
             else ""
         ),
         "history_file": e.get("EDL_HISTORY_FILE", ""),
+        # Multi-host slice placement: replica index from the per-replica
+        # Job's env; host index from the Indexed Job's completion index
+        # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides
+        # for tests/local runs).
+        "replica": (
+            int(e["EDL_REPLICA"]) if e.get("EDL_REPLICA") else None
+        ),
+        "host_index": (
+            int(e["EDL_HOST_INDEX"])
+            if e.get("EDL_HOST_INDEX")
+            else (
+                int(e["JOB_COMPLETION_INDEX"])
+                if e.get("JOB_COMPLETION_INDEX")
+                else None
+            )
+        ),
     }
 
 
@@ -218,7 +234,11 @@ def make_world_builder(
             try:
                 jax.distributed.initialize(
                     coordinator_address=f"{host}:{port}",
-                    num_processes=plan.world_size,
+                    # members lists every POD; world_size counts trainer
+                    # REPLICAS (a multi-host replica is `hosts` pods,
+                    # each its own process) — they coincide only on
+                    # single-host topologies.
+                    num_processes=len(plan.members),
                     process_id=rank,
                     initialization_timeout=_FORMATION_TIMEOUT_S,
                     # Keep the teardown barrier short: scale-down peers
@@ -331,7 +351,12 @@ def run(
                 return devs
 
             gbs = gbs or 64
-        coordinator.register(trainer_id, address=pod_address)
+        coordinator.register(
+            trainer_id,
+            address=pod_address,
+            replica=cfg["replica"],
+            host=cfg["host_index"],
+        )
         n_dev = 1 if pod_address else len(jax.devices())
     else:
         n_dev = len(jax.devices())
@@ -375,6 +400,8 @@ def run(
     )
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
+    et.register_replica = cfg["replica"]
+    et.register_host = cfg["host_index"]
     if hist_f is not None:
         def on_resize(ev):
             import dataclasses

@@ -277,6 +277,23 @@ class TrainingJob:
         """ref NeedGPU() (``:193-197``)."""
         return self.tpu_per_trainer() > 0
 
+    def hosts_per_replica(self) -> int:
+        """Host machines (pods) per trainer replica.  1 for single-host
+        slices; >1 for multi-host topologies (v5e-16 = 2 hosts), where
+        one replica renders as an Indexed Job of this many pods."""
+        from edl_tpu.cluster.tpu_topology import get_topology
+
+        try:
+            return max(1, get_topology(self.spec.trainer.slice_topology).hosts)
+        except ValueError:
+            return 1
+
+    def tpu_per_host(self) -> int:
+        """TPU chips each POD requests: a multi-host replica's chips
+        split across its host pods (GKE podslice semantics: the per-pod
+        ``google.com/tpu`` limit is chips-per-host)."""
+        return self.tpu_per_trainer() // self.hosts_per_replica()
+
     def fullname(self) -> str:
         return f"{self.namespace}/{self.name}"
 

@@ -90,7 +90,10 @@ class CoordinatorServer:
                 try:
                     if self.path == "/register":
                         plan = coord.register(
-                            req["trainer_id"], address=req.get("address", "")
+                            req["trainer_id"],
+                            address=req.get("address", ""),
+                            replica=req.get("replica"),
+                            host=req.get("host"),
                         )
                         self._reply({"plan": _plan_to_dict(plan)})
                     elif self.path == "/deregister":
@@ -203,9 +206,21 @@ class HTTPCoordinator:
         )
 
     # -- LocalCoordinator interface -----------------------------------------
-    def register(self, trainer_id: str, address: str = "") -> Optional[ElasticPlan]:
+    def register(
+        self,
+        trainer_id: str,
+        address: str = "",
+        replica=None,
+        host=None,
+    ) -> Optional[ElasticPlan]:
         return _plan_from_dict(
-            self._post("/register", trainer_id=trainer_id, address=address)["plan"]
+            self._post(
+                "/register",
+                trainer_id=trainer_id,
+                address=address,
+                replica=replica,
+                host=host,
+            )["plan"]
         )
 
     def deregister(self, trainer_id: str):
@@ -275,6 +290,15 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
             "explicitly empty = NO legal size (trainers hold at the barrier)"
         ),
     )
+    p.add_argument(
+        "--hosts",
+        type=int,
+        default=1,
+        help=(
+            "pods per trainer replica (multi-host slice topologies: one "
+            "replica = an Indexed Job of this many pods)"
+        ),
+    )
     args = p.parse_args(argv)
     legal = (
         None
@@ -286,6 +310,7 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
         max_world=args.max_world,
         heartbeat_timeout=args.heartbeat_timeout,
         legal_sizes=legal,
+        hosts_per_replica=args.hosts,
     )
     if args.target_steps:
         coord.set_target_steps(args.target_steps)

@@ -60,6 +60,13 @@ class _Member:
     joined_generation: int
     acked_generation: int = -1
     address: str = ""
+    #: slice-replica index for multi-host topologies (one trainer
+    #: replica = ``hosts_per_replica`` pods, the per-replica Indexed
+    #: Job's pods); None for single-host replicas
+    replica: Optional[int] = None
+    #: host index within the replica (the Job completion index) —
+    #: fixes intra-replica rank order so TPU_WORKER_ID agrees
+    host: Optional[int] = None
 
 
 class LocalCoordinator:
@@ -76,13 +83,22 @@ class LocalCoordinator:
         heartbeat_timeout: float = 10.0,
         legal_sizes: Optional[List[int]] = None,
         clock: Callable[[], float] = time.monotonic,
+        hosts_per_replica: int = 1,
     ):
         """``legal_sizes``: world sizes the runtime may form (from
         ``TrainingJob.legal_world_sizes()`` — divisors of the global
         batch within [min,max], SURVEY.md §7.4).  The plan quantizes
         down to the largest legal size <= min(members, target); with no
         legal size small enough the plan's world_size is 0 and trainers
-        hold at the barrier until membership recovers."""
+        hold at the barrier until membership recovers.
+
+        ``hosts_per_replica``: pods per trainer replica (>1 for
+        multi-host slice topologies like v5e-16, where one replica is
+        an Indexed Job of ``hosts`` pods).  The plan then counts
+        REPLICAS in ``world_size``/targets/legal sizes while
+        ``members``/``addresses`` list every pod in replica-major,
+        host-minor rank order; only replicas with all their hosts
+        registered can join the active world."""
         self._lock = threading.Condition()
         self._members: Dict[str, _Member] = {}
         self._generation = 0
@@ -94,6 +110,9 @@ class LocalCoordinator:
         self._legal_sizes = (
             sorted(set(legal_sizes)) if legal_sizes is not None else None
         )
+        if hosts_per_replica < 1:
+            raise ValueError("hosts_per_replica must be >= 1")
+        self._hosts_per_replica = hosts_per_replica
         self._clock = clock
         self._latest_checkpoint_step = -1
         self._plan: Optional[ElasticPlan] = None
@@ -105,17 +124,34 @@ class LocalCoordinator:
         self._completed_step = -1
 
     # -- membership (trainer-facing) ----------------------------------------
-    def register(self, trainer_id: str, address: str = "") -> ElasticPlan:
+    def register(
+        self,
+        trainer_id: str,
+        address: str = "",
+        replica: Optional[int] = None,
+        host: Optional[int] = None,
+    ) -> ElasticPlan:
         """Join the job.  Bumps the generation; returns the new plan.
         ``address`` is the member's reachable host:port (used to seed
-        the JAX process group when the world spans pods)."""
+        the JAX process group when the world spans pods).  Multi-host
+        pods pass their replica index and host (completion) index; a
+        re-register (rejoin after eviction) preserves a previously
+        declared placement when the new call omits it."""
         with self._lock:
             now = self._clock()
+            prev = self._members.get(trainer_id)
+            if prev is not None:
+                if replica is None:
+                    replica = prev.replica
+                if host is None:
+                    host = prev.host
             self._members[trainer_id] = _Member(
                 trainer_id=trainer_id,
                 last_heartbeat=now,
                 joined_generation=self._generation + 1,
                 address=address,
+                replica=replica,
+                host=host,
             )
             self._rebuild_plan("join")
             return self._plan
@@ -268,25 +304,60 @@ class LocalCoordinator:
                 self._lock.wait(timeout=min(remaining, 0.5))
 
     # -- internals ----------------------------------------------------------
-    def _rebuild_plan(self, reason: str):
-        """Recompute the plan after any membership/target change.  Caller
-        holds the lock."""
-        # Rank order: stable by join time (dict preserves insertion);
-        # members beyond the target world wait in standby (they keep
-        # heartbeating and join when the target grows — the analog of
-        # pending pods the kube Job controller will fold in).
-        alive = list(self._members)
-        world = min(len(alive), self._target_world, self._max_world)
+    def _active_members(self) -> tuple:
+        """(active_member_ids, world_size_in_replicas) under the current
+        membership/target.  Caller holds the lock.
+
+        Single-host (hosts_per_replica == 1): rank order is join order
+        (dict preserves insertion); members beyond the target wait in
+        standby (they keep heartbeating and join when the target grows
+        — the analog of pending pods the kube Job controller folds in).
+
+        Multi-host: members group into replicas by their declared
+        replica index; only COMPLETE replicas (all ``hosts`` pods
+        present with distinct host indexes) are eligible, taken in
+        ascending replica order (the actuation creates/deletes the
+        highest-indexed per-replica Jobs, so lowest-indexed survive
+        scale-down).  Rank order is replica-major, host-minor — the
+        order the slice's TPU_WORKER_IDs expect."""
+        hosts = self._hosts_per_replica
+        if hosts == 1:
+            alive = list(self._members)
+            world = min(len(alive), self._target_world, self._max_world)
+            if self._legal_sizes is not None:
+                fitting = [s for s in self._legal_sizes if s <= world]
+                world = fitting[-1] if fitting else 0
+            return tuple(alive[:world]), world
+
+        groups: Dict[int, Dict[int, str]] = {}
+        for tid, m in self._members.items():
+            if m.replica is None or m.host is None:
+                continue  # unplaceable pod: cannot join a sliced world
+            groups.setdefault(m.replica, {})[m.host] = tid
+        complete = sorted(
+            r
+            for r, g in groups.items()
+            if len(g) == hosts and set(g) == set(range(hosts))
+        )
+        world = min(len(complete), self._target_world, self._max_world)
         if self._legal_sizes is not None:
             fitting = [s for s in self._legal_sizes if s <= world]
             world = fitting[-1] if fitting else 0
-        active = tuple(alive[:world])
+        active = tuple(
+            groups[r][h] for r in complete[:world] for h in range(hosts)
+        )
+        return active, world
+
+    def _rebuild_plan(self, reason: str):
+        """Recompute the plan after any membership/target change.  Caller
+        holds the lock."""
+        active, world = self._active_members()
         addresses = tuple(self._members[t].address for t in active)
         if (
             self._plan is not None
             and self._plan.members == active
             and self._plan.addresses == addresses
-            and self._plan.world_size == len(active)
+            and self._plan.world_size == world
         ):
             # The change touched only standby membership (e.g. an extra
             # pod joined beyond the target, or a standby left): the
@@ -297,7 +368,7 @@ class LocalCoordinator:
         self._generation += 1
         self._plan = ElasticPlan(
             generation=self._generation,
-            world_size=len(active),
+            world_size=world,
             members=active,
             restore_step=self._latest_checkpoint_step,
             addresses=addresses,
@@ -307,7 +378,7 @@ class LocalCoordinator:
                 "t": self._clock(),
                 "generation": self._generation,
                 "reason": reason,
-                "world_size": len(active),
+                "world_size": world,
                 "members": active,
             }
         )

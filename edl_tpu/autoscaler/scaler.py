@@ -100,21 +100,27 @@ class Autoscaler:
                 self.jobs.pop(evt.job.name, None)
 
     # -- one decision cycle ---------------------------------------------------
-    def run_once(self) -> Optional[ScalePlan]:
+    def run_once(self, workloads=None, pods_by_job=None) -> Optional[ScalePlan]:
         """Inventory -> pending detection -> fixed-point dry run ->
         actuation.  Returns the plan (None when there was nothing to
-        decide over)."""
+        decide over).  ``workloads`` / ``pods_by_job``: optional
+        snapshots (``Cluster.trainer_workloads_map`` / ``job_pods_map``)
+        shared across the controller tick; computed here (ONE list call
+        each) when absent."""
         self._drain_events()
         if not self.jobs:
             return None
         r = self.cluster.inquiry_resource()
-        pods_by_job = self.cluster.job_pods_map()  # ONE pod list per tick
+        if pods_by_job is None:
+            pods_by_job = self.cluster.job_pods_map()  # ONE pod list
+        if workloads is None:
+            workloads = self.cluster.trainer_workloads_map()  # ONE list
 
         views: List[tuple] = []
         demand = PendingDemand()
         have_pending = False
         for job in self.jobs.values():
-            w = self.cluster.get_trainer_workload(job)
+            w = workloads.get(job.name)
             if w is None:
                 continue  # not created yet (ref tryToRetrieve..., :424-447)
             total, running, pending, _ = pods_by_job.get(job.name, (0, 0, 0, 0))

@@ -86,19 +86,16 @@ class Cluster:
                 out.append((int(suffix), w))
         return [w for _, w in sorted(out, key=lambda t: t[0])]
 
-    def get_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:
-        """The job's trainer workload view.  Single-host: the batch Job
-        itself.  Multi-host: a virtual aggregate over the per-replica
-        Indexed Jobs — ``parallelism`` counts REPLICAS (slice groups),
-        the unit every control-plane decision is made in."""
-        if job.hosts_per_replica() == 1:
-            return self.kube.get_workload(job.trainer_job_name())
-        slices = self._slice_jobs(job)
-        if not slices:
-            return None
+    @staticmethod
+    def _aggregate_slices(
+        job_name: str, trainer_name: str, slices: List[WorkloadInfo]
+    ) -> WorkloadInfo:
+        """Virtual aggregate over a multi-host job's per-replica Jobs:
+        ``parallelism`` counts REPLICAS (slice groups), the unit every
+        control-plane decision is made in."""
         return WorkloadInfo(
-            name=job.trainer_job_name(),
-            job_name=job.name,
+            name=trainer_name,
+            job_name=job_name,
             parallelism=len(slices),
             cpu_request_milli=slices[0].cpu_request_milli,
             memory_request_mega=slices[0].memory_request_mega,
@@ -106,6 +103,44 @@ class Cluster:
             kind="Job",
             owner=slices[0].owner,
         )
+
+    def get_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:
+        """The job's trainer workload view.  Single-host: the batch Job
+        itself.  Multi-host: the virtual replica-count aggregate."""
+        if job.hosts_per_replica() == 1:
+            return self.kube.get_workload(job.trainer_job_name())
+        slices = self._slice_jobs(job)
+        if not slices:
+            return None
+        return self._aggregate_slices(job.name, job.trainer_job_name(), slices)
+
+    def trainer_workloads_map(self) -> Dict[str, WorkloadInfo]:
+        """job name -> trainer workload view for EVERY framework job,
+        from ONE ``list_workloads`` call — the control loop uses this so
+        a tick costs O(1) kubectl subprocesses, not one ``get`` per job
+        (the reference's ``GetTrainerJob``-per-job pattern blows the 5s
+        tick at cluster scope).  Multi-host jobs aggregate to their
+        replica count, same as ``get_trainer_workload``."""
+        singles: Dict[str, WorkloadInfo] = {}
+        groups: Dict[str, List[tuple]] = {}
+        for w in self.kube.list_workloads():
+            if w.kind != "Job" or not w.job_name:
+                continue
+            trainer_name = f"{w.job_name}-trainer"
+            if w.name == trainer_name:
+                singles[w.job_name] = w
+                continue
+            prefix = trainer_name + "-"
+            if w.name.startswith(prefix) and w.name[len(prefix):].isdigit():
+                groups.setdefault(w.job_name, []).append(
+                    (int(w.name[len(prefix):]), w)
+                )
+        for job_name, pairs in groups.items():
+            slices = [w for _, w in sorted(pairs, key=lambda t: t[0])]
+            singles[job_name] = self._aggregate_slices(
+                job_name, f"{job_name}-trainer", slices
+            )
+        return singles
 
     def update_parallelism(self, job: TrainingJob, parallelism: int, retries: int = 5) -> bool:
         """Set the trainer replica count.

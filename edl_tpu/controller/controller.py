@@ -54,6 +54,9 @@ class Controller:
         self._pending_refresh: set = set()
         #: last status payload pushed to each CR (avoid a PATCH per tick)
         self._pushed_status: Dict[str, str] = {}
+        #: jobs whose coordinator handshake is currently failing (each
+        #: outage logs once; cleared on recovery)
+        self._handshake_down: set = set()
 
     # -- event handlers (ref onAdd/onUpdate/onDelete, :110-147) --------------
     def on_add(self, job: TrainingJob) -> TrainingJob:
@@ -101,21 +104,31 @@ class Controller:
         self.lifecycle.destroy(job)
         self.jobs.pop(job.name, None)
         # A resubmitted job with an identical status must hit the fresh
-        # CR: drop the dedup key with the job.
+        # CR: drop the dedup key with the job.  Same for the handshake
+        # outage marker — a new job's outage must log again.
         self._pushed_status.pop(job.name, None)
         self._pending_refresh.discard(job.name)
+        self._handshake_down.discard(job.name)
 
     # -- status reconciliation (what the reference never did) ----------------
-    def reconcile_status(self, pods_by_job: Optional[Dict] = None) -> None:
+    def reconcile_status(
+        self,
+        pods_by_job: Optional[Dict] = None,
+        workloads: Optional[Dict] = None,
+    ) -> None:
         """Refresh every job's status from observed cluster state.
-        ``pods_by_job``: share one pod-list snapshot across the tick's
-        passes (each list is a kubectl subprocess on a real cluster)."""
+        ``pods_by_job`` / ``workloads``: share one pod-list and one
+        workload-list snapshot across the tick's passes (each list is a
+        kubectl subprocess on a real cluster; per-job gets would make
+        the tick O(jobs))."""
         if pods_by_job is None:
             pods_by_job = self.cluster.job_pods_map()
+        if workloads is None:
+            workloads = self.cluster.trainer_workloads_map()
         for job in list(self.jobs.values()):
             if job.status.state in (JobState.SUCCEED, JobState.FAILED):
                 continue
-            w = self.cluster.get_trainer_workload(job)
+            w = workloads.get(job.name)
             if w is None:
                 job.status.state = JobState.FAILED
                 job.status.message = "trainer workload disappeared"
@@ -170,16 +183,34 @@ class Controller:
                 continue  # next tick retries (level-triggered)
 
     # -- actuation handshake + completion (coordinator-facing) ---------------
-    def reconcile_targets(self, pods_by_job: Optional[Dict] = None) -> None:
+    #: concurrent coordinator probes per tick: each probe can block on
+    #: its connect timeout (~1-2s); serial probes would make the tick
+    #: O(jobs x timeout)
+    PROBE_WORKERS = 8
+
+    def reconcile_targets(
+        self,
+        pods_by_job: Optional[Dict] = None,
+        workloads: Optional[Dict] = None,
+    ) -> None:
         """Level-triggered half of the actuation handshake: converge
         every live coordinator's target world onto the observed trainer
         parallelism, and fire completion when a coordinator reports the
         job finished.  The autoscaler POSTs targets eagerly at actuation
         time; this pass repairs any handshake that was lost (coordinator
         still scheduling, transient network) so the two halves cannot
-        stay disconnected (VERDICT r2 #1)."""
+        stay disconnected (VERDICT r2 #1).  Probes run with bounded
+        concurrency, and a RUNNING job whose coordinator stays
+        unreachable is logged (once per outage) — a bad Service or
+        NetworkPolicy must not be invisible."""
+        import sys
+        from concurrent.futures import ThreadPoolExecutor
+
         if pods_by_job is None:
             pods_by_job = self.cluster.job_pods_map()
+        if workloads is None:
+            workloads = self.cluster.trainer_workloads_map()
+        targets = []
         for job in list(self.jobs.values()):
             if job.status.state in (JobState.SUCCEED, JobState.FAILED):
                 continue
@@ -188,21 +219,47 @@ class Controller:
                 # likely still scheduling too — don't burn the control
                 # tick on connect timeouts (each probe can block ~1s).
                 continue
-            w = self.cluster.get_trainer_workload(job)
+            w = workloads.get(job.name)
             if w is None:
                 continue
+            targets.append((job, w.parallelism))
+        if not targets:
+            return
+
+        def probe(item):
+            job, parallelism = item
             try:
-                # Factory contract is job -> client (scaler.py docstring);
-                # keyword extras would break injected factories.
+                # Factory contract is job -> client (scaler.py
+                # docstring); keyword extras would break injected
+                # factories.
                 coord = self._coord_client(job)
                 m = coord.metrics()
                 if m.get("completed"):
-                    self.mark_succeeded(job.name)
-                    continue
-                if m.get("target_world") != w.parallelism:
-                    coord.set_target_world(w.parallelism)
-            except Exception:
-                continue  # coordinator not reachable yet; next tick
+                    return (job.name, "completed")
+                if m.get("target_world") != parallelism:
+                    coord.set_target_world(parallelism)
+                return (job.name, "ok")
+            except Exception as e:
+                return (job.name, f"unreachable: {e}")
+
+        with ThreadPoolExecutor(max_workers=self.PROBE_WORKERS) as pool:
+            results = list(pool.map(probe, targets))
+        for name, outcome in results:
+            if outcome == "completed":
+                self.mark_succeeded(name)
+            elif outcome == "ok":
+                self._handshake_down.discard(name)
+            elif name not in self._handshake_down:
+                # Log the outage once; clear on recovery so a later
+                # outage logs again.  The handshake stays level-
+                # triggered — the next tick retries regardless.
+                self._handshake_down.add(name)
+                print(
+                    f"[edl-controller] coordinator handshake for {name} "
+                    f"failing while the job has running trainers "
+                    f"({outcome})",
+                    file=sys.stderr,
+                )
 
     # -- orphan GC (level-triggered, from observed state) --------------------
     def gc_orphans(self, live_cr_names) -> int:
@@ -231,6 +288,7 @@ class Controller:
             self._freeze_pending_clock(job)
             self.autoscaler.on_del(job)
             self.lifecycle.complete(job)
+            self._handshake_down.discard(name)
 
     def _freeze_pending_clock(self, job: TrainingJob) -> None:
         """A job reaching a terminal state without ever being observed
@@ -241,15 +299,26 @@ class Controller:
 
     # -- run loop (ref Run, :64-76: watch goroutine + autoscaler goroutine) --
     def run_once(self) -> None:
-        # One pod-list snapshot serves both reconcile passes this tick.
+        # One pod-list + one workload-list snapshot serve every pass
+        # this tick: the tick costs O(1) kubectl subprocesses however
+        # many jobs the controller manages.
         pods_by_job = self.cluster.job_pods_map()
-        self.reconcile_status(pods_by_job)
+        workloads = self.cluster.trainer_workloads_map()
+        self.reconcile_status(pods_by_job, workloads)
         for name in list(self._pending_refresh):
             job = self.jobs.get(name)
             if job is None or self.lifecycle.refresh(job):
                 self._pending_refresh.discard(name)
-        self.autoscaler.run_once()
-        self.reconcile_targets(pods_by_job)
+        plan = self.autoscaler.run_once(
+            workloads=workloads, pods_by_job=pods_by_job
+        )
+        if plan is not None and plan.targets:
+            # The actuation just changed parallelism: re-list (still
+            # O(1)) so the handshake below converges on the NEW values —
+            # reconciling against the stale snapshot would POST the old
+            # target back and force a spurious world resize.
+            workloads = self.cluster.trainer_workloads_map()
+        self.reconcile_targets(pods_by_job, workloads)
 
     def run(self, interval: float = 5.0) -> None:
         while not self._stop.is_set():

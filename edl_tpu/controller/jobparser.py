@@ -264,40 +264,37 @@ def parse_to_trainer_slice(job: TrainingJob, replica: int) -> Dict[str, Any]:
 
 
 def parse_to_trainer_manifests(
-    job: TrainingJob, replicas: int = 0
+    job: TrainingJob,
+    replicas: int = 0,
+    indexes: Optional[List[int]] = None,
 ) -> List[Dict[str, Any]]:
     """All trainer manifests for a job at ``replicas`` replicas
     (default min_instance).  Single-host: one batch Job whose
     parallelism is the replica count.  Multi-host: one headless Service
     (stable per-pod DNS for the slice runtime) plus one Indexed Job per
-    replica — the unit the autoscaler's actuation creates/deletes."""
+    replica — the unit the autoscaler's actuation creates/deletes.
+    ``indexes`` overrides WHICH replica indexes to render (a refresh of
+    live non-contiguous replicas must re-apply the EXISTING Jobs, not
+    conjure fresh low-index ones)."""
     replicas = replicas or job.spec.trainer.min_instance
     if job.hosts_per_replica() == 1:
         m = parse_to_trainer(job)
         m["spec"]["parallelism"] = replicas
         return [m]
     labels = {JOB_LABEL: job.name, ROLE_LABEL: "trainer"}
-    meta: Dict[str, Any] = {
-        "name": job.trainer_job_name(),
-        "namespace": job.namespace,
-        "labels": dict(labels),
-    }
-    refs = owner_references(job)
-    if refs:
-        meta["ownerReferences"] = refs
     headless = {
         "apiVersion": "v1",
         "kind": "Service",
-        "metadata": meta,
+        "metadata": _trainer_metadata(job, job.trainer_job_name(), labels),
         "spec": {
             "clusterIP": "None",
             "selector": dict(labels),
             "ports": [{"name": "jaxcoord", "port": 8476}],
         },
     }
-    return [headless] + [
-        parse_to_trainer_slice(job, r) for r in range(replicas)
-    ]
+    if indexes is None:
+        indexes = list(range(replicas))
+    return [headless] + [parse_to_trainer_slice(job, r) for r in indexes]
 
 
 def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:

@@ -99,14 +99,27 @@ class JobLifecycle:
                     job.spec.trainer.min_instance,
                     min(cur.parallelism, job.spec.trainer.max_instance),
                 )
-            self.cluster.kube.apply_manifests(
-                parse_to_trainer_manifests(job, replicas=p)
-            )
             if job.hosts_per_replica() > 1:
-                # re-applying manifests only covers replicas [0, p); a
-                # clamp DOWN (max_instance shrank) must also delete the
-                # excess slice Jobs — update_parallelism owns that.
+                # Re-apply the spec into the EXISTING replica Jobs the
+                # clamp keeps (lowest indexes — the same ones
+                # update_parallelism and the coordinator keep; rendering
+                # range(p) instead would conjure fresh empty low-index
+                # Jobs that then displace live high-index replicas).
+                have = sorted(
+                    int(w.name.rsplit("-", 1)[1])
+                    for w in self.cluster._slice_jobs(job)
+                )
+                self.cluster.kube.apply_manifests(
+                    parse_to_trainer_manifests(
+                        job, replicas=p, indexes=have[:p] or None
+                    )
+                )
+                # count convergence (creates missing / deletes excess)
                 self.cluster.update_parallelism(job, p)
+            else:
+                self.cluster.kube.apply_manifests(
+                    parse_to_trainer_manifests(job, replicas=p)
+                )
             self.cluster.kube.apply_manifests(parse_to_coordinator(job))
             return True
         except Exception:

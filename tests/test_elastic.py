@@ -375,3 +375,71 @@ def test_broken_world_recovers_on_generation_bump(devices8):
     # recorded ran in a rebuilt (bumped) generation.
     gens = {r.generation for r in history}
     assert min(gens) > 1, f"expected only rebuilt generations, saw {gens}"
+
+
+def test_deterministic_step_failure_exhausts_cap(devices8):
+    """ADVICE r3: a deterministic error recurring at ONE step (e.g. a
+    poisoned checkpoint path) must exhaust the broken-world cap and
+    surface — the replayed interval's completed steps must NOT re-arm
+    it (counter resets only on progress PAST the failing step), or the
+    trainer loops teardown/replay forever pinned at that step."""
+    import threading
+
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=2, max_world=2)
+    coord.register("a")
+    coord.register("b")
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-2),
+        it,
+        coord,
+        devices=devices8[:2],
+        checkpoint_interval=2,
+        world_builder=lambda plan: devices8[:2],
+    )
+    et.heartbeat_ids = ["a", "b"]
+    et.barrier_poll_interval = 0.01
+
+    FAIL_AT = 5  # odd: the restore point (ckpt step 4) forces a replay
+    orig_trainer_for = et._trainer_for
+
+    def poisoned_trainer_for(ws):
+        tr = orig_trainer_for(ws)
+        if not getattr(tr, "_poisoned", False):
+            orig_step = tr.step
+
+            def step(state, batch):
+                if int(state.step) == FAIL_AT:
+                    raise ValueError("deterministic failure at step 5")
+                return orig_step(state, batch)
+
+            tr.step = step
+            tr._poisoned = True
+        return tr
+
+    et._trainer_for = poisoned_trainer_for
+
+    # The reaper analog: keep bumping the generation so every broken
+    # world gets re-admitted (otherwise the hold would mask the loop).
+    stop = threading.Event()
+
+    def bumper():
+        while not stop.wait(0.25):
+            coord.deregister("b")
+            coord.register("b")
+
+    th = threading.Thread(target=bumper, daemon=True)
+    th.start()
+    try:
+        with pytest.raises(ValueError, match="deterministic failure"):
+            et.run(FAIL_AT + 3)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+    # The cap was exhausted by the SAME step failing repeatedly, even
+    # though the replayed step 4 completed between failures.
+    assert et._world_failures >= et.max_world_failures
+    assert et._last_failed_step == FAIL_AT

@@ -1,9 +1,12 @@
 #!/usr/bin/env bash
 # CI entrypoint (ref analog: .travis.yml:10-18 — lint + `go test`).
-# Runs the whole suite on an 8-device virtual-CPU mesh: tests/conftest.py
-# forces JAX_PLATFORMS=cpu + --xla_force_host_platform_device_count=8,
-# so multi-chip sharding paths execute without TPU hardware.
+# Lint gate first (tools/lint.py, the gofmt/govet/golint analog for an
+# image with no Python linters installed), then the whole suite on an
+# 8-device virtual-CPU mesh: tests/conftest.py forces JAX_PLATFORMS=cpu
+# + --xla_force_host_platform_device_count=8, so multi-chip sharding
+# paths execute without TPU hardware.
 set -euo pipefail
 cd "$(dirname "$0")"
 
+python tools/lint.py
 python -m pytest tests/ -x -q "$@"

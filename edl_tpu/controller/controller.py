@@ -26,7 +26,7 @@ from typing import Callable, Dict, List, Optional
 from edl_tpu.autoscaler.scaler import Autoscaler
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.controller.lifecycle import JobLifecycle
-from edl_tpu.resource.training_job import JobState, TrainingJob, ValidationError
+from edl_tpu.resource.training_job import JobState, TrainingJob
 
 
 class Controller:

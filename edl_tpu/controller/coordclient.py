@@ -20,7 +20,6 @@ without cluster DNS (tests, local runs): a format string with fields
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 from edl_tpu.resource.training_job import TrainingJob
 

@@ -18,7 +18,7 @@ everything (ref ``:135-140``).
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Callable
 
 from edl_tpu.cluster.cluster import Cluster
 from edl_tpu.resource.training_job import TrainingJob

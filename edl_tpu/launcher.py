@@ -143,10 +143,13 @@ def _install_nonfatal_heartbeat_callback() -> None:
         kwargs.setdefault("missed_heartbeat_callback", _log_only)
         try:
             return orig(*args, **kwargs)
-        except TypeError:
-            # kwarg rejected at call time (uninspectable signature
-            # drifted): degrade to stock behavior rather than killing
-            # world formation.
+        except TypeError as e:
+            # Scope the fallback to ACTUAL signature drift: only a
+            # TypeError naming our kwarg means it was rejected; any
+            # other TypeError is a real bug that must surface, not be
+            # retried (the factory may have partially connected).
+            if "missed_heartbeat_callback" not in str(e):
+                raise
             kwargs.pop("missed_heartbeat_callback", None)
             warn("kwarg rejected at call time")
             return orig(*args, **kwargs)

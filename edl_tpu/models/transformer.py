@@ -24,7 +24,6 @@ TPU rebuild owns the trainer half too (SURVEY.md §0).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn

@@ -20,7 +20,6 @@ long-context capability bar of the TPU rebuild.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -22,8 +22,8 @@ Axis names (the framework-wide sharding vocabulary):
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np

@@ -15,7 +15,7 @@ batch semantics are invariant to world size.)
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import numpy as np

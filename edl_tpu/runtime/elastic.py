@@ -30,7 +30,7 @@ to a previously seen size pays zero recompilation — and
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -685,12 +685,14 @@ class ElasticTrainer:
                     attempted = (
                         step if step is not None else self._last_completed_step
                     )
-                    if attempted > self._last_failed_step:
-                        # Failing STRICTLY LATER than the previous
-                        # failure means real forward progress happened
-                        # in between (churn during a long replay window
-                        # is still churn) — re-arm the cap.  Only a
-                        # failure pinned at the same step accumulates.
+                    if attempted != self._last_failed_step:
+                        # A failure at a DIFFERENT step than the
+                        # previous one is churn (later = progress
+                        # happened in between; earlier = a fresh strike
+                        # during the replay window) — re-arm the cap.
+                        # Only a failure pinned at the same step
+                        # accumulates toward the deterministic-bug
+                        # diagnosis.
                         self._world_failures = 0
                     self._world_failures += 1
                     self._last_failed_step = attempted

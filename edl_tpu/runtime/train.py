@@ -15,8 +15,7 @@ rules), XLA inserting the collectives.  Elasticity = constructing a new
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -6,7 +6,6 @@ TPU-native behaviors it couldn't have: slice/batch-quantized steps,
 pending-demand shedding, livelock-free full-utilization fixed point.
 """
 
-import pytest
 
 from edl_tpu.autoscaler.algorithm import (
     JobView,

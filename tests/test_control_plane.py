@@ -16,7 +16,6 @@ the kubectl CLI, state in a JSON file).
 import json
 import os
 import stat
-import subprocess
 import sys
 
 import pytest

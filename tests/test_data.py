@@ -1,7 +1,6 @@
 """Deterministic data-sharding tests (runtime/data.py)."""
 
 import numpy as np
-import pytest
 
 from edl_tpu.runtime.data import ShardedDataIterator
 

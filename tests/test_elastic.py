@@ -11,7 +11,6 @@ import numpy as np
 import optax
 import pytest
 
-from edl_tpu.checkpoint import HostDRAMStore
 from edl_tpu.models import get_model
 from edl_tpu.runtime import ShardedDataIterator
 from edl_tpu.runtime.coordinator import LocalCoordinator

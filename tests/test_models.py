@@ -3,7 +3,6 @@ configs 3-4) — forward/loss correctness, learnability, and real
 tensor-parallel sharding on a dp x fsdp x tp mesh."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest

@@ -253,7 +253,6 @@ def test_placement_refuses_hosts_across_slices():
         JobView,
         search_assignable_nodes,
     )
-    from edl_tpu.cluster.cluster import Cluster as _C
 
     nodes = _slice_nodes(4)
     kube = FakeKube(nodes)

@@ -9,7 +9,6 @@ import numpy as np
 import optax
 import pytest
 
-import jax
 
 from edl_tpu.models import get_model
 from edl_tpu.parallel import MeshSpec, build_mesh, dp_mesh

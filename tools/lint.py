@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Zero-dependency lint gate (the reference gated commits on
+gofmt/govet/golint via pre-commit, ``.travis.yml:10-18`` +
+``.pre-commit-config.yaml``; this is the Python analog for an image
+with no linters installed and installs forbidden).
+
+Checks, all stdlib:
+
+- syntax (ast.parse)
+- unused imports (module-scope imports never referenced)
+- bare ``except:`` (masks KeyboardInterrupt/SystemExit)
+- debugger leftovers (``breakpoint()``, ``pdb.set_trace``)
+- mutable default arguments (list/dict/set literals)
+- f-strings with no placeholders
+- tabs in indentation, trailing whitespace, overlong lines (> MAX_LINE)
+
+Exit code 1 on any finding — ``ci.sh`` runs this before the tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+MAX_LINE = 100
+
+#: names whose import is a re-export or side-effect, not a use
+REEXPORT_FILES = {"__init__.py"}
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # the ROOT of a dotted use: jax.numpy -> jax
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    # names referenced in __all__ string literals count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    for el in ast.walk(node.value):
+                        if isinstance(el, ast.Constant) and isinstance(
+                            el.value, str
+                        ):
+                            used.add(el.value)
+    return used
+
+
+def _unused_imports(tree: ast.AST, path: Path):
+    if path.name in REEXPORT_FILES:
+        return
+    used = _used_names(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = (a.asname or a.name).split(".")[0]
+                if name not in used:
+                    yield node.lineno, f"unused import {a.name!r}"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                name = a.asname or a.name
+                if name not in used:
+                    yield node.lineno, f"unused import {name!r}"
+
+
+def _ast_findings(tree: ast.AST, path: Path):
+    yield from _unused_imports(tree, path)
+    # f-string format specs are themselves JoinedStr nodes with no
+    # FormattedValue (f"{x:02d}" nests JoinedStr(['02d'])): exclude
+    # them from the no-placeholder check or every formatted f-string
+    # false-positives.
+    spec_ids = {
+        id(node.format_spec)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield node.lineno, "bare except: (catches SystemExit too)"
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Name) and f.id == "breakpoint":
+                yield node.lineno, "breakpoint() left in"
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "set_trace"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("pdb", "ipdb")
+            ):
+                yield node.lineno, "pdb.set_trace() left in"
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield d.lineno, (
+                        f"mutable default argument in {node.name}()"
+                    )
+        if isinstance(node, ast.JoinedStr) and id(node) not in spec_ids:
+            if not any(
+                isinstance(v, ast.FormattedValue) for v in node.values
+            ):
+                yield node.lineno, "f-string without placeholders"
+
+
+def _line_findings(text: str):
+    for i, line in enumerate(text.splitlines(), 1):
+        body = line.rstrip("\n")
+        if body != body.rstrip():
+            yield i, "trailing whitespace"
+        indent = body[: len(body) - len(body.lstrip())]
+        if "\t" in indent:
+            yield i, "tab in indentation"
+        if len(body) > MAX_LINE:
+            yield i, f"line too long ({len(body)} > {MAX_LINE})"
+
+
+def lint_file(path: Path):
+    text = path.read_text()
+    try:
+        tree = ast.parse(text, filename=str(path))
+    except SyntaxError as e:
+        yield e.lineno or 0, f"syntax error: {e.msg}"
+        return
+    # standard suppression: a `# noqa` comment on the flagged line
+    lines = text.splitlines()
+    noqa = {
+        i for i, line in enumerate(lines, 1) if "# noqa" in line
+    }
+    for lineno, msg in _ast_findings(tree, path):
+        if lineno not in noqa:
+            yield lineno, msg
+    for lineno, msg in _line_findings(text):
+        if lineno not in noqa:
+            yield lineno, msg
+
+
+def main(argv) -> int:
+    roots = [Path(p) for p in argv] or [
+        Path("edl_tpu"),
+        Path("tests"),
+        Path("tools"),
+        Path("bench.py"),
+        Path("__graft_entry__.py"),
+    ]
+    files = []
+    for root in roots:
+        if root.is_dir():
+            files += sorted(root.rglob("*.py"))
+        elif root.suffix == ".py":
+            files.append(root)
+    findings = 0
+    for f in files:
+        for lineno, msg in lint_file(f):
+            print(f"{f}:{lineno}: {msg}")
+            findings += 1
+    if findings:
+        print(f"lint: {findings} finding(s) in {len(files)} files")
+        return 1
+    print(f"lint: clean ({len(files)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -29,9 +29,11 @@ have:
 Deliberate reference-quirk fixes (SURVEY.md §2.1 "fix, don't
 replicate"): node idle resources are *subtracted* on simulated
 scale-up (the reference added them back, ``:213-216``), and scale-down
-returns capacity to cluster totals only (per-node placement of the
-shed replica is unknowable without pod inspection — same limitation as
-ref ``:230-249``, now documented).
+returns capacity to the shed pods' *nodes* (``JobView.pod_nodes``,
+victim-first from real pod inspection) so the same fixed-point pass
+can re-place a freed slice — the reference returned it to cluster
+totals only (ref ``:230-249``), which with slice-quantized jumps
+would strand a whole freed v5e-16.
 """
 
 from __future__ import annotations
@@ -116,11 +118,29 @@ class JobView:
     #: pods land on `hosts` DISTINCT nodes of the slice's pool, each
     #: consuming per-pod cpu/mem and chips-per-host)
     hosts: int = 1
+    #: node names of the job's CURRENT pods, victim-first (newest pod
+    #: first — the coordinator drops newest-joined members on
+    #: scale-down, and the multi-host path deletes highest-indexed
+    #: replica Jobs).  Lets a dry-run shed return the replica's
+    #: capacity to the node maps it actually occupies, so the same
+    #: fixed-point pass can re-place the freed slice (the reference —
+    #: and our r3 — returned it to cluster totals only, ref
+    #: ``pkg/autoscaler.go:230-249``).
+    pod_nodes: List[str] = field(default_factory=list)
+    #: per-pod nodes THIS dry run placed on simulated scale-ups (so a
+    #: later shed of a not-yet-real replica frees the simulated nodes,
+    #: not a live pod's)
+    _sim_placed: List[str] = field(default_factory=list)
 
     @staticmethod
-    def from_job(job: TrainingJob, parallelism: Optional[int] = None) -> "JobView":
+    def from_job(
+        job: TrainingJob,
+        parallelism: Optional[int] = None,
+        pod_nodes: Optional[List[str]] = None,
+    ) -> "JobView":
         t = job.spec.trainer
         return JobView(
+            pod_nodes=list(pod_nodes or []),
             name=job.name,
             min_instance=t.min_instance,
             max_instance=t.max_instance,
@@ -318,6 +338,35 @@ def _apply(r: ClusterResource, j: JobView, delta_replicas: int, nodes: Sequence[
             )
 
 
+def _free_replicas(r: ClusterResource, j: JobView, n_replicas: int):
+    """Return ``n_replicas`` shed replicas' per-pod capacity to the
+    node maps.  Prefers nodes this dry run itself placed (a simulated
+    grow later shed), then the job's real pod placements, victim-first.
+    Pods whose placement is unknown (no ``pod_nodes`` info — e.g. a
+    hand-built ``JobView``) or whose node has left the inventory free
+    cluster totals only, the reference's behavior (crediting a vanished
+    node would fabricate schedulable capacity)."""
+    for _ in range(n_replicas * max(1, j.hosts)):
+        if j._sim_placed:
+            name = j._sim_placed.pop()
+        elif j.pod_nodes:
+            name = j.pod_nodes.pop(0)
+        else:
+            return
+        if name not in r.nodes.cpu_idle_milli:
+            continue  # node gone from inventory: totals-only freeing
+        r.nodes.cpu_idle_milli[name] = (
+            r.nodes.cpu_idle_milli.get(name, 0) + j.cpu_request_milli
+        )
+        r.nodes.memory_free_mega[name] = (
+            r.nodes.memory_free_mega.get(name, 0) + j.mem_request_mega
+        )
+        if j.tpu_per_trainer > 0:
+            r.nodes.tpu_free[name] = (
+                r.nodes.tpu_free.get(name, 0) + j.tpu_per_pod
+            )
+
+
 def scale_dry_run(
     r: ClusterResource,
     j: JobView,
@@ -345,6 +394,7 @@ def scale_dry_run(
             # legal size (ref ``:231-234`` stepped -1; we jump).
             target = j.clamp_size(min(planned, j.max_instance))
             delta = target - planned
+            _free_replicas(r, j, -delta)
             _apply(r, j, delta, ())
             return delta
         cpu_hot = r.cpu_request_milli > r.cpu_total_milli * max_load_desired
@@ -356,6 +406,7 @@ def scale_dry_run(
                 target = j.next_size_down(planned)
                 if target is not None and target >= j.min_instance:
                     delta = target - planned
+                    _free_replicas(r, j, -delta)
                     _apply(r, j, delta, ())
                     return delta
         return 0
@@ -367,6 +418,7 @@ def scale_dry_run(
         # max_instance could pin an over-max job on an illegal size
         # when max itself isn't in legal_sizes).
         delta = min(0, j.clamp_size(j.max_instance) - planned)
+        _free_replicas(r, j, -delta)
         _apply(r, j, delta, ())
         return delta
     if _competes_on(j, starved):
@@ -427,7 +479,10 @@ def scale_dry_run(
                 )
             placed.append(node)
 
-    # Cluster-level totals (node maps already adjusted above).
+    # Cluster-level totals (node maps already adjusted above); remember
+    # the placements so a later shed of this simulated replica frees
+    # these nodes rather than a live pod's.
+    j._sim_placed.extend(placed)
     _apply(r, j, step, ())
     return step
 

@@ -99,19 +99,26 @@ class Autoscaler:
                 self.jobs.pop(evt.job.name, None)
 
     # -- one decision cycle ---------------------------------------------------
-    def run_once(self, workloads=None, pods_by_job=None) -> Optional[ScalePlan]:
+    def run_once(
+        self, workloads=None, pods_by_job=None, pod_nodes=None
+    ) -> Optional[ScalePlan]:
         """Inventory -> pending detection -> fixed-point dry run ->
         actuation.  Returns the plan (None when there was nothing to
-        decide over).  ``workloads`` / ``pods_by_job``: optional
-        snapshots (``Cluster.trainer_workloads_map`` / ``job_pods_map``)
-        shared across the controller tick; computed here (ONE list call
-        each) when absent."""
+        decide over).  ``workloads`` / ``pods_by_job`` / ``pod_nodes``:
+        optional snapshots (``Cluster.trainer_workloads_map`` /
+        ``job_pods_map`` / ``job_pod_nodes_map``) shared across the
+        controller tick; computed here — both pod maps from ONE pod
+        list — when absent."""
         self._drain_events()
         if not self.jobs:
             return None
         r = self.cluster.inquiry_resource()
-        if pods_by_job is None:
-            pods_by_job = self.cluster.job_pods_map()  # ONE pod list
+        if pods_by_job is None or pod_nodes is None:
+            pods = self.cluster.kube.list_pods()  # ONE pod list
+            if pods_by_job is None:
+                pods_by_job = self.cluster.job_pods_map(pods)
+            if pod_nodes is None:
+                pod_nodes = self.cluster.job_pod_nodes_map(pods)
         if workloads is None:
             workloads = self.cluster.trainer_workloads_map()  # ONE list
 
@@ -138,7 +145,17 @@ class Autoscaler:
                     t.min_instance * hosts * t.resources.mem_request_mega()
                 )
                 continue  # a fully-pending job is demand, not a candidate
-            views.append((JobView.from_job(job, parallelism=w.parallelism), total, running))
+            views.append(
+                (
+                    JobView.from_job(
+                        job,
+                        parallelism=w.parallelism,
+                        pod_nodes=pod_nodes.get(job.name),
+                    ),
+                    total,
+                    running,
+                )
+            )
 
         # Reschedulable set: stable jobs always; every job when pending
         # exists (ref findTrainingJobsMightBeRescheduled, :487-511).

@@ -208,12 +208,13 @@ class Cluster:
         label-selected, honoring DeletionTimestamp)."""
         return self.job_pods_map().get(job.name, (0, 0, 0, 0))
 
-    def job_pods_map(self) -> Dict[str, Tuple[int, int, int, int]]:
+    def job_pods_map(self, pods=None) -> Dict[str, Tuple[int, int, int, int]]:
         """(total, running, pending, succeeded) for every job in ONE
         pod list — the autoscaler loop uses this so a tick costs one
-        list call, not one per job."""
+        list call, not one per job.  ``pods``: optional shared
+        snapshot."""
         out: Dict[str, List[int]] = {}
-        for p in self.kube.list_pods():
+        for p in pods if pods is not None else self.kube.list_pods():
             if not p.job_name or p.deleting:
                 continue
             c = out.setdefault(p.job_name, [0, 0, 0, 0])
@@ -225,6 +226,26 @@ class Cluster:
             elif p.phase == "Succeeded":
                 c[3] += 1
         return {k: tuple(v) for k, v in out.items()}
+
+    def job_pod_nodes_map(self, pods=None) -> Dict[str, List[str]]:
+        """job name -> its scheduled, non-terminal, non-deleting pods'
+        node names, newest pod first (descending ``creationTimestamp``,
+        name as tiebreak — matching the coordinator's drop-newest
+        victim order).  ``pods``: optional shared pod snapshot so a
+        control tick costs ONE pod list for all its maps.  The
+        autoscaler threads the result into ``JobView.pod_nodes`` so a
+        dry-run shed returns capacity to the right node maps."""
+        out: Dict[str, List[Tuple[str, str, str]]] = {}
+        for p in pods if pods is not None else self.kube.list_pods():
+            if not p.job_name or p.deleting or not p.node:
+                continue
+            if p.phase in ("Succeeded", "Failed"):
+                continue
+            out.setdefault(p.job_name, []).append((p.created, p.name, p.node))
+        return {
+            job: [node for _, _, node in sorted(triples, reverse=True)]
+            for job, triples in out.items()
+        }
 
     # -- CRUD (ref :245-291) -------------------------------------------------
     def create_trainer_workload(self, job: TrainingJob) -> Optional[WorkloadInfo]:

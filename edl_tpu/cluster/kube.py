@@ -54,6 +54,9 @@ class PodInfo:
     #: owning workload name — distinct from job_name when a job renders
     #: several per-replica slice Jobs sharing one edl-job label
     workload: str = ""
+    #: creationTimestamp (RFC3339, sorts lexicographically) — victim
+    #: ordering for scale-down: newest pod first.  "" = unknown.
+    created: str = ""
 
 
 @dataclass
@@ -349,6 +352,7 @@ class FakeKube(KubeAPI):
             p = PodInfo(
                 # zero-padded so lexicographic name order == creation order
                 name=f"{w.job_name}-pod-{self._pod_seq:06d}",
+                created=f"{self._pod_seq:06d}",  # monotonic, sortable
                 job_name=w.job_name,
                 cpu_request_milli=w.cpu_request_milli,
                 memory_request_mega=w.memory_request_mega,
@@ -468,6 +472,7 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
                     memory_request_mega=mem,
                     tpu_limit=tpu,
                     deleting="deletionTimestamp" in it["metadata"],
+                    created=it["metadata"].get("creationTimestamp", ""),
                 )
             )
         return pods

@@ -302,7 +302,9 @@ class Controller:
         # One pod-list + one workload-list snapshot serve every pass
         # this tick: the tick costs O(1) kubectl subprocesses however
         # many jobs the controller manages.
-        pods_by_job = self.cluster.job_pods_map()
+        pods = self.cluster.kube.list_pods()
+        pods_by_job = self.cluster.job_pods_map(pods)
+        pod_nodes = self.cluster.job_pod_nodes_map(pods)
         workloads = self.cluster.trainer_workloads_map()
         self.reconcile_status(pods_by_job, workloads)
         for name in list(self._pending_refresh):
@@ -310,7 +312,7 @@ class Controller:
             if job is None or self.lifecycle.refresh(job):
                 self._pending_refresh.discard(name)
         plan = self.autoscaler.run_once(
-            workloads=workloads, pods_by_job=pods_by_job
+            workloads=workloads, pods_by_job=pods_by_job, pod_nodes=pod_nodes
         )
         if plan is not None and plan.targets:
             # The actuation just changed parallelism: re-list (still

@@ -36,9 +36,17 @@ class TrainingJobWatcher:
 
     @staticmethod
     def _meta_fingerprint(manifest: dict) -> str:
+        """Mutable metadata an informer would surface as an update:
+        labels AND annotations (a real informer fires on any metadata
+        change; resourceVersion-free polling approximates that with the
+        two fields users actually edit)."""
         meta = manifest.get("metadata", {}) or {}
         return json.dumps(
-            {"labels": meta.get("labels", {})}, sort_keys=True
+            {
+                "labels": meta.get("labels", {}),
+                "annotations": meta.get("annotations", {}),
+            },
+            sort_keys=True,
         )
 
     def poll_once(self) -> int:

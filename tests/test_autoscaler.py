@@ -464,3 +464,77 @@ def test_over_max_clamp_lands_on_legal_size():
     # scale-up pass clamps over-max plans
     delta = scale_dry_run(r, j, 0, scale_down=False)
     assert j.parallelism + delta == 4  # not 6
+
+
+# ---- shed capacity returns to victim nodes (VERDICT r3 weak-7) --------------
+
+
+def test_shed_returns_capacity_to_victim_nodes():
+    """A shed replica's capacity comes back on its NODE's maps
+    (victim-first pod placement), not just cluster totals."""
+    r = roomy_cluster(n_nodes=2, cpu=4000, tpu=4)
+    r.cpu_request_milli = 8000  # hot: > 0.97 * 8000
+    r.tpu_limit = 8
+    r.nodes.tpu_free = {"node-0": 0, "node-1": 0}
+    r.nodes.cpu_idle_milli = {"node-0": 0, "node-1": 0}
+    a = make_view("a", parallelism=2, mn=1, mx=2, cpu=4000, tpu=4)
+    a.pod_nodes = ["node-1", "node-0"]  # newest pod (the victim) on node-1
+    assert scale_dry_run(r, a, 0, scale_down=True) == -1
+    assert r.nodes.tpu_free == {"node-0": 0, "node-1": 4}
+    assert r.nodes.cpu_idle_milli == {"node-0": 0, "node-1": 4000}
+
+
+def test_freed_victim_node_is_replaceable_same_pass():
+    """The fixed point re-places capacity a shed freed: a CPU-hot job
+    sheds its newest pod off the TPU node, and the TPU job grows onto
+    that node within the SAME dry-run pass (before this fix the node
+    maps never got the capacity back and the growth was refused)."""
+    r = ClusterResource(
+        node_count=2,
+        tpu_total=8,
+        tpu_limit=4,
+        cpu_total_milli=2400,
+        cpu_request_milli=2400,  # hot: > 0.97 * 2400
+        memory_total_mega=65536,
+        nodes=Nodes(
+            cpu_idle_milli={"node-0": 50, "node-1": -50},
+            memory_free_mega={"node-0": 32768, "node-1": 32768},
+            tpu_free={"node-0": 0, "node-1": 4},
+        ),
+    )
+    a = make_view("a", parallelism=2, mn=1, mx=2, cpu=1150, mem=0, tpu=0)
+    a.pod_nodes = ["node-1", "node-0"]
+    c = make_view("c", parallelism=1, mn=1, mx=2, cpu=100, mem=0, tpu=4)
+    c.pod_nodes = ["node-1"]
+    assert scale_all_jobs_dry_run([a, c], r) == {"a": -1, "c": 1}
+
+
+def test_sim_placed_shed_frees_simulated_nodes_not_live_pods():
+    """A shed of a replica this dry run itself placed must free the
+    simulated placement, leaving real pods' nodes untouched."""
+    r = roomy_cluster(n_nodes=2, cpu=8000, tpu=4)
+    j = make_view("j", parallelism=1, mn=1, mx=2, cpu=1000, mem=0, tpu=4)
+    j.pod_nodes = ["node-0"]
+    up = scale_dry_run(r, j, 0)  # grows 1 -> 2, placing on a node
+    assert up == 1 and len(j._sim_placed) == 1
+    placed = j._sim_placed[0]
+    free_before = r.nodes.tpu_free[placed]
+    # over-max clamp sheds the simulated replica (spec shrank scenario)
+    j.max_instance = 1
+    j.legal_sizes = []
+    down = scale_dry_run(r, j, up, scale_down=True)
+    assert down == -1
+    assert r.nodes.tpu_free[placed] == free_before + 4
+    assert j.pod_nodes == ["node-0"]  # the live pod was not "freed"
+
+
+def test_shed_skips_nodes_gone_from_inventory():
+    """A victim pod whose node left the inventory frees totals only —
+    crediting the vanished node would fabricate schedulable capacity."""
+    r = roomy_cluster(n_nodes=1, cpu=4000, tpu=4)
+    r.cpu_request_milli = 8000  # hot
+    a = make_view("a", parallelism=2, mn=1, mx=2, cpu=4000, tpu=4)
+    a.pod_nodes = ["node-gone", "node-0"]
+    assert scale_dry_run(r, a, 0, scale_down=True) == -1
+    assert "node-gone" not in r.nodes.cpu_idle_milli
+    assert "node-gone" not in r.nodes.tpu_free

@@ -280,3 +280,19 @@ def test_scale_down_victims_follow_coordinator_plan():
     # the freed chips let job b schedule
     total, running, pending, _ = cluster.job_pods(jb)
     assert (total, running, pending) == (2, 2, 0)
+
+
+def test_job_pod_nodes_map_newest_first():
+    """job_pod_nodes_map: scheduled pods' nodes, newest pod first (the
+    autoscaler's victim-order proxy for JobView.pod_nodes)."""
+    kube = FakeKube(tpu_nodes(3, chips=4))
+    cluster = Cluster(kube)
+    job = make_job(mx=3)
+    cluster.create_trainer_workload(job)
+    cluster.update_parallelism(job, 3)
+    nodes_by_job = cluster.job_pod_nodes_map()
+    assert len(nodes_by_job[job.name]) == 3
+    # newest pod (highest creation seq) leads the victim list
+    pods = sorted(kube.list_pods(), key=lambda p: p.name)
+    assert nodes_by_job[job.name][0] == pods[-1].node
+    assert nodes_by_job[job.name][-1] == pods[0].node

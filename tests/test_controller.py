@@ -367,3 +367,38 @@ def test_dead_coordinator_logged_once_per_outage(capsys):
     assert "deadco" in err and "handshake" in err
     ctrl.run_once()  # same outage: no duplicate log
     assert "deadco" not in capsys.readouterr().err
+
+
+def test_watcher_fires_on_update_for_annotation_change():
+    """Informer fidelity (VERDICT r3 weak-8): an annotation-only edit
+    must fire on_update, like labels and spec changes do."""
+    from edl_tpu.controller.watch import TrainingJobWatcher
+
+    manifest = make_job("ann").to_manifest()
+    manifests = [manifest]
+
+    class Recorder:
+        def __init__(self):
+            self.events = []
+            self.jobs = {}
+
+        def on_add(self, job):
+            self.events.append(("add", job.name))
+            self.jobs[job.name] = job
+
+        def on_update(self, job):
+            self.events.append(("update", job.name))
+
+        def on_delete(self, job):
+            self.events.append(("delete", job.name))
+
+        def gc_orphans(self, names):
+            pass
+
+    rec = Recorder()
+    watcher = TrainingJobWatcher(lambda: manifests, rec)
+    assert watcher.poll_once() == 1  # add
+    assert watcher.poll_once() == 0  # steady state: no spurious updates
+    manifest["metadata"]["annotations"] = {"edl.tpu.dev/note": "v2"}
+    assert watcher.poll_once() == 1
+    assert rec.events[-1] == ("update", "ann")

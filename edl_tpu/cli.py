@@ -132,7 +132,7 @@ def cmd_local_run(args) -> int:
 
     from edl_tpu.models.base import get_model
     from edl_tpu.runtime.coordinator import LocalCoordinator
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.data import ShardedDataIterator
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     job = _load_job(args.spec)
@@ -141,11 +141,14 @@ def cmd_local_run(args) -> int:
     t = job.spec.trainer
     start_world = min(t.min_instance, n_dev)
     gbs = job.spec.global_batch_size or max(64, 8 * n_dev)
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, max(4096, gbs)),
-        global_batch_size=gbs,
-        seed=args.seed,
+    from edl_tpu.runtime.datasets import resolve_dataset
+
+    dataset = resolve_dataset(
+        model,
+        getattr(args, "data_dir", "") or job.spec.dataset_dir,
+        max(4096, gbs),
     )
+    data = ShardedDataIterator(dataset, global_batch_size=gbs, seed=args.seed)
     coord = LocalCoordinator(
         target_world=start_world,
         max_world=min(t.max_instance, n_dev),
@@ -331,6 +334,15 @@ def build_parser() -> argparse.ArgumentParser:
         action="append",
         metavar="STEP:WORLD",
         help="trigger a resize at a step (repeatable)",
+    )
+    s.add_argument(
+        "--data-dir",
+        default="",
+        help=(
+            "train from a file-backed array store (memory-mapped .npy "
+            "directory, see edl_tpu.runtime.datasets) instead of "
+            "synthetic data; overrides spec.dataset_dir"
+        ),
     )
     s.set_defaults(fn=cmd_local_run)
 

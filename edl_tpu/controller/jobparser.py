@@ -80,6 +80,7 @@ def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
         {"name": "EDL_GLOBAL_BATCH_SIZE", "value": str(job.spec.global_batch_size)},
         {"name": "EDL_CHECKPOINT_INTERVAL", "value": str(job.spec.checkpoint_interval_steps)},
         {"name": "EDL_FAULT_TOLERANT", "value": "1" if job.spec.fault_tolerant else "0"},
+        {"name": "EDL_DATA_DIR", "value": job.spec.dataset_dir},
         # downward API (ref ``:302-312``)
         {
             "name": "EDL_NAMESPACE",

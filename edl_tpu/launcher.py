@@ -39,6 +39,7 @@ def env_config() -> dict:
         "global_batch_size": int(e.get("EDL_GLOBAL_BATCH_SIZE", "0")),
         "checkpoint_interval": int(e.get("EDL_CHECKPOINT_INTERVAL", "100")),
         "fault_tolerant": e.get("EDL_FAULT_TOLERANT", "0") == "1",
+        "data_dir": e.get("EDL_DATA_DIR", ""),
         "pod_name": e.get("EDL_POD_NAME", ""),
         # This pod's reachable host:port — seeds the per-generation JAX
         # process group.  Explicit EDL_POD_ADDRESS wins; otherwise built
@@ -341,6 +342,7 @@ def run(
     dataset_examples: int = 4096,
     pod_address: str = "",
     history_file: str = "",
+    data_dir: str = "",
 ) -> "ElasticTrainer":
     """Build and run the elastic training loop for a registered model.
 
@@ -351,7 +353,7 @@ def run(
     from edl_tpu.models.base import get_model
     from edl_tpu.runtime.coord_service import HTTPCoordinator
     from edl_tpu.runtime.coordinator import LocalCoordinator
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.data import ShardedDataIterator
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     cfg = env_config()
@@ -426,11 +428,12 @@ def run(
         for tid in heartbeat_ids:
             coordinator.register(tid)
 
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, max(dataset_examples, gbs)),
-        global_batch_size=gbs,
-        seed=seed,
+    from edl_tpu.runtime.datasets import resolve_dataset
+
+    dataset = resolve_dataset(
+        model, data_dir or cfg["data_dir"], max(dataset_examples, gbs)
     )
+    data = ShardedDataIterator(dataset, global_batch_size=gbs, seed=seed)
 
     et = ElasticTrainer(
         model,

@@ -192,11 +192,18 @@ class TrainingJobSpec:
     #: batch = global_batch_size / world_size at every generation.
     global_batch_size: int = 0
     checkpoint_interval_steps: int = 100
+    #: directory of a file-backed array store (see
+    #: ``edl_tpu.runtime.datasets``) mounted into trainer pods; ""
+    #: trains on the model's synthetic data (the reference carried the
+    #: analogous pointer opaquely in Workspace/TRAINER_PACKAGE,
+    #: ref ``pkg/jobparser.go:288-291``)
+    dataset_dir: str = ""
 
     @staticmethod
     def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainingJobSpec":
         d = d or {}
         return TrainingJobSpec(
+            dataset_dir=str(d.get("dataset_dir", d.get("datasetDir", "")) or ""),
             image=d.get("image", ""),
             port=int(d.get("port", 0)),
             fault_tolerant=bool(d.get("fault_tolerant", d.get("faultTolerant", False))),

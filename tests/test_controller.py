@@ -67,6 +67,7 @@ def test_pod_env_contract():
     assert env["EDL_JOB_NAME"] == "demo"
     assert env["EDL_COORDINATOR_ADDR"] == "demo-coordinator:7164"
     assert env["EDL_ENTRYPOINT"] == "mnist"
+    assert env["EDL_DATA_DIR"] == ""  # spec.dataset_dir passthrough
     assert env["EDL_MIN_INSTANCE"] == "1"
     assert env["EDL_MAX_INSTANCE"] == "4"
     assert env["EDL_FAULT_TOLERANT"] == "1"

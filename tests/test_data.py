@@ -38,3 +38,88 @@ def test_resize_consistency_across_world_sizes():
             [it.host_batch(5, world=w, rank=r)["x"] for r in range(w)]
         )
         np.testing.assert_array_equal(got, _ds()["x"][it.global_indices(5)])
+
+
+# ---- file-backed array stores (runtime/datasets.py) -------------------------
+
+
+def test_array_store_round_trip_mmap(tmp_path):
+    from edl_tpu.runtime.datasets import load_array_store, save_array_store
+
+    arrays = {
+        "x": np.random.RandomState(0).randn(64, 3).astype(np.float32),
+        "y": np.arange(64, dtype=np.int32),
+    }
+    save_array_store(str(tmp_path / "s"), arrays)
+    loaded = load_array_store(str(tmp_path / "s"))
+    assert isinstance(loaded["x"], np.memmap)  # real bytes from disk
+    for k in arrays:
+        np.testing.assert_array_equal(np.asarray(loaded[k]), arrays[k])
+
+
+def test_array_store_rejects_non_store_and_drift(tmp_path):
+    import pytest
+
+    from edl_tpu.runtime.datasets import load_array_store, save_array_store
+
+    with pytest.raises(FileNotFoundError):
+        load_array_store(str(tmp_path / "nope"))
+    p = str(tmp_path / "s")
+    save_array_store(p, {"x": np.zeros((8, 2), np.float32)})
+    # drift: overwrite the file behind the manifest's back
+    np.save(tmp_path / "s" / "x.npy", np.zeros((9, 2), np.float32))
+    with pytest.raises(ValueError, match="drifted"):
+        load_array_store(p)
+
+
+def test_array_store_rejects_ragged_and_empty(tmp_path):
+    import pytest
+
+    from edl_tpu.runtime.datasets import save_array_store
+
+    with pytest.raises(ValueError):
+        save_array_store(str(tmp_path / "e"), {})
+    with pytest.raises(ValueError, match="leading dim"):
+        save_array_store(
+            str(tmp_path / "r"),
+            {"a": np.zeros(4), "b": np.zeros(5)},
+        )
+
+
+def test_mmap_iterator_matches_in_memory_batches(tmp_path):
+    """The determinism core is byte-source invariant: a memmapped store
+    yields the identical (seed, step, world, rank) batches the
+    in-memory arrays do — so a resize replays the same stream whether
+    data lives in RAM or on disk."""
+    from edl_tpu.runtime.datasets import load_array_store, save_array_store
+
+    arrays = {"x": np.random.RandomState(1).randn(256, 4).astype(np.float32)}
+    save_array_store(str(tmp_path / "s"), arrays)
+    mem = ShardedDataIterator(arrays, global_batch_size=32, seed=9)
+    disk = ShardedDataIterator(
+        load_array_store(str(tmp_path / "s")), global_batch_size=32, seed=9
+    )
+    for step in (0, 3, 17):
+        for world, rank in ((1, 0), (2, 1), (4, 3)):
+            np.testing.assert_array_equal(
+                mem.host_batch(step, world, rank)["x"],
+                disk.host_batch(step, world, rank)["x"],
+            )
+
+
+def test_validate_for_model_fails_fast_on_feature_mismatch(tmp_path):
+    import pytest
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.datasets import (
+        load_array_store,
+        stage_synthetic,
+        validate_for_model,
+    )
+
+    fit = get_model("fit_a_line")
+    stage_synthetic(str(tmp_path / "s"), fit.synth_batch, 64, seed=0)
+    store = load_array_store(str(tmp_path / "s"))
+    validate_for_model(store, fit)  # matching model: fine
+    with pytest.raises(ValueError, match="lacks features"):
+        validate_for_model(store, get_model("mnist"))

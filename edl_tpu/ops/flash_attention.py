@@ -46,17 +46,25 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
-#: Trailing-lane width for per-row stats (TPU vector lane count): a
-#: [T] stat is stored [T, 128] broadcast so block shapes satisfy the
-#: (8, 128) tiling constraint (same layout as jax's own TPU kernels).
-LANES = 128
+#: Trailing width for the per-row logsumexp residual between forward
+#: and backward.  Stats live lane-broadcast *inside* kernels (the
+#: standard TPU trick for per-row scalars), but storing all 128 lanes
+#: to HBM pays 128x the bytes the stat needs (ADVICE r3); 8 trailing
+#: values keep every tile a legal (sublane, lane) shape while cutting
+#: the residual 16x (at T=8k training shapes: 16MB instead of 268MB).
+STAT_LANES = 8
 
-#: Default tile sizes (overridable per call).  Swept in-model on v5e at
-#: T=2048 (bq/bk in {128,256,512,1024}): 128x128 wins — larger tiles
-#: lengthen the serial dependency chains between the online-softmax
-#: carries without reducing the exp count.
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+#: Default tile sizes (overridable per call).  Re-swept in-model on
+#: v5e at T=2048 with the merged single-pass backward (bq/bk in
+#: {128, 256, 512, 1024}): full-step time is 222ms at 128x128, 131ms
+#: at 256x256, **114ms at 512x512**, and 1024x1024 overflows the 16MB
+#: VMEM scoped allocation in the backward.  (The r3 sweep that picked
+#: 128x128 predates the merged backward.)  Larger tiles win because
+#: each (i, j) tile pair pays fixed VPU work — mask iota, online-
+#: softmax carries — per tile, and 1/16th the tiles means 1/16th that
+#: overhead while the MXU dots stay the same total size.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 
 
 def _pick_block(t: int, want: int) -> int:
@@ -107,62 +115,75 @@ def _fwd_kernel(
         jnp.int32, (block_q, 1), 0
     )  # [bq, 1]
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = scale * jax.lax.dot_general(
-            qb,
-            kb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if has_mask:
-            valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0  # [1, bk]
-            s = jnp.where(valid, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [bq,1]
-        m_use = _safe(m_new)
-        p = jnp.exp(s - m_use)
-        alpha = jnp.exp(_safe(m) - m_use)  # [bq,1]
-        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(vb.dtype),
-            vb,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc = acc * alpha + pv
-        return acc, m_new, l
+    def make_body(diag):
+        """``diag=False``: tile pairs strictly below the causal
+        diagonal — no position mask needed, so the iota/where VPU work
+        is skipped entirely (it is per-tile overhead that tiling can't
+        amortize).  ``diag=True``: diagonal tiles, position-masked."""
 
-    if causal:
-        # K blocks whose start exceeds this Q block's last position are
-        # fully masked: skip them (the flash speedup for causal).
-        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
-    else:
-        upper = num_k
+        def body(j, carry):
+            acc, m, l = carry
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = scale * jax.lax.dot_general(
+                qb,
+                kb,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            if causal and diag:
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if has_mask:
+                valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0
+                s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            m_use = _safe(m_new)
+            p = jnp.exp(s - m_use)
+            alpha = jnp.exp(_safe(m) - m_use)  # [bq,1]
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(vb.dtype),
+                vb,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * alpha + pv
+            return acc, m_new, l
+
+        return body
 
     d = q_ref.shape[-1]
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, upper, body, (acc0, m0, l0))
+    if causal:
+        # K blocks entirely at-or-before this Q block's first position
+        # are never masked; blocks past its last position are fully
+        # masked and skipped (the flash speedup for causal); the strip
+        # between runs the masked body.
+        full = (i * block_q + 1) // block_k
+        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
+        carry = jax.lax.fori_loop(0, full, make_body(False), (acc0, m0, l0))
+        acc, m, l = jax.lax.fori_loop(full, upper, make_body(True), carry)
+    else:
+        acc, m, l = jax.lax.fori_loop(
+            0, num_k, make_body(False), (acc0, m0, l0)
+        )
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse = _safe(m) + jnp.log(l_safe)  # [bq, 1]
     lse_ref[0] = jax.lax.broadcast_in_dim(
-        lse.reshape(block_q), (block_q, LANES), (0,)
+        lse.reshape(block_q), (block_q, STAT_LANES), (0,)
     )
 
 
 def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     """q: [BH, Tq, D]; k, v: [BH, Tk, D]; mask: [B, Tk] int32 or None.
 
-    Returns (o [BH, Tq, D], lse [BH, Tq, LANES] f32, lane-broadcast)."""
+    Returns (o [BH, Tq, D], lse [BH, Tq, STAT_LANES] f32, broadcast)."""
     bh, tq, d = q.shape
     tk = k.shape[1]
     has_mask = mask is not None
@@ -193,11 +214,11 @@ def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, tq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(*args)
@@ -209,7 +230,7 @@ def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
 
 
 def _row_stat(ref2d):
-    """Collapse a lane-broadcast [rows, LANES] stat tile to [rows, 1]
+    """Collapse a broadcast [rows, STAT_LANES] stat tile to [rows, 1]
     (all lanes hold the same value; a lane reduction is the portable
     way to read one back)."""
     return jnp.max(ref2d, axis=-1, keepdims=True)
@@ -244,53 +265,60 @@ def _bwd_kernel(
 
     q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
-    def body(j, dq_acc):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = scale * jax.lax.dot_general(
-            qb, kb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        if causal:
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (1, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        if has_mask:
-            valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0
-            s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]; masked -> exp(NEG_INF - lse) == 0
-        dp = jax.lax.dot_general(
-            dob, vb,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bq, bk]
-        ds = (p * (dp - delta)).astype(kb.dtype)
-        dv_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
-            p.astype(dob.dtype), dob,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, D]
-        dk_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
-            ds, qb,
-            dimension_numbers=(((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [bk, D]
-        return dq_acc + jax.lax.dot_general(
-            ds, kb,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    def make_body(diag):
+        """Same sub-diagonal/diagonal split as the forward: tiles
+        strictly below the causal diagonal skip the mask iota/where."""
 
-    if causal:
-        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
-    else:
-        upper = num_k
+        def body(j, dq_acc):
+            kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+            vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+            s = scale * jax.lax.dot_general(
+                qb, kb,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            if causal and diag:
+                k_pos = j * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (1, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if has_mask:
+                valid = mask_ref[0, :, pl.ds(j * block_k, block_k)] != 0
+                s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse)  # [bq, bk]; masked -> exp(NEG_INF-lse) == 0
+            dp = jax.lax.dot_general(
+                dob, vb,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bq, bk]
+            ds = (p * (dp - delta)).astype(kb.dtype)
+            dv_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
+                p.astype(dob.dtype), dob,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bk, D]
+            dk_scr[pl.ds(j * block_k, block_k), :] += jax.lax.dot_general(
+                ds, qb,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [bk, D]
+            return dq_acc + jax.lax.dot_general(
+                ds, kb,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        return body
+
     d = q_ref.shape[-1]
-    acc = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((block_q, d), jnp.float32)
-    )
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        full = (i * block_q + 1) // block_k
+        upper = jnp.minimum(num_k, pl.cdiv((i + 1) * block_q, block_k))
+        acc = jax.lax.fori_loop(0, full, make_body(False), dq0)
+        acc = jax.lax.fori_loop(full, upper, make_body(True), acc)
+    else:
+        acc = jax.lax.fori_loop(0, num_k, make_body(False), dq0)
     dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
 
     @pl.when(i == num_i - 1)
@@ -319,7 +347,7 @@ def _flash_bwd_3d(
         pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0)),           # v
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # o
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # do
-        pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),  # lse
+        pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i: (b, i, 0)),  # lse
     ]
     args = [q, k, v, o, do, lse]
     if has_mask:
@@ -355,8 +383,11 @@ def _flash_bwd_3d(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(
+    q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+    bwd_block_k, interpret,
+):
     out, _ = _run(q, k, v, mask, causal, scale, block_q, block_k, interpret)
     return out
 
@@ -380,19 +411,25 @@ def _run(q, k, v, mask, causal, scale, block_q, block_k, interpret):
     return _from3(out3, b, h), (out3, lse)
 
 
-def _flash_fwd_rule(q, k, v, mask, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_rule(
+    q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+    bwd_block_k, interpret,
+):
     out, (out3, lse) = _run(
         q, k, v, mask, causal, scale, block_q, block_k, interpret
     )
     return out, (q, k, v, out3, lse, mask)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd_rule(
+    causal, scale, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+    res, g,
+):
     q, k, v, out3, lse, mask = res
     b, t, h, d = q.shape
     dq3, dk3, dv3 = _flash_bwd_3d(
         _to3(q), _to3(k), _to3(v), out3, lse, _to3(g.astype(q.dtype)),
-        mask, causal, scale, block_q, block_k, interpret,
+        mask, causal, scale, bwd_block_q, bwd_block_k, interpret,
     )
     dmask = (
         None
@@ -414,13 +451,18 @@ def flash_attention(
     kv_mask: Optional[jax.Array] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over [B, T, H, D] tensors.
 
     ``kv_mask``: optional [B, Tk] bool (True = attend) for padded
-    batches.  ``interpret=None`` auto-selects: real kernel on TPU,
-    Pallas interpreter elsewhere (tests on the CPU mesh take this
+    batches.  ``bwd_block_q``/``bwd_block_k`` tile the backward
+    independently (it carries dK/dV scratch, so its VMEM ceiling —
+    and sweet spot — differ from the forward's); they default to the
+    forward tiles.  ``interpret=None`` auto-selects: real kernel on
+    TPU, Pallas interpreter elsewhere (tests on the CPU mesh take this
     path)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -431,5 +473,10 @@ def flash_attention(
         raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
     block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
     block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
+    bwd_block_q = _pick_block(tq, bwd_block_q or block_q)
+    bwd_block_k = _pick_block(tk, bwd_block_k or block_k)
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
-    return _flash(q, k, v, mask, causal, scale, block_q, block_k, interpret)
+    return _flash(
+        q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+        bwd_block_k, interpret,
+    )

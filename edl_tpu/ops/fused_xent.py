@@ -13,8 +13,10 @@ Numerics match the jnp path: logits are bf16xbf16->f32 MXU dots, the
 online softmax stats are f32, gradients accumulate f32.
 
 Layout notes (TPU tiling): per-row scalars (lse, label logit, row max,
-row scale) travel as [N, LANES] lane-broadcast arrays; labels ride as
-[N, 1] int32.  The vocab axis is padded to a multiple of the v-tile
+row scale) travel as [N, STAT_LANES] broadcast arrays — broadcast over
+a few trailing lanes keeps every tile a legal (sublane, lane) shape
+without paying the full 128-lane residual in HBM (ADVICE r3); labels
+ride as [N, 1] int32.  The vocab axis is padded to a multiple of the v-tile
 and masked with NEG_INF inside the kernel.
 
 Used by the models' loss functions on TPU; the jnp chunked path stays
@@ -37,7 +39,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
-LANES = 128
+STAT_LANES = 8
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -95,13 +97,13 @@ def _fwd_kernel(
         m = jnp.where(m_scr[...] <= NEG_INF / 2, 0.0, m_scr[...])
         lse = m + jnp.log(jnp.maximum(l_scr[...], 1e-30))
         o_lse[...] = jax.lax.broadcast_in_dim(
-            lse.reshape(bn), (bn, LANES), (0,)
+            lse.reshape(bn), (bn, STAT_LANES), (0,)
         )
         o_label[...] = jax.lax.broadcast_in_dim(
-            lab_scr[...].reshape(bn), (bn, LANES), (0,)
+            lab_scr[...].reshape(bn), (bn, STAT_LANES), (0,)
         )
         o_max[...] = jax.lax.broadcast_in_dim(
-            m.reshape(bn), (bn, LANES), (0,)
+            m.reshape(bn), (bn, STAT_LANES), (0,)
         )
 
 
@@ -122,14 +124,14 @@ def _fwd(y, e_pad, labels, vocab, block_n, block_v):
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((block_n, LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda i, j: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, LANES), jnp.float32),
-            jax.ShapeDtypeStruct((n, LANES), jnp.float32),
-            jax.ShapeDtypeStruct((n, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((n, STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),
@@ -138,7 +140,7 @@ def _fwd(y, e_pad, labels, vocab, block_n, block_v):
         ],
         interpret=jax.default_backend() != "tpu",
     )(y, e_pad, labels)
-    return out  # lse3, label3, max3 (each [N, LANES])
+    return out  # lse3, label3, max3 (each [N, STAT_LANES])
 
 
 # ---------------------------------------------------------------------------
@@ -237,8 +239,8 @@ def _bwd(y, e_pad, labels, lse3, row_scale3, vocab, block_n, block_v):
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
             pl.BlockSpec((block_v, d), lambda i, j: (j, 0)),
             pl.BlockSpec((block_n, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda i, j: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), y.dtype),
@@ -256,8 +258,8 @@ def _bwd(y, e_pad, labels, lse3, row_scale3, vocab, block_n, block_v):
             pl.BlockSpec((block_n, d), lambda j, i: (i, 0)),
             pl.BlockSpec((block_v, d), lambda j, i: (j, 0)),
             pl.BlockSpec((block_n, 1), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda j, i: (i, 0)),
-            pl.BlockSpec((block_n, LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_n, STAT_LANES), lambda j, i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_v, d), lambda j, i: (j, 0)),
         out_shape=jax.ShapeDtypeStruct((vp, d), jnp.float32),
@@ -314,7 +316,7 @@ def _xent_bwd_rule(vocab, block_n, block_v, res, g):
         e_pad = jnp.pad(e_pad, ((0, vp - vocab), (0, 0)))
     row_scale = (g_loss * valid / denom).astype(jnp.float32)  # [N]
     row_scale3 = jax.lax.broadcast_in_dim(
-        row_scale, (row_scale.shape[0], LANES), (0,)
+        row_scale, (row_scale.shape[0], STAT_LANES), (0,)
     )
     dy, de = _bwd(
         y, e_pad, labels2, lse3, row_scale3, vocab, block_n, block_v

@@ -44,6 +44,13 @@ def save_array_store(
     if len(set(sizes.values())) != 1:
         raise ValueError(f"arrays disagree on leading dim: {sizes}")
     os.makedirs(path, exist_ok=True)
+    # Invalidate any existing store FIRST: a crash mid-restage must
+    # leave a store that fails load loudly, never an old manifest
+    # validating a mix of old and new .npy files.
+    try:
+        os.remove(os.path.join(path, MANIFEST))
+    except FileNotFoundError:
+        pass
     meta = {"n": next(iter(sizes.values())), "arrays": {}, "seed": seed}
     for key, v in arrays.items():
         if "/" in key or key.startswith("."):
@@ -88,16 +95,31 @@ def load_array_store(path: str, mmap: bool = True) -> Dict[str, np.ndarray]:
 
 
 def validate_for_model(dataset: Dict[str, np.ndarray], model) -> None:
-    """Fail fast — before any compile — when a store doesn't carry the
-    features the model's loss reads (a mismatch otherwise surfaces as a
-    bare ``KeyError`` deep inside the jit'd step)."""
-    expected = set(model.synth_batch(np.random.RandomState(0), 1))
-    missing = expected - set(dataset)
+    """Fail fast — before any compile — when a store doesn't match the
+    batches the model's loss reads (a mismatch otherwise surfaces as a
+    bare ``KeyError`` or opaque XLA shape error deep inside the jit'd
+    step).  The model's own ``synth_batch`` is the shape/dtype
+    contract: per-feature trailing dims and dtype must agree."""
+    ref = model.synth_batch(np.random.RandomState(0), 1)
+    missing = set(ref) - set(dataset)
     if missing:
         raise ValueError(
             f"array store lacks features {sorted(missing)} required by "
             f"model {model.name!r} (store has {sorted(dataset)})"
         )
+    for key, want in ref.items():
+        got = dataset[key]
+        if got.shape[1:] != want.shape[1:]:
+            raise ValueError(
+                f"array store feature {key!r} has per-example shape "
+                f"{tuple(got.shape[1:])}; model {model.name!r} expects "
+                f"{tuple(want.shape[1:])}"
+            )
+        if np.asarray(got).dtype != np.asarray(want).dtype:
+            raise ValueError(
+                f"array store feature {key!r} has dtype {got.dtype}; "
+                f"model {model.name!r} expects {np.asarray(want).dtype}"
+            )
 
 
 def stage_synthetic(

@@ -123,3 +123,53 @@ def test_validate_for_model_fails_fast_on_feature_mismatch(tmp_path):
     validate_for_model(store, fit)  # matching model: fine
     with pytest.raises(ValueError, match="lacks features"):
         validate_for_model(store, get_model("mnist"))
+
+
+def test_validate_for_model_catches_shape_and_dtype_drift(tmp_path):
+    import pytest
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.datasets import validate_for_model
+
+    fit = get_model("fit_a_line")
+    ref = fit.synth_batch(np.random.RandomState(0), 8)
+    bad_shape = {k: (v[:, :-1] if v.ndim == 2 else v) for k, v in ref.items()}
+    with pytest.raises(ValueError, match="per-example shape"):
+        validate_for_model(bad_shape, fit)
+    bad_dtype = {k: v.astype(np.float64) for k, v in ref.items()}
+    with pytest.raises(ValueError, match="dtype"):
+        validate_for_model(bad_dtype, fit)
+
+
+def test_restage_crash_leaves_loudly_broken_store(tmp_path):
+    """Re-staging removes the old manifest before writing arrays, so a
+    crash mid-restage cannot leave an old manifest validating a mix of
+    old and new bytes."""
+    import os
+
+    import pytest
+
+    from edl_tpu.runtime.datasets import (
+        MANIFEST,
+        load_array_store,
+        save_array_store,
+    )
+
+    p = str(tmp_path / "s")
+    save_array_store(p, {"x": np.zeros((8, 2), np.float32)})
+
+    real_replace = os.replace
+
+    def crash_before_manifest(src, dst):
+        if dst.endswith(MANIFEST):
+            raise RuntimeError("crash mid-restage")
+        return real_replace(src, dst)
+
+    os.replace = crash_before_manifest
+    try:
+        with pytest.raises(RuntimeError):
+            save_array_store(p, {"x": np.ones((8, 2), np.float32)})
+    finally:
+        os.replace = real_replace
+    with pytest.raises(FileNotFoundError):  # loud, not a silent mix
+        load_array_store(p)

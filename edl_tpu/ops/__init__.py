@@ -1,7 +1,10 @@
 """Hot-path ops: ring attention (sequence parallelism) and Pallas TPU
 kernels."""
 
-from edl_tpu.ops.flash_attention import flash_attention
+from edl_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_with_lse,
+)
 from edl_tpu.ops.ring_attention import reference_attention, ring_attention
 
 
@@ -34,6 +37,7 @@ def fused_attention(q, k, v, causal=False, scale=None, kv_mask=None):
 
 __all__ = [
     "ring_attention",
+    "flash_attention_with_lse",
     "reference_attention",
     "flash_attention",
     "fused_attention",

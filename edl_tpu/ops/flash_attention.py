@@ -7,12 +7,15 @@ accumulation, and causal runs skip fully-masked K blocks entirely.
 
 Layering: ``ring_attention`` (sequence parallel, ``ops/ring_attention``)
 distributes the sequence *across chips*; this kernel optimizes the
-*within-chip* block loop.  They compose: the ring's per-step local
-attention is exactly this computation.
+*within-chip* block loop.  They compose concretely: the ring's
+per-step local attention IS this kernel via
+``flash_attention_with_lse``, whose differentiable lse output feeds
+the ring's normalized-partial merge.
 
 Backward: a single blockwise kernel with saved residuals — the forward
-emits per-row logsumexp (O(T) stats in a 128-lane-broadcast layout, the
-standard TPU trick for per-row scalars), and ONE backward pass
+emits per-row logsumexp (O(T) stats, broadcast over STAT_LANES
+trailing values so tiles stay legal (sublane, lane) shapes), and ONE
+backward pass
 recomputes each probability tile once to produce dQ, dK and dV
 together (dK/dV accumulate in f32 VMEM scratch while Q tiles stream;
 the split dq/dkv formulation pays the score dot and the exp twice —
@@ -33,7 +36,7 @@ validate the identical code path on the CPU mesh.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -81,15 +84,17 @@ def _safe(m):
     return jnp.where(m <= NEG_INF / 2, 0.0, m)
 
 
-def _with_optional_mask(kernel, has_mask, n_in):
-    """Adapt a kernel written with a mask_ref slot to a pallas_call that
-    may not pass one (mask absent -> mask_ref=None)."""
-    if has_mask:
-        return kernel
+def _adapt_optional(kernel, n_base, present):
+    """Adapt a kernel written with trailing optional input slots (in
+    signature order) to a pallas_call that passes only the live ones —
+    absent slots reach the kernel as None."""
+    n_in = n_base + sum(present)
 
     def wrapped(*refs):
-        ins, outs = refs[: n_in - 1], refs[n_in - 1 :]
-        return kernel(*ins, None, *outs)
+        ins, outs = refs[:n_in], refs[n_in:]
+        rest = iter(ins[n_base:])
+        opts = [next(rest) if p else None for p in present]
+        return kernel(*ins[:n_base], *opts, *outs)
 
     return wrapped
 
@@ -209,7 +214,7 @@ def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
         )
         args.append(mask)
     return pl.pallas_call(
-        _with_optional_mask(kernel, has_mask, n_in=4),
+        _adapt_optional(kernel, 3, (has_mask,)),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -237,9 +242,9 @@ def _row_stat(ref2d):
 
 
 def _bwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, mask_ref,
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, mask_ref,
     dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale, causal, block_k, kv_len, num_i, has_mask,
+    *, scale, causal, block_k, kv_len, num_i, has_mask, has_glse,
 ):
     """Single-pass backward: dQ, dK and dV in one sweep.
 
@@ -262,6 +267,10 @@ def _bwd_kernel(
     num_k = kv_len // block_k
     lse = _row_stat(lse_ref[0])  # [bq, 1]
     delta = jnp.sum(dob_f32 * ob, axis=-1, keepdims=True)  # [bq, 1]
+    if has_glse:
+        # The lse output's cotangent enters ds exactly like -delta:
+        # d lse / d s_ij = p_ij, so ds = p * (dp - delta + glse).
+        delta = delta - _row_stat(glse_ref[0])
 
     q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
 
@@ -328,18 +337,20 @@ def _bwd_kernel(
 
 
 def _flash_bwd_3d(
-    q, k, v, o, lse, do, mask, causal, scale, block_q, block_k, interpret
+    q, k, v, o, lse, do, glse, mask, causal, scale, block_q, block_k,
+    interpret,
 ):
     bh, tq, d = q.shape
     tk = k.shape[1]
     has_mask = mask is not None
+    has_glse = glse is not None
     heads = bh // mask.shape[0] if has_mask else 1
     num_i = tq // block_q
 
     kernel = functools.partial(
         _bwd_kernel,
         scale=scale, causal=causal, block_k=block_k, kv_len=tk,
-        num_i=num_i, has_mask=has_mask,
+        num_i=num_i, has_mask=has_mask, has_glse=has_glse,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),      # q
@@ -350,13 +361,18 @@ def _flash_bwd_3d(
         pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i: (b, i, 0)),  # lse
     ]
     args = [q, k, v, o, do, lse]
+    if has_glse:
+        in_specs.append(
+            pl.BlockSpec((1, block_q, STAT_LANES), lambda b, i: (b, i, 0))
+        )
+        args.append(glse)
     if has_mask:
         in_specs.append(
             pl.BlockSpec((1, 1, tk), lambda b, i, h=heads: (b // h, 0, 0))
         )
         args.append(mask)
     dq, dk, dv = pl.pallas_call(
-        _with_optional_mask(kernel, has_mask, n_in=7),
+        _adapt_optional(kernel, 6, (has_glse, has_mask)),
         grid=(bh, num_i),
         in_specs=in_specs,
         out_specs=[
@@ -421,15 +437,15 @@ def _flash_fwd_rule(
     return out, (q, k, v, out3, lse, mask)
 
 
-def _flash_bwd_rule(
-    causal, scale, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
-    res, g,
-):
+def _bwd_common(res, g_o, glse3, causal, scale, bwd_block_q, bwd_block_k,
+                interpret):
+    """The one backward path both vjp rules share; ``glse3`` is the lse
+    cotangent in residual layout ([BH, T, STAT_LANES]) or None."""
     q, k, v, out3, lse, mask = res
     b, t, h, d = q.shape
     dq3, dk3, dv3 = _flash_bwd_3d(
-        _to3(q), _to3(k), _to3(v), out3, lse, _to3(g.astype(q.dtype)),
-        mask, causal, scale, bwd_block_q, bwd_block_k, interpret,
+        _to3(q), _to3(k), _to3(v), out3, lse, _to3(g_o.astype(q.dtype)),
+        glse3, mask, causal, scale, bwd_block_q, bwd_block_k, interpret,
     )
     dmask = (
         None
@@ -439,7 +455,82 @@ def _flash_bwd_rule(
     return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h), dmask
 
 
+def _flash_bwd_rule(
+    causal, scale, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+    res, g,
+):
+    return _bwd_common(
+        res, g, None, causal, scale, bwd_block_q, bwd_block_k, interpret
+    )
+
+
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# -- (o, lse) variant: lse is a first-class differentiable output ----------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_stats(
+    q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+    bwd_block_k, interpret,
+):
+    out, (out3, lse) = _run(
+        q, k, v, mask, causal, scale, block_q, block_k, interpret
+    )
+    b, t, h, _ = q.shape
+    return out, lse[:, :, 0].reshape(b, h, t)
+
+
+def _flash_stats_fwd_rule(
+    q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
+    bwd_block_k, interpret,
+):
+    out, (out3, lse) = _run(
+        q, k, v, mask, causal, scale, block_q, block_k, interpret
+    )
+    b, t, h, _ = q.shape
+    return (out, lse[:, :, 0].reshape(b, h, t)), (q, k, v, out3, lse, mask)
+
+
+def _flash_stats_bwd_rule(
+    causal, scale, block_q, block_k, bwd_block_q, bwd_block_k, interpret,
+    res, g,
+):
+    g_o, g_lse = g
+    b, t = res[0].shape[0], res[0].shape[1]
+    h = res[0].shape[2]
+    glse3 = jnp.broadcast_to(
+        g_lse.astype(jnp.float32).reshape(b * h, t)[:, :, None],
+        (b * h, t, STAT_LANES),
+    )
+    return _bwd_common(
+        res, g_o, glse3, causal, scale, bwd_block_q, bwd_block_k, interpret
+    )
+
+
+_flash_stats.defvjp(_flash_stats_fwd_rule, _flash_stats_bwd_rule)
+
+
+def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
+          bwd_block_k, interpret):
+    """Shared public-wrapper normalization: defaults, validation, tile
+    picking, mask encoding — one place so the two entry points cannot
+    diverge."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tq, tk = q.shape[1], k.shape[1]
+    if causal and tq != tk:
+        raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
+    block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
+    block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
+    bwd_block_q = _pick_block(tq, bwd_block_q or block_q)
+    bwd_block_k = _pick_block(tk, bwd_block_k or block_k)
+    mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
+    return (mask, causal, scale, block_q, block_k, bwd_block_q,
+            bwd_block_k, interpret)
 
 
 def flash_attention(
@@ -464,19 +555,36 @@ def flash_attention(
     forward tiles.  ``interpret=None`` auto-selects: real kernel on
     TPU, Pallas interpreter elsewhere (tests on the CPU mesh take this
     path)."""
-    if scale is None:
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    tq, tk = q.shape[1], k.shape[1]
-    if causal and tq != tk:
-        raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
-    block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
-    block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
-    bwd_block_q = _pick_block(tq, bwd_block_q or block_q)
-    bwd_block_k = _pick_block(tk, bwd_block_k or block_k)
-    mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
     return _flash(
-        q, k, v, mask, causal, scale, block_q, block_k, bwd_block_q,
-        bwd_block_k, interpret,
+        q, k, v,
+        *_prep(q, k, causal, scale, kv_mask, block_q, block_k,
+               bwd_block_q, bwd_block_k, interpret),
+    )
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    kv_mask: Optional[jax.Array] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """``flash_attention`` that also returns the per-row logsumexp.
+
+    Returns (o [B, Tq, H, D], lse [B, H, Tq] f32).  The lse output is
+    DIFFERENTIABLE — its cotangent folds into the backward kernel's
+    delta term (d lse / d s = p) at no extra passes — which is what a
+    blockwise combiner needs: ``ring_attention`` merges per-ring-step
+    normalized partials as o = sum_i w_i o_i with w_i = exp(lse_i -
+    logsumexp_i lse_i), and gradients flow through both o_i and lse_i."""
+    return _flash_stats(
+        q, k, v,
+        *_prep(q, k, causal, scale, kv_mask, block_q, block_k,
+               bwd_block_q, bwd_block_k, interpret),
     )

@@ -11,7 +11,12 @@ This is the standard public recipe (Ring Attention / blockwise
 parallel attention; see PAPERS.md) implemented jax-natively with
 ``shard_map`` — communication overlaps compute because each step's
 matmuls and the next block's ppermute are independent in XLA's
-schedule.
+schedule.  Each ring step's LOCAL attention is the Pallas flash kernel
+(``ops/flash_attention``), composed through its differentiable lse
+output: scores never materialize in HBM on either level, and causal
+runs skip entirely-future blocks at ring granularity (each device
+computes rank+1 of n block pairs; a zigzag/striped layout that
+rebalances the skip savings across ranks is a known extension).
 
 The reference system has nothing like this (SURVEY.md §5.7: 2018-era,
 pre-dates sequence parallelism entirely); it is required for the
@@ -35,34 +40,33 @@ except ImportError:  # pragma: no cover - older jax
 NEG_INF = -1e30
 
 
-def _block_attn(q, k, v, scale, mask):
-    """Scores + masked softmax stats for one (Q block, K/V block) pair.
+def _local_attn(q, k, v, scale, causal):
+    """One (Q block, K/V block) local attention on the Pallas flash
+    kernel (``ops/flash_attention``): the ring distributes the sequence
+    across chips, the kernel optimizes the within-chip block loop, and
+    the two compose through the kernel's differentiable lse output.
 
-    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
-    Returns (o_unnorm [B,Tq,H,D], m [B,H,Tq], l [B,H,Tq]) — f32 stats.
-    """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1)  # [B,H,Tq]
-    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
-    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
-    p = jnp.exp(s - m_safe[..., None])
-    if mask is not None:
-        p = jnp.where(mask[None, None], p, 0.0)
-    l = jnp.sum(p, axis=-1)  # [B,H,Tq]
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
-    return o.astype(jnp.float32), m_safe, l
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D].
+    Returns (o [B,Tq,H,D] f32 normalized, lse [B,H,Tq] f32)."""
+    from edl_tpu.ops.flash_attention import flash_attention_with_lse
+
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal, scale=scale)
+    return o.astype(jnp.float32), lse
 
 
-def _merge(o1, m1, l1, o2, m2, l2):
-    """Merge two online-softmax partials (flash-attention combine)."""
-    m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
-    l = l1 * a1 + l2 * a2
-    o = o1 * a1.transpose(0, 2, 1)[..., None] + o2 * a2.transpose(0, 2, 1)[..., None]
-    return o, m, l
+def _merge_norm(o1, lse1, o2, lse2):
+    """Merge two NORMALIZED softmax partials: o = w1*o1 + w2*o2 with
+    w_i = exp(lse_i - logaddexp(lse1, lse2)).  Safe against a partial
+    whose block was fully masked (lse == NEG_INF -> weight 0)."""
+    m = jnp.maximum(lse1, lse2)
+    m = jnp.where(m <= NEG_INF / 2, 0.0, m)  # both-empty guard
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    wt1 = (w1 / denom).transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
+    wt2 = (w2 / denom).transpose(0, 2, 1)[..., None]
+    o = o1 * wt1 + o2 * wt2
+    return o, m + jnp.log(denom)
 
 
 def ring_attention(
@@ -86,7 +90,6 @@ def ring_attention(
     if axis not in mesh.axis_names:
         return reference_attention(q, k, v, causal=causal, scale=scale)
     n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
-    t_local = q.shape[1] // n
 
     # Batch stays sharded over the data axes present; sequence over the
     # ring axis.  Heads/head_dim replicated (tp composes by sharding H
@@ -108,42 +111,51 @@ def ring_attention(
 
     def local_fn(q_blk, k_blk, v_blk):
         rank = lax.axis_index(axis)
-        q_pos = rank * t_local + jnp.arange(t_local)  # absolute Q positions
 
-        def mask_for(src_rank):
-            if not causal:
-                return None
-            k_pos = src_rank * t_local + jnp.arange(t_local)
-            return q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-
-        # step 0: attend to the locally-resident K/V block
-        o, m, l = _block_attn(q_blk, k_blk, v_blk, scale, mask_for(rank))
+        # step 0: the locally-resident K/V block — same-origin, so the
+        # causal mask is the kernel's ordinary within-block causal.
+        o, lse = _local_attn(q_blk, k_blk, v_blk, scale, causal=causal)
 
         if n > 1:
             perm = [(i, (i + 1) % n) for i in range(n)]
 
             def body(t, carry):
-                o, m, l, k_cur, v_cur = carry
+                o, lse, k_cur, v_cur = carry
                 k_cur = lax.ppermute(k_cur, axis, perm)
                 v_cur = lax.ppermute(v_cur, axis, perm)
                 # after t+1 hops, this device holds the block that
                 # originated at ring rank (rank - t - 1) mod n
                 src = (rank - t - 1) % n
                 if causal:
-                    k_pos = src * t_local + jnp.arange(t_local)
-                    blk_mask = q_pos[:, None] >= k_pos[None, :]
+                    # src != rank in the rotation, so a visiting block
+                    # is either entirely in the past (src < rank:
+                    # attend unmasked) or entirely in the future
+                    # (skip the matmuls altogether — the causal flash
+                    # speedup, lifted to ring granularity).  Weight 0
+                    # in the merge keeps the skip exact.
+                    o2, lse2 = lax.cond(
+                        src < rank,
+                        lambda ops: _local_attn(
+                            q_blk, ops[0], ops[1], scale, causal=False
+                        ),
+                        lambda ops: (
+                            jnp.zeros_like(o),
+                            jnp.full(lse.shape, NEG_INF, jnp.float32),
+                        ),
+                        (k_cur, v_cur),
+                    )
                 else:
-                    blk_mask = None
-                o2, m2, l2 = _block_attn(q_blk, k_cur, v_cur, scale, blk_mask)
-                o, m, l = _merge(o, m, l, o2, m2, l2)
-                return (o, m, l, k_cur, v_cur)
+                    o2, lse2 = _local_attn(
+                        q_blk, k_cur, v_cur, scale, causal=False
+                    )
+                o, lse = _merge_norm(o, lse, o2, lse2)
+                return (o, lse, k_cur, v_cur)
 
-            o, m, l, _, _ = lax.fori_loop(
-                0, n - 1, body, (o, m, l, k_blk, v_blk)
+            o, lse, _, _ = lax.fori_loop(
+                0, n - 1, body, (o, lse, k_blk, v_blk)
             )
 
-        denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-        return (o / denom).astype(q_blk.dtype)
+        return o.astype(q_blk.dtype)
 
     kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     try:  # jax >= 0.8 renamed check_rep -> check_vma

@@ -239,11 +239,53 @@ def make_world_builder(
     # broken-world path) instead of process termination.
     _install_nonfatal_heartbeat_callback()
 
+    broken = [False]
+    #: dead worlds' distributed handles, kept referenced so their C++
+    #: destructors never run (a destructor-triggered shutdown would hit
+    #: the same barrier the leak avoids).  Each entry pins a client's
+    #: threads/fds (and, on rank 0, a service holding its old port —
+    #: the formation port formula wraps every _PORT_WINDOW /
+    #: _FORMATION_ATTEMPTS generations, at which point a leaked port
+    #: costs one burned formation attempt).  Hard-capped: a process
+    #: that survives this many ungraceful world deaths is pathological
+    #: — fail loudly and let the pod restart rejoin cleanly.
+    graveyard = []
+    _MAX_DEAD_WORLDS = 32
+
+    def mark_broken():
+        broken[0] = True
+
     def teardown():
         from jax._src import distributed
 
         gs = distributed.global_state
-        if gs.client is not None or gs.service is not None:
+        if broken[0]:
+            broken[0] = False
+            # The world died UNGRACEFULLY (peer SIGKILL/preemption): the
+            # shutdown barrier can never complete — dead peers don't
+            # arrive — and jaxlib's coordination service then propagates
+            # the barrier failure to every polling client, which can
+            # terminate() the surviving process from a background C++
+            # thread (observed as std::bad_cast under load; no Python
+            # except can catch it).  Leak the dead world's handles
+            # instead: the per-generation port window guarantees the
+            # next formation never reuses this world's port, so a
+            # leaked service holding its old port is inert.
+            if gs.client is not None or gs.service is not None:
+                if len(graveyard) >= _MAX_DEAD_WORLDS:
+                    raise RuntimeError(
+                        f"{_MAX_DEAD_WORLDS} ungraceful world deaths in "
+                        "one process: leaked-handle budget exhausted; "
+                        "restart the trainer pod (it will rejoin and "
+                        "restore from the coordinator's checkpoint)"
+                    )
+                graveyard.append(
+                    (gs.client, gs.service, gs.preemption_sync_manager)
+                )
+                gs.client = None
+                gs.service = None
+                gs.preemption_sync_manager = None
+        elif gs.client is not None or gs.service is not None:
             try:
                 jax.distributed.shutdown()
             except Exception:
@@ -315,6 +357,21 @@ def make_world_builder(
             )
         return devices
 
+    def leak_dead_world():
+        """Abandon the current world's handles WITHOUT the shutdown
+        barrier — for fatal exit paths where no next formation will
+        run teardown (e.g. the broken-world cap re-raising).  Leaving
+        the handles live would let interpreter-exit destructors hit the
+        dead-peer barrier and abort the process from a C++ thread,
+        replacing the diagnostic traceback with a terminate()."""
+        mark_broken()
+        teardown()
+
+    # ElasticTrainer calls these: mark_broken when a collective dies
+    # mid-step (so the NEXT teardown knows the world is unbarrierable),
+    # leak_dead_world when it is about to re-raise fatally.
+    build.mark_broken = mark_broken
+    build.leak_dead_world = leak_dead_world
     return build
 
 
@@ -398,6 +455,11 @@ def run(
                 if devs is not None:
                     _check_slice_topology(cfg["slice_topology"], devs)
                 return devs
+
+            # the broken-world signals must reach the RAW builder's
+            # teardown through this wrapper
+            world_builder.mark_broken = raw_builder.mark_broken
+            world_builder.leak_dead_world = raw_builder.leak_dead_world
 
             gbs = gbs or 64
         coordinator.register(

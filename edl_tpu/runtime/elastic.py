@@ -518,7 +518,14 @@ class ElasticTrainer:
 
     def _world_broken(self) -> None:
         """The live process group failed mid-step.  Drop every handle to
-        it and hold for a fresh generation (see maybe_resize)."""
+        it and hold for a fresh generation (see maybe_resize).  Tell the
+        world builder the group is unbarrierable so its next teardown
+        skips the shutdown barrier (dead peers never arrive, and the
+        barrier-failure propagation can kill the survivor from a C++
+        thread — see launcher.make_world_builder)."""
+        mark = getattr(self.world_builder, "mark_broken", None)
+        if mark is not None:
+            mark()
         self.state = None
         self._trainers.clear()
         self.mesh = None
@@ -698,6 +705,16 @@ class ElasticTrainer:
                     self._last_failed_step = attempted
                     self._world_broken()
                     continue
+                # Fatal: no next formation will tear this world down.
+                # Abandon its handles barrier-free so interpreter-exit
+                # destructors can't hang/abort on dead peers and mask
+                # the diagnostic traceback below.
+                leak = getattr(self.world_builder, "leak_dead_world", None)
+                if leak is not None:
+                    try:
+                        leak()
+                    except Exception:
+                        pass
                 raise
         self.profiler.stop()  # close any live trace at target step
         return self.history

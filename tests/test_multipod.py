@@ -631,3 +631,61 @@ def test_multipod_ungraceful_kill_evicts_and_reforms(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+def test_broken_world_teardown_skips_shutdown_barrier(monkeypatch):
+    """After a mid-step collective failure the next teardown must NOT
+    run jax.distributed.shutdown: its barrier cannot complete (dead
+    peers never arrive) and the coordination service's barrier-failure
+    propagation can terminate() the surviving process from a C++ thread
+    (std::bad_cast observed under CI load).  The dead world's handles
+    are leaked instead — inert, because the per-generation port window
+    never reuses the dead world's port."""
+    import jax
+
+    from edl_tpu.launcher import make_world_builder
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    build = make_world_builder("t0")
+    assert callable(build.mark_broken)
+
+    from jax._src import distributed
+
+    gs = distributed.global_state
+    sentinel_client, sentinel_service = object(), object()
+    monkeypatch.setattr(gs, "client", sentinel_client, raising=False)
+    monkeypatch.setattr(gs, "service", sentinel_service, raising=False)
+    calls = []
+    monkeypatch.setattr(
+        jax.distributed, "shutdown", lambda: calls.append("barrier")
+    )
+
+    # _world_broken forwards the signal through the builder attribute
+    et = ElasticTrainer.__new__(ElasticTrainer)
+    et.world_builder = build
+    et._trainers = {}
+    et.state = None
+    et.mesh = None
+    et._world_broken()
+
+    class Plan:
+        members = ["someone-else"]  # not t0: teardown-only path
+        addresses = []
+        generation = 7
+        world_size = 1
+
+    assert build(Plan()) is None  # standby: teardown ran, no re-init
+    assert calls == [], "broken teardown must not enter the barrier"
+    assert gs.client is None and gs.service is None  # handles dropped
+
+    # A GRACEFUL teardown (no broken mark) still uses the barrier.
+    monkeypatch.setattr(gs, "client", sentinel_client, raising=False)
+    assert build(Plan()) is None
+    assert calls == ["barrier"]
+
+    # Fatal-exit path: leak_dead_world abandons handles barrier-free
+    # (no next build() will run teardown for the re-raising trainer).
+    monkeypatch.setattr(gs, "client", sentinel_client, raising=False)
+    build.leak_dead_world()
+    assert calls == ["barrier"]  # no new barrier entry
+    assert gs.client is None

@@ -255,6 +255,28 @@ def make_world_builder(
     def mark_broken():
         broken[0] = True
 
+    def _bury(gs):
+        """Graveyard the live distributed handles (no destructors, no
+        barrier), then enforce the leak budget.  The handles are
+        secured BEFORE the cap check raises: a budget-exhausted process
+        must still exit with a traceback, not a destructor-triggered
+        barrier abort."""
+        graveyard.append(
+            (gs.client, gs.service, gs.preemption_sync_manager)
+        )
+        gs.client = None
+        gs.service = None
+        gs.preemption_sync_manager = None
+        if len(graveyard) > _MAX_DEAD_WORLDS:
+            from edl_tpu.runtime.elastic import FatalWorldError
+
+            raise FatalWorldError(
+                f"{_MAX_DEAD_WORLDS} ungraceful world deaths in one "
+                "process: leaked-handle budget exhausted; restart the "
+                "trainer pod (it will rejoin and restore from the "
+                "coordinator's checkpoint)"
+            )
+
     def teardown():
         from jax._src import distributed
 
@@ -272,28 +294,17 @@ def make_world_builder(
             # next formation never reuses this world's port, so a
             # leaked service holding its old port is inert.
             if gs.client is not None or gs.service is not None:
-                if len(graveyard) >= _MAX_DEAD_WORLDS:
-                    raise RuntimeError(
-                        f"{_MAX_DEAD_WORLDS} ungraceful world deaths in "
-                        "one process: leaked-handle budget exhausted; "
-                        "restart the trainer pod (it will rejoin and "
-                        "restore from the coordinator's checkpoint)"
-                    )
-                graveyard.append(
-                    (gs.client, gs.service, gs.preemption_sync_manager)
-                )
-                gs.client = None
-                gs.service = None
-                gs.preemption_sync_manager = None
+                _bury(gs)
         elif gs.client is not None or gs.service is not None:
             try:
                 jax.distributed.shutdown()
             except Exception:
                 # Peers already gone (scale-down races the shutdown
-                # barrier): force-drop the dead world's handles; the
-                # next initialize starts clean.
-                gs.client = None
-                gs.service = None
+                # barrier): the world may be un-barrierable, so treat
+                # its handles like a broken world's — graveyarded, not
+                # dropped to GC, whose destructors would re-enter the
+                # same shutdown machinery.
+                _bury(gs)
         from jax._src import xla_bridge
 
         if xla_bridge.backends_are_initialized():
@@ -331,11 +342,15 @@ def make_world_builder(
                     num_processes=len(plan.members),
                     process_id=rank,
                     initialization_timeout=_FORMATION_TIMEOUT_S,
-                    # Keep the teardown barrier short: scale-down peers
-                    # leave at their own pace, and a standby pod must
-                    # not block 300s (the default) in shutdown before
-                    # it can hold.
-                    shutdown_timeout_seconds=10,
+                    # Teardown-barrier patience: long enough that a
+                    # loaded peer's graceful leave (both parties alive,
+                    # skewed tens of seconds under CI load) still
+                    # completes the barrier — a timeout here risks the
+                    # coordination service's error propagation — yet
+                    # far under the 300s default so a standby pod
+                    # doesn't stall its hold.  Dead-peer worlds never
+                    # reach this barrier at all (see teardown()).
+                    shutdown_timeout_seconds=30,
                 )
                 break
             except Exception:

@@ -9,13 +9,13 @@ from edl_tpu.ops.ring_attention import reference_attention, ring_attention
 
 
 #: At or above this sequence length attention dispatches to the Pallas
-#: flash kernel on TPU.  Re-measured on v5e with the blockwise
-#: backward: XLA's fused attention is slightly faster fwd+bwd up
-#: through T=1024 (both are softmax/VPU-bound at head_dim 64), but its
-#: [B, H, T, T] f32 score tensor OOMs 16G HBM from T=2048 at training
-#: batch sizes — the crossover is *memory*, and flash is the only
-#: path that scales long-context.
-FLASH_MIN_SEQ_LEN = 2048
+#: flash kernel on TPU.  Re-measured on v5e after the 512x512 tile
+#: retune (in-model, fwd+bwd, fixed B*T): flash wins from T=512 up
+#: (T=512/B=32: 33.8ms vs XLA 43.5; T=1024/B=16: 37.7 vs 60.9) and is
+#: a wash at T=256 (34.2 vs 33.9, XLA marginally ahead).  From T=2048
+#: it is also the only path that fits: XLA's [B, H, T, T] f32 scores
+#: OOM 16G HBM at training batch sizes.
+FLASH_MIN_SEQ_LEN = 512
 
 
 def fused_attention(q, k, v, causal=False, scale=None, kv_mask=None):

@@ -46,6 +46,14 @@ from edl_tpu.runtime.data import ShardedDataIterator
 from edl_tpu.runtime.train import Trainer, TrainState
 
 
+class FatalWorldError(RuntimeError):
+    """Unrecoverable world-management failure (e.g. the launcher's
+    dead-world leak budget is exhausted): the process must exit loudly
+    so the pod restarts and rejoins — holding and retrying would only
+    repeat the failure.  ``_rebuild_world`` re-raises this where
+    ordinary formation errors degrade to hold-and-retry."""
+
+
 @dataclass
 class ResizeEvent:
     generation: int
@@ -250,6 +258,8 @@ class ElasticTrainer:
         self.mesh = None
         try:
             devs = self.world_builder(plan)
+        except FatalWorldError:
+            raise  # loud exit, not hold-and-retry (see the class doc)
         except Exception:
             return False
         if devs is None:
@@ -610,6 +620,11 @@ class ElasticTrainer:
                 elif hold_started is None:
                     hold_started = now
                 elif now - hold_started > self.barrier_timeout:
+                    # A broken world's handles may still be live here
+                    # (teardown only runs at the NEXT formation, which
+                    # never came): abandon them barrier-free so exit
+                    # destructors can't mask this diagnostic.
+                    self._leak_dead_world()
                     raise RuntimeError(
                         f"held at resize barrier > {self.barrier_timeout}s "
                         "with no formable world"
@@ -618,6 +633,7 @@ class ElasticTrainer:
                 continue
             hold_started = None
             if self.state is None:
+                self._leak_dead_world()
                 raise RuntimeError("no plan with world_size >= 1 available")
             step = None  # the step this iteration attempts (for the cap)
             try:
@@ -709,15 +725,21 @@ class ElasticTrainer:
                 # Abandon its handles barrier-free so interpreter-exit
                 # destructors can't hang/abort on dead peers and mask
                 # the diagnostic traceback below.
-                leak = getattr(self.world_builder, "leak_dead_world", None)
-                if leak is not None:
-                    try:
-                        leak()
-                    except Exception:
-                        pass
+                self._leak_dead_world()
                 raise
         self.profiler.stop()  # close any live trace at target step
         return self.history
+
+    def _leak_dead_world(self) -> None:
+        """Best-effort barrier-free abandonment of the current world's
+        distributed handles, for fatal exit paths (see
+        launcher.make_world_builder's leak_dead_world)."""
+        leak = getattr(self.world_builder, "leak_dead_world", None)
+        if leak is not None:
+            try:
+                leak()
+            except Exception:
+                pass
 
     def _world_size(self) -> int:
         # Trainer count = total mesh devices / devices-per-trainer (the

@@ -689,3 +689,17 @@ def test_broken_world_teardown_skips_shutdown_barrier(monkeypatch):
     build.leak_dead_world()
     assert calls == ["barrier"]  # no new barrier entry
     assert gs.client is None
+
+    # Leak budget: the cap raises FatalWorldError AFTER securing the
+    # handles (a budget-exhausted process must exit with a traceback,
+    # not a destructor-triggered barrier abort), and never barriers.
+    import pytest
+
+    from edl_tpu.runtime.elastic import FatalWorldError
+
+    with pytest.raises(FatalWorldError, match="budget exhausted"):
+        for _ in range(40):
+            monkeypatch.setattr(gs, "client", object(), raising=False)
+            build.leak_dead_world()
+    assert gs.client is None  # secured before the raise
+    assert calls == ["barrier"]

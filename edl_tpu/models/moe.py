@@ -1,0 +1,333 @@
+"""Mixture-of-Experts LM — the expert-parallel (``ep``) model family.
+
+Beyond the reference's scope (it never saw a model at all, SURVEY.md
+§2.3), this family exists to make the mesh's ``ep`` axis load-bearing:
+experts shard over ``ep``, so scaling experts means adding chips on
+that axis rather than growing every chip's memory.
+
+TPU-first routing: **static-shape capacity-based top-1 dispatch** (the
+Switch-Transformer recipe) expressed entirely as einsums —
+
+- router logits -> top-1 expert per token,
+- tokens route within fixed-size GROUPS (so the one-hot dispatch
+  tensor is [groups, G, E, C] with C proportional to G/E — routing
+  memory and FLOPs stay LINEAR in total tokens; ungrouped capacity
+  routing is quadratic and cannot fit full-size configs),
+- each token's position in its expert's per-group buffer comes from a
+  capacity cumulative-sum; tokens past capacity are dropped (their
+  residual stream passes through unchanged),
+- the ``dispatch`` one-hot scatters tokens to expert buffers and its
+  gate-weighted transpose (``combine``) gathers them back.
+
+No gathers, no dynamic shapes, no ragged anything: the dispatch/combine
+einsums are MXU matmuls.  The router adds the standard load-balancing
+auxiliary loss (mean fraction x mean probability per expert) so
+training actually spreads load.
+
+Partition rules: expert weights are [E, d_model, d_ff] sharded
+``P("ep", "fsdp", "tp")``.  Pass ``ep_mesh`` to ALSO pin the expert
+buffers' activation sharding (``with_sharding_constraint`` over the
+``ep`` axis): storage sharding alone leaves GSPMD free to all-gather
+the expert weights per step, which would make the ep axis
+non-load-bearing.  With the constraint, every expert matmul runs on
+its device's LOCAL expert shard and the partitioner inserts the
+token<->expert redistribution collective (all-to-all on TPU
+topologies; the CPU partitioner picks gather-based forms) — compiler-
+inserted, like every collective in this framework (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.models.transformer_lm import CausalSelfAttention
+
+
+def _group_size(n: int, want: int = 512) -> int:
+    """Largest divisor of ``n`` that is <= want (routing group width)."""
+    g = min(want, n)
+    while n % g != 0:
+        g -= 1
+    return g
+
+
+class MoEMlp(nn.Module):
+    """Top-1 capacity-routed expert MLP over ``num_experts`` experts.
+
+    ``ep_mesh``: optional mesh carrying an ``ep`` axis; when present
+    the expert buffers get an explicit activation sharding constraint
+    so every expert matmul runs on its device's local expert shard
+    (instead of GSPMD all-gathering the expert weights)."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    capacity_factor: float = 1.25
+    ep_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    def _constrain(self, x):
+        if self.ep_mesh is None or "ep" not in self.ep_mesh.axis_names:
+            return x
+        from jax.sharding import NamedSharding
+
+        spec = P(*([None] * (x.ndim - 3)), "ep", None, None)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.ep_mesh, spec)
+        )
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        n = b * t
+        e = self.num_experts
+        G = _group_size(n)  # routing group width (tokens)
+        g = n // G
+        cap = max(1, int(self.capacity_factor * G / e))
+        tokens = x.reshape(n, d)
+
+        # Router in f32: small, numerically load-bearing.
+        logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )  # [n, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)  # [n]
+        gate = jnp.max(probs, axis=-1)  # [n] router weight of the winner
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [n, E]
+
+        # Load-balancing aux loss (Switch): e * sum_e fraction_e * prob_e.
+        frac = jnp.mean(onehot, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow("intermediates", "aux_loss", e * jnp.sum(frac * mean_prob))
+
+        # Position of each token within its expert's PER-GROUP capacity
+        # buffer: exclusive cumsum within the group.  Static shapes
+        # throughout — tokens at position >= cap are DROPPED (pass
+        # through on the residual stream), the standard capacity
+        # tradeoff.
+        oh_g = onehot.reshape(g, G, e)
+        pos = jnp.cumsum(oh_g, axis=1) - oh_g  # [g, G, E]
+        pos_in_expert = jnp.sum(pos * oh_g, axis=-1).astype(jnp.int32)
+        keep = pos_in_expert < cap
+        slot = jax.nn.one_hot(
+            jnp.where(keep, pos_in_expert, cap), cap, dtype=jnp.float32
+        )  # [g, G, C] (dropped tokens one-hot to nowhere)
+        dispatch = oh_g[..., None] * slot[:, :, None, :]  # [g, G, E, C]
+        combine = dispatch * gate.reshape(g, G)[..., None, None]
+
+        # Scatter tokens to expert buffers, run every expert, gather.
+        wi = self.param(
+            "wi",
+            nn.initializers.lecun_normal(),
+            (e, d, self.d_ff),
+            jnp.float32,
+        )
+        wo = self.param(
+            "wo",
+            nn.initializers.lecun_normal(),
+            (e, self.d_ff, d),
+            jnp.float32,
+        )
+        tok_g = tokens.reshape(g, G, d).astype(self.dtype)
+        buffers = self._constrain(
+            jnp.einsum("gnec,gnd->gecd", dispatch.astype(self.dtype), tok_g)
+        )
+        h = jnp.einsum("gecd,edf->gecf", buffers, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        out_buffers = self._constrain(
+            jnp.einsum("gecf,efd->gecd", h, wo.astype(self.dtype))
+        )
+        out = jnp.einsum(
+            "gnec,gecd->gnd", combine.astype(self.dtype), out_buffers
+        )
+        return out.reshape(b, t, d)
+
+
+class MoEBlock(nn.Module):
+    num_heads: int
+    d_model: int
+    d_ff: int
+    num_experts: int
+    sp_mesh: Optional[Mesh] = None
+    ep_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.d_model, self.sp_mesh, self.dtype, name="attn"
+        )(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        return x + MoEMlp(
+            self.d_model,
+            self.d_ff,
+            self.num_experts,
+            ep_mesh=self.ep_mesh,
+            dtype=self.dtype,
+            name="moe",
+        )(h)
+
+
+class MoELM(nn.Module):
+    vocab_size: int
+    d_model: int
+    d_ff: int
+    num_heads: int
+    num_layers: int
+    num_experts: int
+    max_len: int
+    sp_mesh: Optional[Mesh] = None
+    ep_mesh: Optional[Mesh] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        embed = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            embedding_init=nn.initializers.normal(1.0),
+            name="embed",
+        )
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.d_model),
+        )
+        T = tokens.shape[1]
+        x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
+        for i in range(self.num_layers):
+            x = MoEBlock(
+                self.num_heads,
+                self.d_model,
+                self.d_ff,
+                self.num_experts,
+                self.sp_mesh,
+                self.ep_mesh,
+                self.dtype,
+                name=f"layer_{i}",
+            )(x)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+
+
+def _partition_rules(params) -> Any:
+    """Expert weights shard over ``ep`` on the expert dim; everything
+    else follows the LM family's tp/fsdp conventions."""
+
+    def spec_for(path: str, x) -> P:
+        if x.ndim <= 1 or "pos_embed" in path:
+            return P()
+        if "embedding" in path:
+            return P("tp", "fsdp")
+        if "moe/wi" in path:  # [E, d_model, d_ff]
+            return P("ep", "fsdp", "tp")
+        if "moe/wo" in path:  # [E, d_ff, d_model]
+            return P("ep", "tp", "fsdp")
+        if "qkv/kernel" in path:
+            return P("fsdp", None, "tp", None)
+        if "out/kernel" in path:
+            return P("tp", None, "fsdp")
+        if x.ndim == 2:
+            return P("fsdp", None)
+        return P()
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = [
+        spec_for("/".join(str(getattr(k, "key", k)) for k in path), leaf)
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@register_model("moe_lm")
+def moe_lm(
+    tiny: bool = False,
+    seq_len: Optional[int] = None,
+    num_experts: Optional[int] = None,
+    sp_mesh: Optional[Mesh] = None,
+    ep_mesh: Optional[Mesh] = None,
+) -> ModelDef:
+    if tiny:
+        vocab, d_model, d_ff, heads, layers = 256, 64, 128, 4, 2
+        experts = num_experts or 4
+        L = seq_len or 64
+    else:
+        vocab, d_model, d_ff, heads, layers = 32000, 768, 1536, 12, 12
+        experts = num_experts or 8
+        L = seq_len or 2048
+    module = MoELM(
+        vocab_size=vocab,
+        d_model=d_model,
+        d_ff=d_ff,
+        num_heads=heads,
+        num_layers=layers,
+        num_experts=experts,
+        max_len=L,
+        sp_mesh=sp_mesh,
+        ep_mesh=ep_mesh,
+    )
+    sample = jnp.zeros((1, L), jnp.int32)
+
+    def init_params(rng: jax.Array):
+        return module.init(rng, sample)["params"]
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        from edl_tpu.ops.losses import best_vocab_xent
+
+        tokens = batch["tokens"]
+        labels = tokens[:, 1:]
+        x, inter = module.apply(
+            {"params": params},
+            tokens[:, :-1],
+            mutable=["intermediates"],
+        )
+        loss, _ = best_vocab_xent(
+            x, params["embed"]["embedding"], labels, labels != 0
+        )
+        aux_leaves = jax.tree_util.tree_leaves(inter)
+        aux = (
+            sum(jnp.asarray(a) for a in aux_leaves) / max(1, len(aux_leaves))
+            if aux_leaves
+            else jnp.float32(0)
+        )
+        total = loss + 0.01 * aux
+        return total, {"loss": loss, "moe_aux_loss": aux}
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        start = rng.randint(3, vocab - 8, size=(n, 1))
+        t = np.arange(L + 1)[None, :]
+        tokens = 3 + ((start - 3) + t) % (vocab - 3)
+        return {"tokens": tokens.astype(np.int32)}
+
+    # Active FLOPs per example: attention/proj as a dense LM, one
+    # expert's MLP per token (top-1 routing), the vocab projection,
+    # AND the dispatch/combine einsums — per token those touch
+    # ~2 * capacity_factor * G * d_model MACs (G = routing group
+    # width), which at G=512 is the same order as the expert MLP and
+    # must not be silently dropped from MFU accounting.
+    att_proj = 4 * d_model * d_model
+    G = min(512, L)
+    route = 2 * int(1.25 * G) * d_model
+    flops = (
+        6
+        * (layers * (att_proj + 2 * d_model * d_ff + route) + vocab * d_model)
+        * L
+        + 12 * layers * L * L * d_model // 2
+    )
+    return ModelDef(
+        name="moe_lm",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        param_partition=_partition_rules,
+        flops_per_example=flops,
+        tokens_per_example=L,
+    )

@@ -289,6 +289,8 @@ class ElasticTrainer:
         if self.world_builder is not None:
             try:
                 self.world_builder(plan)  # teardown-only (not a member)
+            except FatalWorldError:
+                raise  # loud exit (leak budget), not silent standby
             except Exception:
                 pass
         self.generation = plan.generation

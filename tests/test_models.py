@@ -152,3 +152,104 @@ def test_full_size_models_construct():
         np.prod(l.shape) for l in jax.tree_util.tree_leaves(shapes)
     )
     assert 2e7 < n_params < 4e7, f"resnet50 params {n_params:,}"
+
+
+# ---- MoE / expert parallelism (beyond the reference's scope) ----------------
+
+
+def test_moe_dispatch_combine_exact_vs_dense():
+    """With one expert and ample capacity, the dispatch/combine einsum
+    routing must reproduce a plain dense MLP exactly: every token goes
+    to expert 0 at gate 1.0, so MoEMlp(x) == gelu(x @ wi[0]) @ wo[0]."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models.moe import MoEMlp
+
+    mod = MoEMlp(d_model=16, d_ff=32, num_experts=1, capacity_factor=2.0,
+                 dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    params = mod.init(jax.random.PRNGKey(1), x)["params"]
+    out = mod.apply({"params": params}, x)
+    import flax.linen as nn
+
+    ref = nn.gelu(x @ params["wi"][0]) @ params["wo"][0]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_capacity_drop_passes_through():
+    """Tokens beyond an expert's capacity get ZERO MLP delta (their
+    residual stream passes through unchanged) — the static-shape
+    capacity contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models.moe import MoEMlp
+
+    # 1 expert, capacity_factor tiny -> capacity 1: only the first
+    # token is processed, the rest are dropped.
+    mod = MoEMlp(d_model=8, d_ff=16, num_experts=1, capacity_factor=0.01,
+                 dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 8))
+    params = mod.init(jax.random.PRNGKey(1), x)["params"]
+    out = np.asarray(mod.apply({"params": params}, x))
+    assert np.abs(out[0, 0]).max() > 0  # first token processed
+    np.testing.assert_array_equal(out[0, 1:], 0)  # rest dropped
+
+
+def test_moe_expert_parallel_sharding_and_step():
+    """dp2 x ep4: expert weights shard over the ep axis (local shard
+    carries 1 of 4 experts), the compiled step carries the
+    token->expert all-to-all (ep is load-bearing, not just declared),
+    and a train step runs with finite loss."""
+    mesh = build_mesh(MeshSpec.create(dp=2, ep=4))
+    m = get_model("moe_lm", tiny=True, ep_mesh=mesh)
+    tr = Trainer(m, optax.adam(1e-3), mesh)
+    state = tr.init_state()
+
+    wi = state.params["layer_0"]["moe"]["wi"]
+    assert wi.shape[0] == 4  # experts
+    shard = wi.addressable_shards[0].data
+    assert shard.shape[0] == 1, f"experts not sharded over ep: {shard.shape}"
+
+    data = ShardedDataIterator(
+        synthetic_dataset(m.synth_batch, 128), global_batch_size=16
+    )
+    batch = data.device_batch(0, mesh, batch_axes=("dp",))
+    # The compiled step must run the expert MLP on LOCAL expert shards
+    # (e dim 1 of 4 per device) — never on the full expert dim, which
+    # would mean GSPMD all-gathered the experts and ep is decorative.
+    # (The redistribution collective itself is the partitioner's
+    # choice: all-to-all on TPU topologies, gather-based elsewhere.)
+    import re as _re
+
+    hlo = tr.lower_step(state, batch).as_text()
+    assert _re.search(r"bf16\[\d+,1,\d+,128\]", hlo), (
+        "no ep-local expert matmul operand in the compiled step"
+    )
+    assert not _re.search(r"bf16\[\d+,4,\d+,128\]", hlo), (
+        "found a FULL-expert-dim d_ff operand: experts were gathered"
+    )
+    state2, metrics = tr.step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert np.isfinite(float(metrics["moe_aux_loss"]))
+    assert state2.params["layer_0"]["moe"]["wi"].sharding == wi.sharding
+
+
+def test_moe_lm_learns():
+    """The tiny MoE LM trains end-to-end (loss falls) on one device."""
+    import optax as _optax
+
+    m = get_model("moe_lm", tiny=True)
+    mesh = dp_mesh(1)
+    tr = Trainer(m, _optax.adam(1e-3), mesh)
+    state = tr.init_state()
+    data = ShardedDataIterator(
+        synthetic_dataset(m.synth_batch, 256), global_batch_size=16
+    )
+    losses = []
+    for s in range(25):
+        state, metrics = tr.step(state, data.device_batch(s, mesh))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.8, losses[::6]

@@ -11,6 +11,7 @@ from edl_tpu.parallel.mesh import (
     batch_sharding,
     replicated_sharding,
 )
+from edl_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = [
     "AXIS_DP",
@@ -24,4 +25,5 @@ __all__ = [
     "dp_mesh",
     "batch_sharding",
     "replicated_sharding",
+    "pipeline_apply",
 ]

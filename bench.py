@@ -198,6 +198,25 @@ def _longcontext_child(seq_len: int, batch: int, steps: int):
     print(json.dumps(_timed_train_loop(model, batch, steps)))
 
 
+def bench_moe_lm(batch: int = 4, steps: int = 4) -> dict:
+    """Full-size MoE LM (12L x 8 experts, T=2048, grouped top-1
+    routing) — the expert-parallel family's single-chip figure (MFU is
+    ACTIVE FLOPs: one expert per token plus routing einsums).  Child
+    process for the same chip-isolation reason as long context."""
+    return _run_bench_child("--moe-child", str(batch), str(steps))
+
+
+def _moe_child(batch: int, steps: int):
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "full-size MoE bench is TPU-only"}))
+        return
+    from edl_tpu.models.base import get_model
+
+    print(json.dumps(_timed_train_loop(get_model("moe_lm"), batch, steps)))
+
+
 def _run_bench_child(*argv: str, env=None) -> dict:
     """Spawn this file as a child bench section and parse the JSON line
     it prints last (warnings go to stderr, so the parse is safe)."""
@@ -256,6 +275,7 @@ def main():
     # Long-context first: its child must own the chip alone (this
     # process has not initialized a TPU client yet).
     lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
+    moe = _attempt(bench_moe_lm, "moe_lm", retries=0)
     r = _attempt(bench_resize, "resize")
     thr = _attempt(bench_transformer_throughput, "transformer_base")
     cross = _attempt(bench_cpu_cross_size, "cpu_cross_size", retries=0)
@@ -270,7 +290,8 @@ def main():
                     "unit": "s",
                     "vs_baseline": None,
                     "detail": {"error": r["error"], "transformer_base": thr,
-                               "longcontext_lm": lc, "cpu_cross_size": cross},
+                               "longcontext_lm": lc, "moe_lm": moe,
+                               "cpu_cross_size": cross},
                 }
             )
         )
@@ -311,6 +332,17 @@ def main():
                             "seq_len": lc["seq_len"],
                         }
                     ),
+                    "moe_lm": (
+                        moe
+                        if ("error" in moe or "skipped" in moe)
+                        else {
+                            "step_s": round(moe["step_s"], 5),
+                            "tokens_per_s": round(moe["tokens_per_s"]),
+                            "mfu": round(moe["mfu"], 4),
+                            "batch": moe["batch"],
+                            "seq_len": moe["seq_len"],
+                        }
+                    ),
                     "cpu_cross_size": (
                         cross
                         if "error" in cross
@@ -344,5 +376,9 @@ if __name__ == "__main__":
         i = sys.argv.index("--longcontext-child")
         sl, b, st = (int(x) for x in sys.argv[i + 1 : i + 4])
         _longcontext_child(sl, b, st)
+    elif "--moe-child" in sys.argv:
+        i = sys.argv.index("--moe-child")
+        b, st = (int(x) for x in sys.argv[i + 1 : i + 3])
+        _moe_child(b, st)
     else:
         main()

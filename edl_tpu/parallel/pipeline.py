@@ -112,7 +112,19 @@ def pipeline_apply(
     # Microbatch dim may shard over dp; stage dim over pp; everything
     # else replicated at this level (fsdp/tp compose inside stage_fn
     # via GSPMD on the params' own specs).
-    bspec = batch_axis if batch_axis in sizes and mb % sizes.get(batch_axis, 1) == 0 else None
+    dp_size = sizes.get(batch_axis, 1)
+    bspec = batch_axis if batch_axis in sizes and mb % dp_size == 0 else None
+    if batch_axis in sizes and dp_size > 1 and bspec is None:
+        import sys
+
+        print(
+            f"[edl] pipeline_apply: microbatch width {mb} not divisible "
+            f"by the {batch_axis!r} axis ({dp_size}); running the "
+            "pipeline REPLICATED over it (correct but wastes "
+            f"{dp_size}x compute) — pick num_microbatches so "
+            f"B/num_microbatches divides {dp_size}",
+            file=sys.stderr,
+        )
     x_spec = P(None, bspec, *([None] * (x.ndim - 1)))
     p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
     # Output keeps the [M, mb, ...] layout (same spec as the input) and

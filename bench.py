@@ -271,6 +271,20 @@ def _attempt(fn, label: str, retries: int = 1):
     return {"error": err[:500]}
 
 
+def _lm_summary(r: dict) -> dict:
+    """Per-model bench summary (one shape for every LM section); error
+    and skipped records pass through untouched."""
+    if "error" in r or "skipped" in r:
+        return r
+    return {
+        "step_s": round(r["step_s"], 5),
+        "tokens_per_s": round(r["tokens_per_s"]),
+        "mfu": round(r["mfu"], 4),
+        "batch": r["batch"],
+        "seq_len": r["seq_len"],
+    }
+
+
 def main():
     # Long-context first: its child must own the chip alone (this
     # process has not initialized a TPU client yet).
@@ -310,39 +324,9 @@ def main():
                     "n_devices": r["n_devices"],
                     "world_cycle": r["world_cycle"],
                     "budget_s": RESIZE_BUDGET_S,
-                    "transformer_base": (
-                        thr
-                        if "error" in thr
-                        else {
-                            "step_s": round(thr["step_s"], 5),
-                            "tokens_per_s": round(thr["tokens_per_s"]),
-                            "mfu": round(thr["mfu"], 4),
-                            "batch": thr["batch"],
-                            "seq_len": thr["seq_len"],
-                        }
-                    ),
-                    "longcontext_lm": (
-                        lc
-                        if ("error" in lc or "skipped" in lc)
-                        else {
-                            "step_s": round(lc["step_s"], 5),
-                            "tokens_per_s": round(lc["tokens_per_s"]),
-                            "mfu": round(lc["mfu"], 4),
-                            "batch": lc["batch"],
-                            "seq_len": lc["seq_len"],
-                        }
-                    ),
-                    "moe_lm": (
-                        moe
-                        if ("error" in moe or "skipped" in moe)
-                        else {
-                            "step_s": round(moe["step_s"], 5),
-                            "tokens_per_s": round(moe["tokens_per_s"]),
-                            "mfu": round(moe["mfu"], 4),
-                            "batch": moe["batch"],
-                            "seq_len": moe["seq_len"],
-                        }
-                    ),
+                    "transformer_base": _lm_summary(thr),
+                    "longcontext_lm": _lm_summary(lc),
+                    "moe_lm": _lm_summary(moe),
                     "cpu_cross_size": (
                         cross
                         if "error" in cross

@@ -544,17 +544,34 @@ def run(
     # deregister + flush synchronously so the survivors' resize window
     # never waits out the heartbeat lease (VERDICT r1 §missing-3).  The
     # reference relied on the lease expiring — a 30s budget hole.
+    def _deregister_all():
+        """Leave the membership.  Transport retries live in the HTTP
+        client itself (HTTPCoordinator._request: 3 tries, 5s timeout)
+        — stacking another retry loop here could blow the k8s
+        termination grace period from inside the SIGTERM handler.  A
+        final failure is LOGGED (it used to be silently swallowed,
+        leaving a ghost member until the lease expired — the 30s
+        budget hole the handshake exists to close — with zero trace
+        of why)."""
+        import sys
+
+        for tid in heartbeat_ids:
+            try:
+                coordinator.deregister(tid)
+            except Exception as e:
+                print(
+                    f"[edl] deregister {tid} failed (ghost member "
+                    f"until lease expiry): {e}",
+                    file=sys.stderr,
+                )
+
     def _graceful_leave(signum, frame):
         try:
             et.stop_heartbeat()
             if et.state is not None and jax.process_count() == 1:
                 et.store.save_async(et.state, generation=et.generation)
                 et.store.wait()
-            for tid in heartbeat_ids:
-                try:
-                    coordinator.deregister(tid)
-                except Exception:
-                    pass
+            _deregister_all()
         finally:
             os._exit(0)
 
@@ -601,11 +618,7 @@ def run(
         # FIRST — an in-flight beat after the deregister would resurrect
         # this pod as a ghost member.
         et.stop_heartbeat()
-        for tid in heartbeat_ids:
-            try:
-                coordinator.deregister(tid)
-            except Exception:
-                pass
+        _deregister_all()
     finally:
         signal.signal(signal.SIGTERM, prev_term)
         et.stop_heartbeat()

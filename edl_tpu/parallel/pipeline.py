@@ -121,8 +121,9 @@ def pipeline_apply(
             f"[edl] pipeline_apply: microbatch width {mb} not divisible "
             f"by the {batch_axis!r} axis ({dp_size}); running the "
             "pipeline REPLICATED over it (correct but wastes "
-            f"{dp_size}x compute) — pick num_microbatches so "
-            f"B/num_microbatches divides {dp_size}",
+            f"{dp_size}x compute) — pick num_microbatches so the "
+            f"microbatch width B/num_microbatches is a multiple of "
+            f"{dp_size}",
             file=sys.stderr,
         )
     x_spec = P(None, bspec, *([None] * (x.ndim - 1)))

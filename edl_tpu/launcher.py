@@ -553,24 +553,48 @@ def run(
         leaving a ghost member until the lease expired — the 30s
         budget hole the handshake exists to close — with zero trace
         of why)."""
-        import sys
-
         for tid in heartbeat_ids:
             try:
                 coordinator.deregister(tid)
             except Exception as e:
-                print(
-                    f"[edl] deregister {tid} failed (ghost member "
-                    f"until lease expiry): {e}",
-                    file=sys.stderr,
-                )
+                # os.write is signal-safe; print() can raise a
+                # reentrant-buffered-IO RuntimeError inside the SIGTERM
+                # handler and abort the loop mid-deregistration.
+                try:
+                    os.write(
+                        2,
+                        (
+                            f"[edl] deregister {tid} failed (ghost "
+                            f"member until lease expiry): {e}\n"
+                        ).encode(errors="backslashreplace"),
+                    )
+                except Exception:
+                    pass
 
     def _graceful_leave(signum, frame):
+        # Every phase is independently guarded: an exception in the
+        # flush (or a stuck heartbeat join) must NOT skip the
+        # deregistration — the finally's os._exit would swallow it and
+        # leave a ghost member with zero trace.
         try:
-            et.stop_heartbeat()
-            if et.state is not None and jax.process_count() == 1:
-                et.store.save_async(et.state, generation=et.generation)
-                et.store.wait()
+            try:
+                et.stop_heartbeat()
+            except Exception:
+                pass
+            try:
+                if et.state is not None and jax.process_count() == 1:
+                    et.store.save_async(et.state, generation=et.generation)
+                    et.store.wait()
+            except Exception as e:
+                try:
+                    os.write(
+                        2,
+                        f"[edl] graceful-leave flush failed: {e}\n".encode(
+                            errors="backslashreplace"
+                        ),
+                    )
+                except Exception:
+                    pass
             _deregister_all()
         finally:
             os._exit(0)

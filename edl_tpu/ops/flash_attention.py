@@ -78,6 +78,19 @@ def _pick_block(t: int, want: int) -> int:
     return b
 
 
+def _default_bwd_block(fwd_block: int, tk: int) -> int:
+    """Backward tile default: the forward's tile up to T=2048, shrunk
+    to 256 beyond.  The merged backward keeps K, V (bf16) AND the
+    dK/dV f32 scratch resident per bh — ~12 bytes/key-position/lane —
+    so its VMEM footprint grows with T while the tiles add their own
+    double-buffered share; measured on v5e (16MB scoped VMEM): 512
+    tiles fit at T=2048 (fastest), overflow by 256KB at T=4096 where
+    256 tiles run at 0.36 MFU, and NO tile size fits at T=8192 —
+    single-chip sequences beyond ~4k are what the sp axis (ring
+    attention) is for."""
+    return fwd_block if tk <= 2048 else min(fwd_block, 256)
+
+
 def _safe(m):
     """Replace NEG_INF row-maxima with 0 so fully-masked rows produce
     p == exp(NEG_INF - 0) == 0 instead of exp(0) == 1."""
@@ -526,8 +539,12 @@ def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
         raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
     block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
     block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
-    bwd_block_q = _pick_block(tq, bwd_block_q or block_q)
-    bwd_block_k = _pick_block(tk, bwd_block_k or block_k)
+    bwd_block_q = _pick_block(
+        tq, bwd_block_q or _default_bwd_block(block_q, tk)
+    )
+    bwd_block_k = _pick_block(
+        tk, bwd_block_k or _default_bwd_block(block_k, tk)
+    )
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
     return (mask, causal, scale, block_q, block_k, bwd_block_q,
             bwd_block_k, interpret)
@@ -552,9 +569,10 @@ def flash_attention(
     batches.  ``bwd_block_q``/``bwd_block_k`` tile the backward
     independently (it carries dK/dV scratch, so its VMEM ceiling —
     and sweet spot — differ from the forward's); they default to the
-    forward tiles.  ``interpret=None`` auto-selects: real kernel on
-    TPU, Pallas interpreter elsewhere (tests on the CPU mesh take this
-    path)."""
+    forward tiles up to T=2048 and shrink to 256 beyond (the measured
+    v5e VMEM ceiling — see ``_default_bwd_block``).  ``interpret=None``
+    auto-selects: real kernel on TPU, Pallas interpreter elsewhere
+    (tests on the CPU mesh take this path)."""
     return _flash(
         q, k, v,
         *_prep(q, k, causal, scale, kv_mask, block_q, block_k,

@@ -180,3 +180,13 @@ def test_flash_attention_with_lse_kv_mask_gradients():
     gr = jax.grad(loss(oracle), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
+
+
+def test_bwd_block_default_shrinks_with_context():
+    """VMEM-aware backward tiles: the forward's 512 default up to
+    T=2048, 256 beyond (measured v5e ceiling — see _default_bwd_block)."""
+    from edl_tpu.ops.flash_attention import _default_bwd_block
+
+    assert _default_bwd_block(512, 2048) == 512
+    assert _default_bwd_block(512, 4096) == 256
+    assert _default_bwd_block(128, 4096) == 128  # explicit small stays

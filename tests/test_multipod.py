@@ -185,11 +185,15 @@ def test_multipod_elastic_1_2_1(tmp_path):
         assert "w2" in coord.members()
         w2.send_signal(signal.SIGTERM)
         w2.wait(timeout=60)
-        _wait_for(lambda: "w2" not in coord.members(), 10, "w2 deregistered")
+        _wait_for(
+            lambda: "w2" not in coord.members(), 30, "w2 deregistered", procs
+        )
         assert "w1" in coord.members()
         w1.send_signal(signal.SIGTERM)
         w1.wait(timeout=60)
-        _wait_for(lambda: "w1" not in coord.members(), 10, "w1 deregistered")
+        _wait_for(
+            lambda: "w1" not in coord.members(), 30, "w1 deregistered", procs
+        )
 
         # -- history checks -------------------------------------------------
         h1 = _read_history(hist["w1"])
@@ -311,8 +315,9 @@ def test_multipod_multichip_pods_1_2_1(tmp_path):
             proc.wait(timeout=60)
             _wait_for(
                 lambda n=name: n not in coord.members(),
-                10,
+                30,
                 f"{name} deregistered",
+                procs,
             )
 
         h1 = _read_history(hist["m1"])

@@ -579,8 +579,16 @@ def run(
         try:
             try:
                 et.stop_heartbeat()
-            except Exception:
-                pass
+            except Exception as e:
+                try:
+                    os.write(
+                        2,
+                        f"[edl] stop_heartbeat failed: {e}\n".encode(
+                            errors="backslashreplace"
+                        ),
+                    )
+                except Exception:
+                    pass
             try:
                 if et.state is not None and jax.process_count() == 1:
                     et.store.save_async(et.state, generation=et.generation)

@@ -13,5 +13,6 @@ import edl_tpu.models.resnet  # noqa: F401
 import edl_tpu.models.transformer  # noqa: F401
 import edl_tpu.models.transformer_lm  # noqa: F401
 import edl_tpu.models.moe  # noqa: F401
+import edl_tpu.models.pipeline_lm  # noqa: F401
 
 __all__ = ["ModelDef", "get_model", "register_model", "registered_models"]

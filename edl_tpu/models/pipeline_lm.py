@@ -1,0 +1,185 @@
+"""Pipeline-parallel LM — the ``pp`` model family.
+
+A decoder LM whose block stack runs through the GPipe microbatch
+schedule (``parallel/pipeline.pipeline_apply``): block parameters carry
+a leading STAGE dimension sharded over the mesh's ``pp`` axis, each pp
+rank owns ``layers/S`` blocks, and activations hop stages via
+ppermute.  Embedding, final norm, and the vocab loss run outside the
+pipeline (replicated over ``pp``, sharded over ``dp`` on the batch).
+
+Stacked-parameter trick: ONE ``LMBlock`` is initialized per layer with
+its own rng, and the per-layer trees are stacked leaf-wise to
+``[layers, ...]`` then reshaped ``[S, layers/S, ...]`` — so
+``stage_fn`` is just "apply my ``layers/S`` blocks in order with
+tree-indexed params".  No bespoke pipelined module code: the SAME
+``LMBlock`` used by ``transformer_lm`` flows through the pipeline
+(SURVEY.md §2.3: pipeline parallelism is absent from the reference;
+this family exceeds the parity bar).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import flax.linen as nn
+
+from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.models.transformer_lm import LMBlock, lm_flops, lm_synth_batch
+from edl_tpu.parallel.pipeline import pipeline_apply
+
+
+@register_model("pipeline_lm")
+def pipeline_lm(
+    tiny: bool = False,
+    seq_len: Optional[int] = None,
+    pp_mesh: Optional[Mesh] = None,
+    num_stages: Optional[int] = None,
+    num_microbatches: int = 4,
+) -> ModelDef:
+    """``pp_mesh``: mesh carrying the ``pp`` axis (stage count defaults
+    to its size; without a mesh the stages run sequentially — same
+    code path, so CPU tests and the one-chip TPU run the identical
+    model)."""
+    if tiny:
+        vocab, d_model, d_ff, heads, layers = 256, 64, 256, 4, 4
+        L = seq_len or 64
+    else:
+        vocab, d_model, d_ff, heads, layers = 32000, 768, 3072, 12, 12
+        L = seq_len or 2048
+
+    if num_stages is None:
+        sizes = (
+            dict(zip(pp_mesh.axis_names, pp_mesh.devices.shape))
+            if pp_mesh is not None
+            else {}
+        )
+        num_stages = sizes.get("pp", 1) or 1
+    if layers % num_stages != 0:
+        raise ValueError(
+            f"{layers} layers do not split into {num_stages} stages"
+        )
+    per_stage = layers // num_stages
+
+    block = LMBlock(num_heads=heads, d_model=d_model, d_ff=d_ff)
+
+    class _Outer(nn.Module):
+        """Embedding + final norm (everything OUTSIDE the pipeline)."""
+
+        @nn.compact
+        def __call__(self, tokens):
+            embed = nn.Embed(
+                vocab,
+                d_model,
+                embedding_init=nn.initializers.normal(1.0),
+                name="embed",
+            )
+            pos = self.param(
+                "pos_embed", nn.initializers.normal(0.02), (L, d_model)
+            )
+            x = (embed(tokens) + pos[None, : tokens.shape[1]]).astype(
+                jnp.bfloat16
+            )
+            return x
+
+    outer = _Outer()
+    ln_f = nn.LayerNorm(dtype=jnp.float32)
+    sample_tokens = jnp.zeros((1, L), jnp.int32)
+    sample_x = jnp.zeros((1, L, d_model), jnp.bfloat16)
+
+    def init_params(rng: jax.Array):
+        r_outer, r_ln, r_blocks = jax.random.split(rng, 3)
+        params = {
+            "outer": outer.init(r_outer, sample_tokens)["params"],
+            "ln_f": ln_f.init(r_ln, sample_x)["params"],
+        }
+        layer_rngs = jax.random.split(r_blocks, layers)
+        per_layer = [
+            block.init(layer_rngs[i], sample_x)["params"]
+            for i in range(layers)
+        ]
+        stacked = jax.tree.map(lambda *ls: jnp.stack(ls), *per_layer)
+        # [layers, ...] -> [S, layers/S, ...]
+        params["blocks"] = jax.tree.map(
+            lambda p: p.reshape(num_stages, per_stage, *p.shape[1:]),
+            stacked,
+        )
+        return params
+
+    def stage_fn(stage_params, h):
+        """Apply this stage's ``per_stage`` blocks in order."""
+        for i in range(per_stage):
+            layer_p = jax.tree.map(lambda p: p[i], stage_params)
+            h = block.apply({"params": layer_p}, h)
+        return h
+
+    def features(params, tokens):
+        x = outer.apply({"params": params["outer"]}, tokens)
+        if pp_mesh is not None and "pp" in pp_mesh.axis_names:
+            b, t, d = x.shape
+            flat = pipeline_apply(
+                lambda p, h: stage_fn(
+                    p, h.reshape(-1, t, d)
+                ).reshape(h.shape),
+                params["blocks"],
+                x.reshape(b, t * d),
+                pp_mesh,
+                num_microbatches=min(num_microbatches, b),
+            )
+            x = flat.reshape(b, t, d)
+        else:
+            for s in range(num_stages):
+                x = stage_fn(
+                    jax.tree.map(lambda p: p[s], params["blocks"]), x
+                )
+        return ln_f.apply({"params": params["ln_f"]}, x)
+
+    def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        from edl_tpu.ops.losses import best_vocab_xent
+
+        tokens = batch["tokens"]
+        labels = tokens[:, 1:]
+        x = features(params, tokens[:, :-1])
+        loss, _ = best_vocab_xent(
+            x,
+            params["outer"]["embed"]["embedding"],
+            labels,
+            labels != 0,
+        )
+        return loss, {"loss": loss}
+
+    synth_batch = lm_synth_batch(vocab, L)
+
+    def param_partition(params) -> Any:
+        """Stage dim over ``pp``; everything else replicated (tp/fsdp
+        within a stage composes later — the pipeline is the axis this
+        family exists to exercise)."""
+
+        def spec_for(path, x):
+            if path and path[0] == "blocks" and x.ndim >= 1:
+                return P("pp")
+            return P()
+
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        treedef = jax.tree_util.tree_structure(params)
+        leaves = [
+            spec_for(
+                [str(getattr(k, "key", k)) for k in path], leaf
+            )
+            for path, leaf in flat
+        ]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    flops = lm_flops(vocab, d_model, d_ff, layers, L)
+    return ModelDef(
+        name="pipeline_lm",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+        param_partition=param_partition,
+        flops_per_example=flops,
+        tokens_per_example=L,
+    )

@@ -151,6 +151,32 @@ def _partition_rules(params) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def lm_synth_batch(vocab: int, L: int):
+    """Deterministic periodic token stream (period via offset) —
+    learnable with context; shared by every LM family so their
+    synthetic corpora (and bench numbers) stay comparable."""
+
+    def synth_batch(rng: np.random.RandomState, n: int):
+        start = rng.randint(3, vocab - 8, size=(n, 1))
+        t = np.arange(L + 1)[None, :]
+        tokens = 3 + ((start - 3) + t) % (vocab - 3)
+        return {"tokens": tokens.astype(np.int32)}
+
+    return synth_batch
+
+
+def lm_flops(vocab: int, d_model: int, d_ff: int, layers: int, L: int) -> int:
+    """True executed matmul FLOPs per example for a decoder LM
+    (fwd+bwd): per-token layer matmuls + causal attention score/PV
+    terms (causal halves the T^2 work) + the tied vocab projection.
+    Shared by every LM family so MFU accounting can't diverge."""
+    params_per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
+    return (
+        6 * (layers * params_per_layer + vocab * d_model) * L
+        + 12 * layers * L * L * d_model // 2
+    )
+
+
 @register_model("transformer_lm")
 def transformer_lm(
     tiny: bool = False,
@@ -190,21 +216,8 @@ def transformer_lm(
         )
         return loss, {"loss": loss}
 
-    def synth_batch(rng: np.random.RandomState, n: int):
-        """Periodic token stream (period 7) — learnable with context."""
-        start = rng.randint(3, vocab - 8, size=(n, 1))
-        t = np.arange(L + 1)[None, :]
-        tokens = 3 + ((start - 3) + t) % (vocab - 3)
-        return {"tokens": tokens.astype(np.int32)}
-
-    # True executed matmul FLOPs per example (see models/transformer.py):
-    # per-token layer matmuls + causal attention score/PV terms
-    # (causal halves the T^2 work) + the tied vocab projection.
-    params_per_layer = 4 * d_model * d_model + 2 * d_model * d_ff
-    flops = (
-        6 * (layers * params_per_layer + vocab * d_model) * L
-        + 12 * layers * L * L * d_model // 2
-    )
+    synth_batch = lm_synth_batch(vocab, L)
+    flops = lm_flops(vocab, d_model, d_ff, layers, L)
     return ModelDef(
         name="transformer_lm",
         init_params=init_params,

@@ -14,9 +14,11 @@ matmuls and the next block's ppermute are independent in XLA's
 schedule.  Each ring step's LOCAL attention is the Pallas flash kernel
 (``ops/flash_attention``), composed through its differentiable lse
 output: scores never materialize in HBM on either level, and causal
-runs skip entirely-future blocks at ring granularity (each device
-computes rank+1 of n block pairs; a zigzag/striped layout that
-rebalances the skip savings across ranks is a known extension).
+runs skip entirely-future blocks at ring granularity.  Causal rings
+default to the ZIGZAG layout (device r holds sequence stripes r and
+2n-1-r) so the skip savings balance exactly across ranks — on the
+plain contiguous layout rank n-1 does n times rank 0's work and gates
+every ppermute (see ``_zigzag_ring``).
 
 The reference system has nothing like this (SURVEY.md §5.7: 2018-era,
 pre-dates sequence parallelism entirely); it is required for the
@@ -69,6 +71,130 @@ def _merge_norm(o1, lse1, o2, lse2):
     return o, m + jnp.log(denom)
 
 
+def _batch_spec(mesh: Mesh, batch_size: int):
+    """Batch-dim sharding over whichever data axes divide it.  Axes
+    that don't divide the (static) batch size are dropped — e.g.
+    ``module.init`` traces with batch 1.  Heads/head_dim stay
+    replicated (tp composes by sharding H outside this op)."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    data_axes: list = []
+    prod = 1
+    for a in ("dp", "fsdp"):
+        if a in sizes and batch_size % (prod * sizes[a]) == 0:
+            data_axes.append(a)
+            prod *= sizes[a]
+    return (
+        tuple(data_axes)
+        if len(data_axes) > 1
+        else (data_axes[0] if data_axes else None)
+    )
+
+
+def _shard_mapped(local_fn, mesh, spec, n_in=3):
+    kwargs = dict(
+        mesh=mesh, in_specs=(spec,) * n_in, out_specs=spec
+    )
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        return shard_map(local_fn, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        return shard_map(local_fn, check_rep=False, **kwargs)
+
+
+def _zigzag_ring(q, k, v, mesh, axis, n, scale):
+    """Causal ring attention on the ZIGZAG layout: device r holds
+    sequence stripes ``r`` and ``2n-1-r`` (width T/2n each), so every
+    rank's causal work is identical — per ring step each rank attends
+    exactly 2 of the 4 (q-stripe, k-stripe) pairs:
+
+    - (qa, ka'): a' = src — full when src < r, skipped when src > r
+    - (qa, kb'): b' = 2n-1-src >= n > a = r — always future, skipped
+    - (qb, ka'): a' <= n-1 < b = 2n-1-r — always past, attended
+    - (qb, kb'): b' < b iff src > r — full when src > r, else skipped
+
+    (step 0, src == r, runs the two stripe diagonals causally plus the
+    always-past cross pair).  The permutation into/out of the zigzag
+    order is a global take on the sequence dim; XLA lowers it to the
+    shard exchange once per call — O(T) traffic vs the ring's O(n*T)."""
+    T = q.shape[1]
+    s = T // (2 * n)  # stripe width; local shard = 2 stripes
+    idx = []
+    for r in range(n):
+        idx.extend(range(r * s, (r + 1) * s))
+        br = 2 * n - 1 - r
+        idx.extend(range(br * s, (br + 1) * s))
+    zig = jnp.asarray(idx, jnp.int32)  # new position -> old index
+    inv = jnp.argsort(zig)  # old position -> new index
+
+    qz = jnp.take(q, zig, axis=1)
+    kz = jnp.take(k, zig, axis=1)
+    vz = jnp.take(v, zig, axis=1)
+    spec = P(_batch_spec(mesh, q.shape[0]), axis, None, None)
+
+    def local_fn(q_blk, k_blk, v_blk):
+        rank = lax.axis_index(axis)
+        qa, qb = q_blk[:, :s], q_blk[:, s:]
+
+        def halves(x):
+            return x[:, :s], x[:, s:]
+
+        ka, kb = halves(k_blk)
+        va, vb = halves(v_blk)
+
+        # step 0 (src == rank): both stripe diagonals causal, plus the
+        # always-past (qb, ka) cross pair.
+        oa, lsea = _local_attn(qa, ka, va, scale, causal=True)
+        ob, lseb = _merge_norm(
+            *_local_attn(qb, ka, va, scale, causal=False),
+            *_local_attn(qb, kb, vb, scale, causal=True),
+        )
+
+        if n > 1:
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            def body(t, carry):
+                oa, lsea, ob, lseb, kc, vc = carry
+                kc = lax.ppermute(kc, axis, perm)
+                vc = lax.ppermute(vc, axis, perm)
+                src = (rank - t - 1) % n
+                ka_, kb_ = halves(kc)
+                va_, vb_ = halves(vc)
+                # qb vs the visitor's a-stripe: always past.
+                ob, lseb = _merge_norm(
+                    ob, lseb,
+                    *_local_attn(qb, ka_, va_, scale, causal=False),
+                )
+                # Exactly one of (qa, ka') / (qb, kb') is visible
+                # (balanced work — the zigzag point); both branches
+                # share shapes so one cond covers them.
+                o_x, lse_x = lax.cond(
+                    src < rank,
+                    lambda ops: _local_attn(
+                        qa, ops[0], ops[2], scale, causal=False
+                    ),
+                    lambda ops: _local_attn(
+                        qb, ops[1], ops[3], scale, causal=False
+                    ),
+                    (ka_, kb_, va_, vb_),
+                )
+                na, nlsea = _merge_norm(oa, lsea, o_x, lse_x)
+                nb, nlseb = _merge_norm(ob, lseb, o_x, lse_x)
+                sel = src < rank
+                oa = jnp.where(sel, na, oa)
+                lsea = jnp.where(sel, nlsea, lsea)
+                ob = jnp.where(sel, ob, nb)
+                lseb = jnp.where(sel, lseb, nlseb)
+                return (oa, lsea, ob, lseb, kc, vc)
+
+            oa, lsea, ob, lseb, _, _ = lax.fori_loop(
+                0, n - 1, body, (oa, lsea, ob, lseb, k_blk, v_blk)
+            )
+
+        return jnp.concatenate([oa, ob], axis=1).astype(q_blk.dtype)
+
+    out = _shard_mapped(local_fn, mesh, spec)(qz, kz, vz)
+    return jnp.take(out, inv, axis=1)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -77,6 +203,7 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = False,
     scale: Optional[float] = None,
+    zigzag: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention with sequence sharded over ``mesh`` axis ``axis``.
 
@@ -84,30 +211,31 @@ def ring_attention(
     Returns [B, T, H, D], same sharding.  ``causal`` applies a global
     causal mask (each device resolves its shard's absolute positions
     from its ring rank).
+
+    ``zigzag`` (causal only; default auto): on a plain contiguous
+    layout the causal skip is rank-IMBALANCED — rank r computes r+1 of
+    n block pairs, so the slowest rank gates every ppermute and the
+    skip saves no wall-clock.  The zigzag layout gives device r
+    stripes ``r`` and ``2n-1-r`` of the sequence, making every rank's
+    visible work identical (each ring step attends exactly 2 of 4
+    stripe pairs).  Auto-enabled for causal rings when the local shard
+    splits into two stripes; ``zigzag=False`` forces the plain layout.
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if axis not in mesh.axis_names:
         return reference_attention(q, k, v, causal=causal, scale=scale)
     n = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    # The zigzag layout needs T to split into 2n equal stripes; gate on
+    # exact divisibility (floor-division parity would admit T=20, n=8
+    # and silently TRUNCATE the output to 16 positions).
+    splits = q.shape[1] % (2 * n) == 0 if n > 0 else False
+    if zigzag is None:
+        zigzag = causal and n > 1 and splits
+    if zigzag and causal and n > 1 and splits:
+        return _zigzag_ring(q, k, v, mesh, axis, n, scale)
 
-    # Batch stays sharded over the data axes present; sequence over the
-    # ring axis.  Heads/head_dim replicated (tp composes by sharding H
-    # outside this op).  Axes that don't divide the (static) batch size
-    # are dropped — e.g. module.init traces with batch 1.
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    data_axes: list = []
-    prod = 1
-    for a in ("dp", "fsdp"):
-        if a in sizes and q.shape[0] % (prod * sizes[a]) == 0:
-            data_axes.append(a)
-            prod *= sizes[a]
-    bspec = (
-        tuple(data_axes)
-        if len(data_axes) > 1
-        else (data_axes[0] if data_axes else None)
-    )
-    spec = P(bspec, axis, None, None)
+    spec = P(_batch_spec(mesh, q.shape[0]), axis, None, None)
 
     def local_fn(q_blk, k_blk, v_blk):
         rank = lax.axis_index(axis)
@@ -157,12 +285,7 @@ def ring_attention(
 
         return o.astype(q_blk.dtype)
 
-    kwargs = dict(mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    try:  # jax >= 0.8 renamed check_rep -> check_vma
-        fn = shard_map(local_fn, check_vma=False, **kwargs)
-    except TypeError:  # pragma: no cover - older jax
-        fn = shard_map(local_fn, check_rep=False, **kwargs)
-    return fn(q, k, v)
+    return _shard_mapped(local_fn, mesh, spec)(q, k, v)
 
 
 def reference_attention(

@@ -107,3 +107,72 @@ def test_transformer_lm_trains_with_sequence_parallelism():
         last = float(metrics["loss"])
     assert np.isfinite(last)
     assert last < first - 0.3, f"no learning under sp: {first} -> {last}"
+
+
+# ---- zigzag layout (balanced causal rings) ----------------------------------
+
+
+def test_zigzag_and_plain_layouts_both_match(sp_mesh):
+    """Causal rings default to the zigzag layout (balanced per-rank
+    work); both layouts must be exact vs the oracle."""
+    q, k, v = qkv(3)
+    want = reference_attention(q, k, v, causal=True)
+    for zz in (True, False):
+        got = ring_attention(
+            q, k, v, sp_mesh, axis="sp", causal=True, zigzag=zz
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5,
+            err_msg=f"zigzag={zz}",
+        )
+
+
+def test_plain_layout_gradients_match(sp_mesh):
+    """zigzag=False keeps the plain path's gradients covered (the
+    default causal tests now route through zigzag)."""
+    q, k, v = qkv(4, T=32)
+
+    def loss_plain(q, k, v):
+        return jnp.sum(
+            ring_attention(
+                q, k, v, sp_mesh, causal=True, zigzag=False
+            )
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_p = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5
+        )
+
+
+def test_zigzag_falls_back_on_odd_local_shard(sp_mesh):
+    """T/n odd: the shard can't split into two stripes; auto-zigzag
+    declines and the plain ring still matches the oracle."""
+    q, k, v = qkv(5, T=24)  # t_local = 3 on sp=8
+    want = reference_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, sp_mesh, axis="sp", causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_zigzag_gate_requires_exact_stripe_divisibility():
+    """T=20 on sp=4: t_local=5 is odd AND 20 % 8 != 0 — but T=40 on
+    sp=4 with t_local=10: 40 % 8 == 0 takes zigzag, while a T whose
+    floor-division LOOKS even but doesn't split into 2n stripes (T=20,
+    sp=8 -> t_local=2, 20 % 16 != 0) must fall back to the plain ring
+    with FULL-LENGTH output, never a truncated one."""
+    mesh = build_mesh(MeshSpec.create(sp=4))
+    q, k, v = qkv(6, T=20)
+    want = reference_attention(q, k, v, causal=True)
+    got = ring_attention(q, k, v, mesh, axis="sp", causal=True)
+    assert got.shape == q.shape
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )

@@ -13,8 +13,11 @@ Schedule: plain GPipe.  ``M`` microbatches over ``S`` stages run in
 — pick ``M >> S``.  The whole schedule is ONE ``lax.scan`` inside
 ``shard_map``, so reverse-mode AD differentiates it like any scan:
 the transpose of ``ppermute`` is the reverse hop and the backward
-schedule emerges mechanically (correctness first; a 1F1B interleave
-is a schedule swap inside the same scan, not a redesign).
+schedule emerges mechanically.  Correctness first: a 1F1B interleave
+(which shrinks peak activation memory from M microbatches to S) would
+require taking MANUAL control of the forward/backward interleaving —
+a custom_vjp over the whole schedule — rather than relying on scan
+AD; that is future work, not a parameter away.
 
 Composition: batch may additionally shard over ``dp`` (the microbatch
 dim's spec), params over ``fsdp``/``tp`` within a stage — the same

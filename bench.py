@@ -289,6 +289,11 @@ def main():
     # Long-context first: its child must own the chip alone (this
     # process has not initialized a TPU client yet).
     lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
+    lc4k = _attempt(
+        lambda: bench_longcontext_lm(seq_len=4096, batch=4, steps=4),
+        "longcontext_lm_4k",
+        retries=0,
+    )
     moe = _attempt(bench_moe_lm, "moe_lm", retries=0)
     r = _attempt(bench_resize, "resize")
     thr = _attempt(bench_transformer_throughput, "transformer_base")
@@ -304,7 +309,8 @@ def main():
                     "unit": "s",
                     "vs_baseline": None,
                     "detail": {"error": r["error"], "transformer_base": thr,
-                               "longcontext_lm": lc, "moe_lm": moe,
+                               "longcontext_lm": lc,
+                               "longcontext_lm_4k": lc4k, "moe_lm": moe,
                                "cpu_cross_size": cross},
                 }
             )
@@ -326,6 +332,7 @@ def main():
                     "budget_s": RESIZE_BUDGET_S,
                     "transformer_base": _lm_summary(thr),
                     "longcontext_lm": _lm_summary(lc),
+                    "longcontext_lm_4k": _lm_summary(lc4k),
                     "moe_lm": _lm_summary(moe),
                     "cpu_cross_size": (
                         cross

@@ -130,13 +130,19 @@ def cmd_local_run(args) -> int:
     import jax
     import optax
 
-    from edl_tpu.models.base import get_model
+    from edl_tpu.models.base import bind_model
     from edl_tpu.runtime.coordinator import LocalCoordinator
     from edl_tpu.runtime.data import ShardedDataIterator
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     job = _load_job(args.spec)
-    model = get_model(job.spec.trainer.entrypoint or "mnist")
+    layout = job.spec.trainer.parallelism.axes()
+    model_factory = bind_model(
+        job.spec.trainer.entrypoint or "mnist",
+        layout,
+        workspace=job.spec.trainer.workspace,
+    )
+    model = model_factory(None)
     n_dev = len(jax.devices())
     t = job.spec.trainer
     start_world = min(t.min_instance, n_dev)
@@ -149,26 +155,47 @@ def cmd_local_run(args) -> int:
         max(4096, gbs),
     )
     data = ShardedDataIterator(dataset, global_batch_size=gbs, seed=args.seed)
+    # Local sim runs one-device trainers: quantize on w, not on the
+    # deployed topology's w x chips.
+    legal_list = [
+        w for w in job.legal_world_sizes(chips_per_replica=1) if w <= n_dev
+    ]
+    if not legal_list:
+        print(
+            f"error: no legal world size <= {n_dev} local devices "
+            f"(layout {layout or '{}'}, global batch "
+            f"{job.spec.global_batch_size}); a layout's axis product "
+            "must divide the local world",
+            file=sys.stderr,
+        )
+        return 2
+    # Clamp the start target to a legal size (a deployed layout may be
+    # satisfiable only at topology chips, not at 1 device/trainer).
+    start_world = max(
+        [w for w in legal_list if w <= start_world] or [legal_list[0]]
+    )
     coord = LocalCoordinator(
         target_world=start_world,
         max_world=min(t.max_instance, n_dev),
-        # Local sim runs one-device trainers: quantize on w, not on the
-        # deployed topology's w x chips.
-        legal_sizes=[
-            w
-            for w in job.legal_world_sizes(chips_per_replica=1)
-            if w <= n_dev
-        ],
+        legal_sizes=legal_list,
     )
     for i in range(min(t.max_instance, n_dev)):
         coord.register(f"local-{i}")
+    store = None
+    ckpt_dir = getattr(args, "checkpoint_dir", "") or job.spec.checkpoint_dir
+    if ckpt_dir:
+        from edl_tpu.checkpoint import HostDRAMStore
+
+        store = HostDRAMStore(spill_dir=ckpt_dir)
     et = ElasticTrainer(
-        model,
+        model_factory if layout else model,
         optax.adam(1e-3),
         data,
         coord,
+        store=store,
         checkpoint_interval=job.spec.checkpoint_interval_steps,
         seed=args.seed,
+        layout=layout,
     )
 
     resizes = _parse_resizes(args.resize_at)
@@ -180,6 +207,10 @@ def cmd_local_run(args) -> int:
         coord.set_target_world(world)
         print(f"[resize] step={at_step} -> target world {world}")
     et.run(steps)
+    if store is not None and et.state is not None:
+        # Durable runs persist the FINAL state, not just the last
+        # interval/resize checkpoint.
+        et.store.save_async(et.state, generation=et.generation)
     et.store.wait()
 
     first = et.history[0] if et.history else None
@@ -342,6 +373,14 @@ def build_parser() -> argparse.ArgumentParser:
             "train from a file-backed array store (memory-mapped .npy "
             "directory, see edl_tpu.runtime.datasets) instead of "
             "synthetic data; overrides spec.dataset_dir"
+        ),
+    )
+    s.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help=(
+            "durable checkpoint directory (spill + cold-start restore); "
+            "overrides spec.checkpoint_dir"
         ),
     )
     s.set_defaults(fn=cmd_local_run)

@@ -81,6 +81,15 @@ def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
         {"name": "EDL_CHECKPOINT_INTERVAL", "value": str(job.spec.checkpoint_interval_steps)},
         {"name": "EDL_FAULT_TOLERANT", "value": "1" if job.spec.fault_tolerant else "0"},
         {"name": "EDL_DATA_DIR", "value": job.spec.dataset_dir},
+        # Durable checkpoint dir (mounted volume): host-DRAM checkpoints
+        # spill here; a cold start restores from it (whole-world loss
+        # must not restart training at step 0 — the durability the
+        # reference's etcd sidecar owned, ref pkg/jobparser.go:174-191).
+        {"name": "EDL_CHECKPOINT_DIR", "value": job.spec.checkpoint_dir},
+        # Requested mesh layout beyond elastic dp ("fsdp=2,tp=2"; empty
+        # = pure dp).  The launcher builds every generation's mesh as
+        # dp x <these axes>, dp absorbing the elastic world size.
+        {"name": "EDL_PARALLELISM", "value": t.parallelism.env_value()},
         # downward API (ref ``:302-312``)
         {
             "name": "EDL_NAMESPACE",

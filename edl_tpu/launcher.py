@@ -40,6 +40,10 @@ def env_config() -> dict:
         "checkpoint_interval": int(e.get("EDL_CHECKPOINT_INTERVAL", "100")),
         "fault_tolerant": e.get("EDL_FAULT_TOLERANT", "0") == "1",
         "data_dir": e.get("EDL_DATA_DIR", ""),
+        # durable checkpoint volume; "" = host-DRAM only
+        "checkpoint_dir": e.get("EDL_CHECKPOINT_DIR", ""),
+        # "fsdp=2,tp=2" (jobparser's EDL_PARALLELISM); "" = pure dp.
+        "parallelism": e.get("EDL_PARALLELISM", ""),
         "pod_name": e.get("EDL_POD_NAME", ""),
         # This pod's reachable host:port — seeds the per-generation JAX
         # process group.  Explicit EDL_POD_ADDRESS wins; otherwise built
@@ -354,7 +358,17 @@ def make_world_builder(
                 )
                 break
             except Exception:
-                teardown()  # drop any half-initialized state
+                # A FAILED initialize leaves the coordination agent in
+                # an error state: Shutdown() on it logs
+                # "Shutdown() was called while coordination agent is in
+                # error state" and its error-poll thread can terminate()
+                # the process from C++ (the std::bad_cast, observed when
+                # a restarted pod races a STALE dead member still in the
+                # plan — whole-world preemption recovery).  Treat the
+                # half-initialized world exactly like a broken one:
+                # graveyard its handles, never barrier.
+                mark_broken()
+                teardown()
                 if attempt == _FORMATION_ATTEMPTS - 1:
                     raise
         devices = jax.devices()
@@ -415,6 +429,8 @@ def run(
     pod_address: str = "",
     history_file: str = "",
     data_dir: str = "",
+    parallelism: str = "",
+    checkpoint_dir: str = "",
 ) -> "ElasticTrainer":
     """Build and run the elastic training loop for a registered model.
 
@@ -422,14 +438,25 @@ def run(
     import jax
     import optax
 
-    from edl_tpu.models.base import get_model
+    from edl_tpu.models.base import bind_model
+    from edl_tpu.resource.training_job import ParallelismSpec
     from edl_tpu.runtime.coord_service import HTTPCoordinator
     from edl_tpu.runtime.coordinator import LocalCoordinator
     from edl_tpu.runtime.data import ShardedDataIterator
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     cfg = env_config()
-    model = get_model(entrypoint or cfg["entrypoint"])
+    par = ParallelismSpec.from_env(parallelism or cfg["parallelism"])
+    layout = par.axes()
+    # bind_model validates layout-vs-entrypoint up front (boot-time
+    # failure, not a mid-resize one); model_factory(None) is the
+    # mesh-free instance used for dataset shapes below.  Unregistered
+    # entrypoints load from EDL_WORKSPACE/model.py (the user-code
+    # contract, ref pkg/jobparser.go:288-291).
+    model_factory = bind_model(
+        entrypoint or cfg["entrypoint"], layout, workspace=cfg["workspace"]
+    )
+    model = model_factory(None)
     gbs = global_batch_size or cfg["global_batch_size"]
     pod_address = pod_address or cfg["pod_address"]
     history_file = history_file or cfg["history_file"]
@@ -492,10 +519,23 @@ def run(
         # Local mode: in-process coordinator, one membership per device.
         max_w = max(cfg["max_instance"], n_dev)
         legal = None
-        if gbs:
+        if gbs or layout:
             # same quantization the deployed coordinator gets via
-            # --legal-sizes: only worlds dividing the global batch
-            legal = [w for w in range(1, max_w + 1) if gbs % w == 0]
+            # --legal-sizes: worlds must factor into the layout and
+            # divide the global batch (one device per local trainer)
+            from edl_tpu.resource.training_job import quantized_world_sizes
+
+            legal = quantized_world_sizes(1, max_w, 1, gbs, par)
+            if not legal:
+                # Surface the layout misconfiguration NOW: an empty
+                # legal list would pin the plan's world_size to 0 and
+                # die 300s later with a membership-sounding barrier
+                # timeout.
+                raise ValueError(
+                    f"no legal world size <= {max_w} devices: layout "
+                    f"{layout} (product {par.product()}) with global "
+                    f"batch {gbs} admits none"
+                )
         coordinator = LocalCoordinator(
             target_world=min(cfg["max_instance"], n_dev) or n_dev,
             max_world=max_w,
@@ -512,11 +552,23 @@ def run(
     )
     data = ShardedDataIterator(dataset, global_batch_size=gbs, seed=seed)
 
+    spill_dir = checkpoint_dir or cfg["checkpoint_dir"]
+    store = None
+    if spill_dir:
+        from edl_tpu.checkpoint import HostDRAMStore
+
+        # Durable checkpoints: every DRAM checkpoint also spills to the
+        # mounted volume, and ElasticTrainer's restore paths fall back
+        # to it on a cold start (whole-world loss) — see
+        # elastic._latest_or_disk.
+        store = HostDRAMStore(spill_dir=spill_dir)
+
     et = ElasticTrainer(
-        model,
+        model_factory if layout else model,
         optax.adam(1e-3),
         data,
         coordinator,
+        store=store,
         checkpoint_interval=(
             checkpoint_interval
             if checkpoint_interval is not None
@@ -524,6 +576,7 @@ def run(
         ),
         seed=seed,
         world_builder=world_builder,
+        layout=layout,
     )
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
@@ -633,6 +686,12 @@ def run(
         if steps is None:
             steps = cfg["num_passes"] * data.batches_per_epoch
         et.run(steps, on_step=on_step)
+        # Final flush: the durable dir must hold the FINISHED state,
+        # not just the last interval/resize checkpoint (every member
+        # completes the same step, so the save's collectives — if any —
+        # are dispatched in lockstep like interval saves).
+        if et.state is not None:
+            et.store.save_async(et.state, generation=et.generation)
         et.store.wait()
         # The job ran its passes to completion: tell the coordinator so
         # the controller can flip the CR to Succeed and tear the
@@ -681,6 +740,22 @@ def main(argv=None):  # pragma: no cover - process entrypoint
     p.add_argument(
         "--history-file", default="", help="append per-step JSONL records here"
     )
+    p.add_argument(
+        "--parallelism",
+        default="",
+        help=(
+            'mesh layout beyond elastic dp, e.g. "fsdp=2,tp=2" '
+            "(normally from EDL_PARALLELISM)"
+        ),
+    )
+    p.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help=(
+            "durable checkpoint directory (normally from "
+            "EDL_CHECKPOINT_DIR); cold starts restore from it"
+        ),
+    )
     args = p.parse_args(argv)
 
     if args.platform:
@@ -698,6 +773,8 @@ def main(argv=None):  # pragma: no cover - process entrypoint
         seed=args.seed,
         pod_address=args.address,
         history_file=args.history_file,
+        parallelism=args.parallelism,
+        checkpoint_dir=args.checkpoint_dir,
     )
     last = et.history[-1] if et.history else None
     print(

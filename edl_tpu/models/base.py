@@ -12,6 +12,7 @@ layer, not here).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -56,22 +57,157 @@ def register_model(name: str):
     return deco
 
 
-def get_model(name: str, **kwargs) -> ModelDef:
-    """Build a registered model by name (used by the CLI/launcher to turn
-    a TrainingJob entrypoint into a runnable model)."""
-    # Import built-ins lazily so registration happens on first lookup.
-    import edl_tpu.models  # noqa: F401
+def load_workspace_factory(workspace: str) -> Callable[..., ModelDef]:
+    """Load user training code from ``workspace``/model.py.
 
-    try:
-        factory = _REGISTRY[name]
-    except KeyError:
+    The user-code contract (the reference's whole trainer interface:
+    an opaque ``Entrypoint`` run inside ``TRAINER_PACKAGE``,
+    ``pkg/jobparser.go:288-291``): the workspace directory contains a
+    ``model.py`` exposing ``build(**kwargs) -> ModelDef``.  The
+    workspace dir is put on ``sys.path`` while executing so user code
+    may import its sibling modules."""
+    import importlib.util
+    import sys
+
+    path = os.path.join(workspace, "model.py")
+    if not os.path.isfile(path):
         raise ValueError(
-            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
-        ) from None
-    return factory(**kwargs)
+            f"workspace {workspace!r} has no model.py (the user-code "
+            "contract: model.py exposing build(**kwargs) -> ModelDef)"
+        )
+    modname = f"_edl_workspace_{abs(hash(os.path.abspath(path)))}"
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, workspace)
+    try:
+        sys.modules[modname] = mod
+        spec.loader.exec_module(mod)
+    finally:
+        try:
+            sys.path.remove(workspace)
+        except ValueError:
+            pass
+    build = getattr(mod, "build", None)
+    if not callable(build):
+        raise ValueError(
+            f"{path} defines no callable build(**kwargs) -> ModelDef"
+        )
+    return build
+
+
+def _resolve_factory(name: str, workspace: str = "") -> Callable[..., ModelDef]:
+    """Registry lookup, falling back to the workspace's ``build`` for
+    unregistered entrypoints (ref ``pkg/jobparser.go:288-291``)."""
+    import edl_tpu.models  # noqa: F401  (register built-ins)
+
+    factory = _REGISTRY.get(name)
+    if factory is not None:
+        return factory
+    if workspace:
+        return load_workspace_factory(workspace)
+    raise ValueError(
+        f"unknown model {name!r}; registered: {sorted(_REGISTRY)} "
+        "(set trainer.workspace to train user code)"
+    )
+
+
+def get_model(name: str, workspace: str = "", **kwargs) -> ModelDef:
+    """Build a model by entrypoint name (used by the CLI/launcher to
+    turn a TrainingJob entrypoint into a runnable model).  Unregistered
+    names load from ``workspace``/model.py when given."""
+    model = _resolve_factory(name, workspace)(**kwargs)
+    if not isinstance(model, ModelDef):
+        raise ValueError(
+            f"model factory for {name!r} returned {type(model).__name__}, "
+            "not a ModelDef"
+        )
+    return model
 
 
 def registered_models():
     import edl_tpu.models  # noqa: F401
 
     return sorted(_REGISTRY)
+
+
+#: Layout axis -> the factory kwarg that carries the mesh into
+#: mesh-aware model families.  tp/fsdp need no kwarg: partition rules
+#: (``ModelDef.param_partition``) cover them, and the Trainer filters
+#: rule axes to whatever the mesh actually has.
+_MESH_KWARGS = {"sp": "sp_mesh", "ep": "ep_mesh", "pp": "pp_mesh"}
+
+
+def bind_model(name: str, layout=None, workspace: str = "", **kwargs):
+    """Bind an entrypoint + parallelism layout into a mesh -> ModelDef
+    factory for the elastic runtime.
+
+    Elasticity rebuilds the device mesh every generation, and the
+    sp/ep/pp model families close over the mesh (ring attention's
+    shard_map, expert activation constraints, the pipeline schedule) —
+    so a deployed layout needs the model REBUILT per mesh, not built
+    once (the reference never faced this: its trainer spec was one flat
+    data-parallel pool, ``pkg/resource/training_job.go:128-134``).
+
+    Validates up front (fail at submit/boot, not mid-resize):
+    - the entrypoint exists and accepts the mesh kwargs the layout needs;
+    - tp/fsdp layouts require the model to declare partition rules
+      (otherwise params would replicate and the axes carry nothing).
+
+    Returns ``build(mesh=None) -> ModelDef``; ``build(None)`` gives a
+    mesh-free instance (synthetic-data probing, single-chip runs).
+    """
+    import inspect
+
+    layout = {a: int(s) for a, s in (layout or {}).items() if int(s) > 1}
+    factory = _resolve_factory(name, workspace)
+    try:
+        params = inspect.signature(factory).parameters
+        has_varkw = any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+        )
+    except (TypeError, ValueError):  # pragma: no cover - C callables
+        params, has_varkw = {}, True
+    needed = {a: _MESH_KWARGS[a] for a in layout if a in _MESH_KWARGS}
+    missing = [
+        f"{a} (kwarg {kw})"
+        for a, kw in needed.items()
+        if kw not in params and not has_varkw
+    ]
+    if missing:
+        raise ValueError(
+            f"model {name!r} does not support layout axes: "
+            f"{', '.join(missing)}"
+        )
+    def _checked(model) -> ModelDef:
+        if not isinstance(model, ModelDef):
+            raise ValueError(
+                f"model factory for {name!r} returned "
+                f"{type(model).__name__}, not a ModelDef"
+            )
+        return model
+
+    # The mesh-free instance is immutable (frozen ModelDef) and mesh-
+    # independent, so build it at most once: callers probe it for data
+    # shapes / partition presence and ElasticTrainer binds it again —
+    # without the cache a workspace user's build() would execute three
+    # times at boot.
+    mesh_free: list = []
+
+    def build(mesh=None) -> ModelDef:
+        if mesh is None:
+            if not mesh_free:
+                mesh_free.append(_checked(factory(**kwargs)))
+            return mesh_free[0]
+        kw = dict(kwargs)
+        for axis, kwarg in needed.items():
+            kw[kwarg] = mesh
+        return _checked(factory(**kw))
+
+    if any(a in layout for a in ("tp", "fsdp")):
+        if build(None).param_partition is None:
+            raise ValueError(
+                f"model {name!r} declares no partition rules; a "
+                "tp/fsdp layout would shard nothing"
+            )
+
+    return build

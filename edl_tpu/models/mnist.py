@@ -44,6 +44,35 @@ class ConvNet(nn.Module):
         return x
 
 
+def _partition_rules(params):
+    """Megatron-style rules for the MLP head (the parameter mass):
+    Dense_0 column-parallel over tp + row-sharded over fsdp, Dense_1
+    row-parallel.  Conv kernels shard output channels over tp.  Axes
+    absent from the mesh are filtered by the Trainer, so one rule set
+    serves every layout — this is what makes ``mnist`` usable as the
+    cheap dp x fsdp / dp x tp deployable-layout model in tests."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(path: str, x) -> P:
+        if "Dense_0/kernel" in path:  # [3136, 256]
+            return P("fsdp", "tp")
+        if "Dense_0/bias" in path:  # [256]
+            return P("tp")
+        if "Dense_1/kernel" in path:  # [256, 10]
+            return P("tp", None)
+        if "Conv" in path and x.ndim == 4:  # [5,5,in,out]
+            return P(None, None, None, "tp")
+        return P()
+
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    leaves = [
+        spec_for("/".join(str(getattr(k, "key", k)) for k in path), leaf)
+        for path, leaf in flat
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 @register_model("mnist")
 def mnist() -> ModelDef:
     module = ConvNet()
@@ -82,5 +111,6 @@ def mnist() -> ModelDef:
         init_params=init_params,
         loss_fn=loss_fn,
         synth_batch=synth_batch,
+        param_partition=_partition_rules,
         flops_per_example=3 * flops_fwd,
     )

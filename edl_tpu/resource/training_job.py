@@ -124,6 +124,93 @@ class ResourceSpec:
         )
 
 
+#: Model-parallel mesh axes a job may request in its layout.  ``dp`` is
+#: deliberately NOT here: it is the elastic axis, always the remainder
+#: (world x chips / product of the requested axes), so the layout stays
+#: valid at every legal world size.
+LAYOUT_AXES = ("fsdp", "tp", "sp", "ep", "pp")
+#: Layout axes that carry batch rows: the global batch shards over
+#: dp x fsdp; tp/sp/ep/pp replicate the batch (they split hidden dims,
+#: sequence, experts, and stages respectively).
+BATCH_LAYOUT_AXES = ("fsdp",)
+
+
+@dataclass
+class ParallelismSpec:
+    """Requested parallelism layout: model-axis sizes for the trainer
+    mesh (the reference's trainer spec was its whole parallelism
+    interface — one flat pool of data-parallel pods,
+    ref pkg/resource/training_job.go:128-134; this spec is its TPU-first
+    generalization to dp x fsdp x tp x sp x ep x pp meshes).
+
+    All sizes default to 1 (pure elastic data parallelism — the
+    reference's one strategy).  The ``dp`` extent is never declared:
+    at world size ``w`` with ``c`` chips per replica it is
+    ``w*c / product()``, so elasticity resizes dp and leaves the model
+    axes fixed."""
+
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def axes(self) -> Dict[str, int]:
+        """The non-trivial axis sizes (size > 1) — the dict handed to
+        mesh construction and rendered into EDL_PARALLELISM."""
+        return {
+            a: int(getattr(self, a))
+            for a in LAYOUT_AXES
+            if int(getattr(self, a)) > 1
+        }
+
+    def product(self) -> int:
+        """Devices one dp slot spans: the model-axis product."""
+        out = 1
+        for a in LAYOUT_AXES:
+            out *= max(1, int(getattr(self, a)))
+        return out
+
+    def nonbatch_product(self) -> int:
+        """Product of axes that replicate the batch (tp*sp*ep*pp);
+        total devices / this = the batch extent (dp*fsdp)."""
+        out = 1
+        for a in LAYOUT_AXES:
+            if a not in BATCH_LAYOUT_AXES:
+                out *= max(1, int(getattr(self, a)))
+        return out
+
+    def trivial(self) -> bool:
+        return self.product() == 1
+
+    def env_value(self) -> str:
+        """Serialized for the EDL_PARALLELISM pod env: "fsdp=2,tp=2"."""
+        return ",".join(f"{a}={s}" for a, s in self.axes().items())
+
+    @staticmethod
+    def from_env(value: str) -> "ParallelismSpec":
+        sizes: Dict[str, int] = {}
+        for part in (value or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            axis, _, size = part.partition("=")
+            sizes[axis.strip()] = int(size)
+        return ParallelismSpec.from_dict(sizes)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> "ParallelismSpec":
+        d = d or {}
+        unknown = set(d) - set(LAYOUT_AXES)
+        if unknown:
+            raise ValidationError(
+                f"unknown parallelism axes {sorted(unknown)}; "
+                f"valid: {list(LAYOUT_AXES)} (dp is implicit — it is the "
+                "elastic remainder)"
+            )
+        return ParallelismSpec(**{a: int(s) for a, s in d.items()})
+
+
 @dataclass
 class TrainerSpec:
     """Elastic trainer group (ref TrainerSpec, pkg/resource/training_job.go:128-134).
@@ -138,6 +225,8 @@ class TrainerSpec:
     #: Per-replica TPU slice topology, e.g. "v5e-1", "v5e-4", "v5e-8".
     slice_topology: str = "v5e-4"
     resources: ResourceSpec = field(default_factory=ResourceSpec)
+    #: Requested mesh layout beyond elastic dp (fsdp/tp/sp/ep/pp).
+    parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
 
     @staticmethod
     def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainerSpec":
@@ -149,6 +238,7 @@ class TrainerSpec:
             max_instance=int(d.get("max_instance", d.get("maxInstance", 1))),
             slice_topology=d.get("slice_topology", d.get("sliceTopology", "v5e-4")),
             resources=ResourceSpec.from_dict(d.get("resources")),
+            parallelism=ParallelismSpec.from_dict(d.get("parallelism")),
         )
 
 
@@ -198,12 +288,23 @@ class TrainingJobSpec:
     #: analogous pointer opaquely in Workspace/TRAINER_PACKAGE,
     #: ref ``pkg/jobparser.go:288-291``)
     dataset_dir: str = ""
+    #: durable checkpoint directory (a mounted volume shared by the
+    #: trainer pods).  When set, every host-DRAM checkpoint also spills
+    #: here and a cold start (whole-world loss: full slice preemption,
+    #: restart-all) restores from it instead of silently re-initializing
+    #: at step 0.  The reference delegated exactly this durability to
+    #: its master+etcd sidecar (ref ``pkg/jobparser.go:174-191``;
+    #: design doc pointer ``README.md:18-21``); "" = DRAM-only.
+    checkpoint_dir: str = ""
 
     @staticmethod
     def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainingJobSpec":
         d = d or {}
         return TrainingJobSpec(
             dataset_dir=str(d.get("dataset_dir", d.get("datasetDir", "")) or ""),
+            checkpoint_dir=str(
+                d.get("checkpoint_dir", d.get("checkpointDir", "")) or ""
+            ),
             image=d.get("image", ""),
             port=int(d.get("port", 0)),
             fault_tolerant=bool(d.get("fault_tolerant", d.get("faultTolerant", False))),
@@ -377,28 +478,35 @@ class TrainingJob:
             )
         if s.global_batch_size < 0:
             raise ValidationError("global_batch_size must be >= 0")
-        if s.global_batch_size:
-            # Fixed-global-batch elasticity (SURVEY.md §7.4): the batch
-            # dim shards over the world's FULL device mesh (world x
-            # chips-per-replica — a trainer replica owns a whole slice,
-            # ref pkg/resource/training_job.go:128-134), so the runtime
-            # only resizes to world sizes whose device count divides the
-            # global batch (see legal_world_sizes()).  The endpoints
-            # must themselves be legal or the job could neither start
-            # at min nor reach max.
-            chips = max(1, topo_chips)
-            if s.global_batch_size % (t.min_instance * chips) != 0:
+        par = t.parallelism
+        for a in LAYOUT_AXES:
+            if int(getattr(par, a)) < 1:
                 raise ValidationError(
-                    "global_batch_size must be divisible by "
-                    f"trainer.min_instance x slice chips "
-                    f"({t.min_instance} x {chips})"
+                    f"parallelism.{a} must be >= 1, got {getattr(par, a)}"
                 )
-            if s.global_batch_size % (t.max_instance * chips) != 0:
+        chips = max(1, topo_chips)
+        # The layout and (when set) the global batch must admit BOTH
+        # instance endpoints, or the job could neither start at min nor
+        # reach max.  At world w the mesh spans w x chips devices, the
+        # model axes claim par.product() of them per dp slot, and the
+        # batch shards over the dp x fsdp extent (SURVEY.md §7.4:
+        # fixed-global-batch elasticity).
+        for w, which in ((t.min_instance, "min"), (t.max_instance, "max")):
+            total = w * chips
+            if total % par.product() != 0:
                 raise ValidationError(
-                    "global_batch_size must be divisible by "
-                    f"trainer.max_instance x slice chips "
-                    f"({t.max_instance} x {chips})"
+                    f"parallelism layout {par.axes()} (product "
+                    f"{par.product()}) must divide trainer.{which}_instance "
+                    f"x slice chips ({w} x {chips} = {total})"
                 )
+            if s.global_batch_size:
+                extent = total // par.nonbatch_product()
+                if s.global_batch_size % extent != 0:
+                    raise ValidationError(
+                        "global_batch_size must be divisible by the batch "
+                        f"extent at trainer.{which}_instance "
+                        f"(dp x fsdp = {extent} of {total} devices)"
+                    )
         return self
 
     def legal_world_sizes(
@@ -406,10 +514,10 @@ class TrainingJob:
     ) -> List[int]:
         """World sizes the elastic runtime may resize to: every w in
         [min_instance, max_instance] whose full device mesh
-        (w x chips-per-replica) divides the global batch — the batch
-        dim shards over every chip of every replica, not one row per
-        pod.  With no global_batch_size set, every size in range is
-        legal.
+        (w x chips-per-replica) factors into the requested parallelism
+        layout AND whose batch extent (dp x fsdp) divides the global
+        batch.  With no global_batch_size set, only the layout
+        divisibility applies.
 
         ``chips_per_replica`` defaults to the spec's slice topology;
         pass 1 when the runtime simulates one-device trainers (the CLI
@@ -418,14 +526,15 @@ class TrainingJob:
         from edl_tpu.cluster.tpu_topology import topology_chips
 
         t = self.spec.trainer
-        sizes = range(t.min_instance, t.max_instance + 1)
-        gbs = self.spec.global_batch_size
-        if not gbs:
-            return list(sizes)
         if chips_per_replica is None:
             chips_per_replica = topology_chips(t.slice_topology)
-        chips = max(1, chips_per_replica)
-        return [w for w in sizes if gbs % (w * chips) == 0]
+        return quantized_world_sizes(
+            t.min_instance,
+            t.max_instance,
+            chips_per_replica,
+            self.spec.global_batch_size,
+            t.parallelism,
+        )
 
     # -- (de)serialization --------------------------------------------------
     def to_manifest(self) -> Dict[str, Any]:
@@ -493,6 +602,37 @@ class TrainingJob:
         ``copy.deepcopy`` in Python; kept as a named method so call
         sites document intent."""
         return copy.deepcopy(self)
+
+
+def quantized_world_sizes(
+    min_w: int,
+    max_w: int,
+    chips_per_replica: int,
+    global_batch_size: int,
+    parallelism: Optional[ParallelismSpec] = None,
+) -> List[int]:
+    """World sizes in [min_w, max_w] the elastic runtime may form.
+
+    A size ``w`` is legal when its full device mesh (w x chips) factors
+    into the parallelism layout (dp = total / product must be whole)
+    and, when a global batch is set, the batch extent (dp x fsdp =
+    total / nonbatch product) divides it.  Shared by
+    ``TrainingJob.legal_world_sizes`` (deployed path: the coordinator's
+    ``--legal-sizes``) and the launcher/CLI local modes, so every mode
+    quantizes identically."""
+    par = parallelism or ParallelismSpec()
+    chips = max(1, chips_per_replica)
+    out = []
+    for w in range(min_w, max_w + 1):
+        total = w * chips
+        if total % par.product() != 0:
+            continue
+        if global_batch_size:
+            extent = total // par.nonbatch_product()
+            if global_batch_size % extent != 0:
+                continue
+        out.append(w)
+    return out
 
 
 def crd_manifest() -> Dict[str, Any]:

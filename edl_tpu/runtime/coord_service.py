@@ -35,6 +35,7 @@ def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
         "members": list(plan.members),
         "restore_step": plan.restore_step,
         "addresses": list(plan.addresses),
+        "alive": list(plan.alive),
     }
 
 
@@ -47,6 +48,7 @@ def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
         members=tuple(d["members"]),
         restore_step=d.get("restore_step", -1),
         addresses=tuple(d.get("addresses", ())),
+        alive=tuple(d.get("alive", ())),
     )
 
 

@@ -51,6 +51,12 @@ class ElasticPlan:
     #: rank 0's address seeds ``jax.distributed.initialize`` when the
     #: world spans processes (the launcher's world_builder)
     addresses: tuple = ()
+    #: EVERY registered live member (active + standby) at plan time.
+    #: The resize flush reads this to decide whether a collective flush
+    #: is safe: model-sharded state can only be gathered if every
+    #: old-world member is still alive to dispatch the collective
+    #: (an evicted/dead one never would — the flush would hang).
+    alive: tuple = ()
 
 
 @dataclass
@@ -372,6 +378,7 @@ class LocalCoordinator:
             members=active,
             restore_step=self._latest_checkpoint_step,
             addresses=addresses,
+            alive=tuple(self._members),
         )
         self._resize_log.append(
             {

@@ -40,10 +40,15 @@ import optax
 from edl_tpu.checkpoint import HostDRAMStore
 from edl_tpu.checkpoint.hostdram import HostCheckpoint
 from edl_tpu.models.base import ModelDef
-from edl_tpu.parallel.mesh import dp_mesh
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.coordinator import ElasticPlan, LocalCoordinator
 from edl_tpu.runtime.data import ShardedDataIterator
 from edl_tpu.runtime.train import Trainer, TrainState
+
+#: Mesh axes the global batch shards over (dp x fsdp; tp/sp/ep/pp
+#: replicate the batch) — must agree with
+#: ``resource.training_job.BATCH_LAYOUT_AXES``.
+BATCH_AXES = ("dp", "fsdp")
 
 
 class FatalWorldError(RuntimeError):
@@ -98,8 +103,21 @@ class ElasticTrainer:
         checkpoint_interval: int = 50,
         seed: int = 0,
         world_builder: Optional[Callable[[Any], Sequence[jax.Device]]] = None,
+        layout: Optional[Dict[str, int]] = None,
     ):
-        """``world_builder``: optional hook invoked with each new
+        """``model``: a ModelDef, or (for deployed parallelism layouts)
+        a ``mesh -> ModelDef`` factory from ``models.base.bind_model``
+        — sp/ep/pp families close over the mesh, so each generation's
+        re-mesh must rebuild the model too.
+
+        ``layout``: model-axis sizes (fsdp/tp/sp/ep/pp) from the job's
+        ``ParallelismSpec.axes()``.  Each generation's mesh is then
+        ``dp x <layout>`` with dp absorbing the elastic world size —
+        the coordinator's legal sizes guarantee divisibility
+        (``TrainingJob.legal_world_sizes``).  None/empty = the pure-dp
+        mesh (the reference's one strategy).
+
+        ``world_builder``: optional hook invoked with each new
         ElasticPlan to (re)build the *process group* and return the
         global device list for the new generation.  Single-process runs
         leave it None (devices never change).  The deployed multi-pod
@@ -112,7 +130,18 @@ class ElasticTrainer:
         and a plan that does not include any of this process's
         ``heartbeat_ids`` puts it in *standby*: world torn down, polling
         until a future plan readmits it."""
-        self.model = model
+        if isinstance(model, ModelDef):
+            self.model = model
+            self._model_factory = None
+        else:
+            # mesh -> ModelDef factory (deployed layouts); bind a
+            # mesh-free instance now so pre-mesh consumers
+            # (synth data shape, param_partition presence) work.
+            self._model_factory = model
+            self.model = model(None)
+        self.layout = {
+            a: int(s) for a, s in (layout or {}).items() if int(s) > 1
+        }
         self.optimizer = optimizer
         self.data = data
         self.coordinator = coordinator
@@ -133,6 +162,9 @@ class ElasticTrainer:
 
         self.generation = -1
         self._standby = False
+        #: pod ids of the generation whose state we currently hold (the
+        #: collective-flush safety gate reads it, see _can_flush)
+        self._world_members: tuple = ()
         self.mesh = None
         self.state: Optional[TrainState] = None
         self._trainers: Dict[int, Trainer] = {}  # world_size -> compiled Trainer
@@ -187,12 +219,37 @@ class ElasticTrainer:
         self.profiler = StepProfiler()
 
     # -- trainer cache ------------------------------------------------------
+    def _mesh_spec(self, total_devices: int) -> MeshSpec:
+        """dp x <layout> mesh shape for a world spanning
+        ``total_devices``: the model axes are fixed by the layout, dp is
+        the elastic remainder."""
+        prod = 1
+        for s in self.layout.values():
+            prod *= s
+        if total_devices % prod != 0:
+            raise RuntimeError(
+                f"world of {total_devices} devices does not factor into "
+                f"parallelism layout {self.layout} (product {prod}); the "
+                "coordinator's legal sizes must quantize on the layout "
+                "(TrainingJob.legal_world_sizes)"
+            )
+        return MeshSpec.create(dp=total_devices // prod, **self.layout)
+
     def _trainer_for(self, world_size: int) -> Trainer:
         tr = self._trainers.get(world_size)
         if tr is None:
-            mesh = dp_mesh(world_size * self.devices_per_trainer, self.devices)
-            tr = Trainer(self.model, self.optimizer, mesh, seed=self.seed)
+            total = world_size * self.devices_per_trainer
+            mesh = build_mesh(self._mesh_spec(total), self.devices)
+            model = (
+                self._model_factory(mesh)
+                if self._model_factory is not None
+                else self.model
+            )
+            tr = Trainer(model, self.optimizer, mesh, seed=self.seed)
             self._trainers[world_size] = tr
+        # Keep self.model pointing at the ACTIVE mesh's instance (the
+        # restore paths read its param_partition / init behavior).
+        self.model = tr.model
         return tr
 
     def precompile(self, world_sizes: Sequence[int]):
@@ -201,7 +258,7 @@ class ElasticTrainer:
         for w in world_sizes:
             tr = self._trainer_for(w)
             state = tr.init_state()
-            batch = self.data.device_batch(0, tr.mesh)
+            batch = self.data.device_batch(0, tr.mesh, batch_axes=BATCH_AXES)
             tr.lower_step(state, batch)
 
     # -- fault injection (what the reference never had; SURVEY.md §5.3) -----
@@ -219,18 +276,34 @@ class ElasticTrainer:
         self.store.wait()
         self.coordinator.report_checkpoint(int(jax.device_get(self.state.step)))
 
-    def _can_flush_without_collectives(self) -> bool:
-        """A resize flush happens exactly when membership changed, so it
-        must not dispatch collectives: a departed old-world member would
-        never join them and the survivors would hang.  Replicated or
-        locally addressable leaves fetch without communication; anything
-        else (model-sharded multi-pod state) skips the flush and relies
-        on the last *interval* checkpoint + deterministic replay."""
-        return all(
+    def _can_flush(self, plan: ElasticPlan) -> bool:
+        """Whether the live state can be flushed at this resize.
+
+        Collective-free cases (always safe): every leaf is locally
+        addressable, fully replicated, or covered by its addressable
+        shards (state sharded only over intra-pod axes — the multi-chip
+        pod layouts; ``hostdram._cover_regions``).
+
+        Truly cross-pod-sharded state (e.g. fsdp spanning pods) needs an
+        allgather over the OLD world, which completes only if every
+        old-world member is alive to dispatch it — a departed member
+        would hang the survivors mid-flush.  ``plan.alive`` (all live
+        registrations, active + standby) is the gate: coordinated
+        retargets flush gracefully; an eviction-driven resize degrades
+        to the last interval checkpoint + deterministic replay."""
+        from edl_tpu.checkpoint.hostdram import _cover_regions
+
+        local = all(
             (not isinstance(l, jax.Array))
             or l.is_fully_addressable
             or l.is_fully_replicated
+            or _cover_regions(l) is not None
             for l in jax.tree_util.tree_leaves(self.state)
+        )
+        if local:
+            return True
+        return bool(self._world_members) and set(self._world_members) <= set(
+            plan.alive
         )
 
     def _my_member_ids(self, plan: ElasticPlan) -> List[str]:
@@ -281,9 +354,20 @@ class ElasticTrainer:
     def _enter_standby(self, plan: ElasticPlan) -> None:
         """This process is not in ``plan``'s world: flush what we have,
         tear down our slice of the old world, hold until readmitted."""
-        if self.state is not None and self._can_flush_without_collectives():
-            self._flush(plan.generation)
+        if self.state is not None and self._can_flush(plan):
+            try:
+                self._flush(plan.generation)
+            except Exception:
+                # Same degradation as _resize's flush guard: a peer
+                # death between plan emission and this flush poisons
+                # the collective — fall back to the last interval
+                # checkpoint + replay rather than dying on the way to
+                # standby (the pod must survive to be readmitted).
+                import traceback
+
+                traceback.print_exc()
         self.state = None
+        self._world_members = ()
         self._trainers.clear()
         self.mesh = None
         if self.world_builder is not None:
@@ -300,7 +384,7 @@ class ElasticTrainer:
         from edl_tpu.utils.profiling import annotate
 
         t0 = time.perf_counter()
-        graceful = self.state is not None and self._can_flush_without_collectives()
+        graceful = self.state is not None and self._can_flush(plan)
 
         if graceful:
             # Flush a fresh checkpoint so no steps are lost.  Must land
@@ -332,7 +416,7 @@ class ElasticTrainer:
             # is a configuration error (legal-size metadata disagreeing
             # with chips-per-trainer), not peer churn.
             try:
-                self.data.validate_mesh(trainer.mesh)
+                self.data.validate_mesh(trainer.mesh, batch_axes=BATCH_AXES)
             except ValueError as e:
                 raise RuntimeError(
                     f"resize to world {plan.world_size} "
@@ -348,7 +432,7 @@ class ElasticTrainer:
                     self._restore_multiprocess(trainer)
                 )
             else:
-                ckpt = self.store.latest()
+                ckpt = self._latest_or_disk(trainer)
                 if ckpt is None:
                     # Fresh job: initialize on the new mesh.
                     self.state = trainer.init_state()
@@ -372,6 +456,7 @@ class ElasticTrainer:
 
         self.generation = plan.generation
         self._standby = False
+        self._world_members = tuple(plan.members)
         seconds = time.perf_counter() - t0
         event = ResizeEvent(
             generation=plan.generation,
@@ -392,6 +477,41 @@ class ElasticTrainer:
             self.coordinator.ack_generation(tid, plan.generation)
         return True
 
+    def _latest_or_disk(self, trainer: Trainer) -> Optional[HostCheckpoint]:
+        """Latest DRAM checkpoint, falling back to the durable spill dir
+        on a cold start (process restarted: DRAM empty, disk warm).
+
+        This is the restore half of EDL_CHECKPOINT_DIR (VERDICT r4 #2):
+        without it a whole-world loss — full slice preemption, node-pool
+        upgrade, restart-all — silently restarts training from step 0
+        despite durable state existing.  A checkpoint that exists but
+        cannot be loaded (wrong model's leaves, truncated bytes) raises
+        loudly: re-initializing over it would destroy the very state
+        the operator mounted the volume to keep."""
+        ckpt = self.store.latest()
+        if ckpt is not None or not self.store.spill_dir:
+            return ckpt
+        # treedef template from the model's abstract init: no allocation
+        # (this runs inside the resize window).
+        template = jax.eval_shape(
+            trainer._init_fn, jax.random.key(trainer.seed)
+        )
+        try:
+            ckpt = self.store.load_from_disk(template)
+        except FileNotFoundError:
+            return None  # fresh job: nothing spilled yet
+        import sys
+
+        print(
+            f"[edl] cold start: restored step {ckpt.step} from durable "
+            f"checkpoint dir {self.store.spill_dir}",
+            file=sys.stderr,
+        )
+        # Replays are measured against the durable step, not 0 — a
+        # restarted process has no memory of its pre-crash progress.
+        self._last_completed_step = max(self._last_completed_step, ckpt.step)
+        return ckpt
+
     def _restore_multiprocess(self, trainer: Trainer):
         """Agree on one state across the (re-formed) process group.
 
@@ -411,7 +531,12 @@ class ElasticTrainer:
         Returns (state, restored_step, restore_source)."""
         from jax.experimental import multihost_utils
 
-        ckpt = self.store.latest()
+        # Disk fallback first: after a whole-world restart every member's
+        # DRAM is empty but the durable dir is warm — the loaded
+        # checkpoint then acts as this member's contribution to the
+        # agreement (identical spilled bytes everywhere -> local
+        # restore; a lone survivor's disk copy -> broadcast source).
+        ckpt = self._latest_or_disk(trainer)
         summary = np.asarray(
             [
                 1 if ckpt is not None else 0,
@@ -539,6 +664,7 @@ class ElasticTrainer:
         if mark is not None:
             mark()
         self.state = None
+        self._world_members = ()
         self._trainers.clear()
         self.mesh = None
         self._await_new_generation = True
@@ -651,7 +777,9 @@ class ElasticTrainer:
                 self.profiler.maybe_start()
                 t0 = time.perf_counter()
                 with self.profiler.step(step):
-                    batch = self.data.device_batch(step, trainer.mesh)
+                    batch = self.data.device_batch(
+                        step, trainer.mesh, batch_axes=BATCH_AXES
+                    )
                     self.state, metrics = trainer.step(self.state, batch)
                     loss = float(metrics["loss"])
                 self.profiler.maybe_stop()

@@ -220,3 +220,95 @@ def test_local_run_file_backed_matches_in_memory(spec, tmp_path, capsys):
     assert 2 in file_run["world_sizes_seen"]
     assert file_run["final_loss"] == mem_run["final_loss"]
     assert file_run["first_loss"] == mem_run["first_loss"]
+
+
+def test_local_run_trains_user_workspace_model(tmp_path, capsys):
+    """The user-code contract (VERDICT r4 #4; ref ENTRY/TRAINER_PACKAGE,
+    pkg/jobparser.go:288-291): an UNREGISTERED entrypoint loads from the
+    workspace's model.py (build(**kwargs) -> ModelDef) and trains end to
+    end through `edl local-run`, including a mid-run resize."""
+    ws = tmp_path / "userspace"
+    ws.mkdir()
+    (ws / "helper.py").write_text("SCALE = 0.5\n")
+    (ws / "model.py").write_text(
+        '''
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import helper  # sibling import: the workspace dir is on sys.path
+
+from edl_tpu.models.base import ModelDef
+
+
+def build(**kwargs):
+    def init_params(rng):
+        return {"w": jax.random.normal(rng, (4,)) * helper.SCALE}
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        loss = jnp.mean((pred - batch["y"]) ** 2)
+        return loss, {"loss": loss}
+
+    def synth_batch(rng, n):
+        x = rng.randn(n, 4).astype(np.float32)
+        return {"x": x, "y": (x @ np.arange(4.0, dtype=np.float32))}
+
+    return ModelDef(
+        name="user_linear",
+        init_params=init_params,
+        loss_fn=loss_fn,
+        synth_batch=synth_batch,
+    )
+'''
+    )
+    spec_path = tmp_path / "job.yaml"
+    spec_path.write_text(
+        f"""
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata:
+  name: user-job
+spec:
+  image: edl-tpu/trainer:latest
+  fault_tolerant: true
+  global_batch_size: 32
+  trainer:
+    entrypoint: user_linear
+    workspace: {ws}
+    min_instance: 1
+    max_instance: 2
+"""
+    )
+    rc = main(["local-run", str(spec_path), "--steps", "12", "--resize-at", "6:2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{") :])
+    assert summary["model"] == "user_linear"
+    assert summary["steps"] == 12
+    assert summary["final_loss"] < summary["first_loss"]
+    assert summary["world_sizes_seen"] == [1, 2]
+
+
+def test_pod_env_roundtrips_workspace(tmp_path):
+    """A submitted job's pod env carries the workspace for the launcher
+    (EDL_WORKSPACE -> env_config -> bind_model fallback)."""
+    from edl_tpu.controller.jobparser import pod_env
+    from edl_tpu.resource.training_job import TrainingJob
+
+    job = TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": "ws-job"},
+            "spec": {
+                "trainer": {
+                    "entrypoint": "user_linear",
+                    "workspace": "/mnt/user/code",
+                }
+            },
+        }
+    ).validate()
+    env = {e["name"]: e.get("value") for e in pod_env(job)}
+    assert env["EDL_WORKSPACE"] == "/mnt/user/code"
+    assert env["EDL_ENTRYPOINT"] == "user_linear"

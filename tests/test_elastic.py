@@ -442,3 +442,42 @@ def test_deterministic_step_failure_exhausts_cap(devices8):
     # though the replayed step 4 completed between failures.
     assert et._world_failures >= et.max_world_failures
     assert et._last_failed_step == FAIL_AT
+
+
+def test_cold_start_restores_from_durable_dir(tmp_path):
+    """Process restart with empty DRAM: the resize path must cold-load
+    the spilled checkpoint (elastic._latest_or_disk) instead of
+    re-initializing at step 0 (VERDICT r4 #2, single-process form)."""
+    from edl_tpu.checkpoint import HostDRAMStore
+
+    spill = str(tmp_path / "durable")
+
+    def world(store):
+        model = get_model("fit_a_line")
+        ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+        it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+        coord = LocalCoordinator(target_world=2, max_world=8)
+        for i in range(2):
+            coord.register(f"tr{i}")
+        return ElasticTrainer(
+            model, optax.adam(1e-2), it, coord,
+            store=store, checkpoint_interval=5,
+        )
+
+    first = world(HostDRAMStore(spill_dir=spill))
+    first.run(12)
+    first.store.wait()  # interval saves at steps 5 and 10 spilled
+
+    # "Restart": fresh trainer, fresh (empty) DRAM store, same dir.
+    second = world(HostDRAMStore(spill_dir=spill))
+    hist = second.run(15)
+    ev = second.resize_events[0]
+    assert ev.restored_step == 10, ev
+    assert ev.restore_source == "local"
+    # Only the post-checkpoint steps run; nothing replays from 0.
+    assert [r.step for r in hist] == list(range(10, 15))
+
+    # A THIRD start now sees the second run's newer spill (step 15).
+    third = world(HostDRAMStore(spill_dir=spill))
+    third.run(16)
+    assert third.resize_events[0].restored_step == 15

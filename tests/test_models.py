@@ -253,3 +253,31 @@ def test_moe_lm_learns():
         state, metrics = tr.step(state, data.device_batch(s, mesh))
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0] * 0.8, losses[::6]
+
+
+def test_workspace_fallback_errors_are_loud(tmp_path):
+    """Unregistered entrypoints without a usable workspace fail with
+    actionable messages (not a silent fall-through to step 0)."""
+    import pytest
+
+    from edl_tpu.models.base import get_model
+
+    with pytest.raises(ValueError, match="trainer.workspace"):
+        get_model("no_such_model")
+    ws = tmp_path / "empty_ws"
+    ws.mkdir()
+    with pytest.raises(ValueError, match="no model.py"):
+        get_model("no_such_model", workspace=str(ws))
+    (ws / "model.py").write_text("x = 1\n")
+    with pytest.raises(ValueError, match="build"):
+        get_model("no_such_model", workspace=str(ws))
+    (ws / "model.py").write_text("def build(**kw):\n    return 42\n")
+    # stale import cache: same path hash -> same module name; force new file
+    ws2 = tmp_path / "ws2"
+    ws2.mkdir()
+    (ws2 / "model.py").write_text("def build(**kw):\n    return 42\n")
+    with pytest.raises(ValueError, match="not a ModelDef"):
+        get_model("no_such_model", workspace=str(ws2))
+    # registered names NEVER fall through to the workspace
+    m = get_model("fit_a_line", workspace=str(ws2))
+    assert m.name == "fit_a_line"

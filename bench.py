@@ -145,7 +145,7 @@ def _timed_train_loop(model, batch_size: int, steps: int) -> dict:
     seq_len = model.tokens_per_example or max(
         (v.shape[1] for v in batches[0].values() if v.ndim >= 2), default=1
     )
-    return {
+    out = {
         "step_s": dt,
         "tokens_per_s": batch_size * seq_len / dt,
         "mfu": model.flops_per_example * batch_size / dt / peak
@@ -154,6 +154,12 @@ def _timed_train_loop(model, batch_size: int, steps: int) -> dict:
         "batch": batch_size,
         "seq_len": seq_len,
     }
+    # Model-specific quality counters ride along (e.g. the MoE family's
+    # capacity-drop rate — an MFU figure must not hide dropped compute).
+    for k, v in metrics.items():
+        if k.startswith("moe_"):
+            out[k] = round(float(v), 5)
+    return out
 
 
 def bench_transformer_throughput(steps: int = 20) -> dict:
@@ -198,15 +204,18 @@ def _longcontext_child(seq_len: int, batch: int, steps: int):
     print(json.dumps(_timed_train_loop(model, batch, steps)))
 
 
-def bench_moe_lm(batch: int = 4, steps: int = 4) -> dict:
+def bench_moe_lm(batch: int = 8, steps: int = 8, group: int = 0) -> dict:
     """Full-size MoE LM (12L x 8 experts, T=2048, grouped top-1
     routing) — the expert-parallel family's single-chip figure (MFU is
     ACTIVE FLOPs: one expert per token plus routing einsums).  Child
-    process for the same chip-isolation reason as long context."""
-    return _run_bench_child("--moe-child", str(batch), str(steps))
+    process for the same chip-isolation reason as long context.
+    ``group`` overrides the routing group width (0 = model default)."""
+    return _run_bench_child(
+        "--moe-child", str(batch), str(steps), str(group)
+    )
 
 
-def _moe_child(batch: int, steps: int):
+def _moe_child(batch: int, steps: int, group: int = 0):
     import jax
 
     if jax.default_backend() != "tpu":
@@ -214,7 +223,9 @@ def _moe_child(batch: int, steps: int):
         return
     from edl_tpu.models.base import get_model
 
-    print(json.dumps(_timed_train_loop(get_model("moe_lm"), batch, steps)))
+    kwargs = {"group_size": group} if group else {}
+    out = _timed_train_loop(get_model("moe_lm", **kwargs), batch, steps)
+    print(json.dumps(out))
 
 
 def _run_bench_child(*argv: str, env=None) -> dict:
@@ -369,7 +380,7 @@ if __name__ == "__main__":
         _longcontext_child(sl, b, st)
     elif "--moe-child" in sys.argv:
         i = sys.argv.index("--moe-child")
-        b, st = (int(x) for x in sys.argv[i + 1 : i + 3])
-        _moe_child(b, st)
+        rest = [int(x) for x in sys.argv[i + 1 :][:3]]
+        _moe_child(*rest)
     else:
         main()

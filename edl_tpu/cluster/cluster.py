@@ -230,11 +230,17 @@ class Cluster:
     def job_pod_nodes_map(self, pods=None) -> Dict[str, List[str]]:
         """job name -> its scheduled, non-terminal, non-deleting pods'
         node names, newest pod first (descending ``creationTimestamp``,
-        name as tiebreak — matching the coordinator's drop-newest
-        victim order).  ``pods``: optional shared pod snapshot so a
-        control tick costs ONE pod list for all its maps.  The
-        autoscaler threads the result into ``JobView.pod_nodes`` so a
-        dry-run shed returns capacity to the right node maps."""
+        name as tiebreak — APPROXIMATING the coordinator's drop-newest
+        victim order: k8s timestamps have 1s resolution and pod names
+        carry random suffixes, so within one creation second the order
+        can diverge from the true join order.  Harmless by design —
+        this feeds only the autoscaler's dry-run capacity simulation,
+        which self-corrects on the next tick from live pod state; the
+        authoritative victim choice is the coordinator's, ADVICE r4).
+        ``pods``: optional shared pod snapshot so a control tick costs
+        ONE pod list for all its maps.  The autoscaler threads the
+        result into ``JobView.pod_nodes`` so a dry-run shed returns
+        capacity to the right node maps."""
         out: Dict[str, List[Tuple[str, str, str]]] = {}
         for p in pods if pods is not None else self.kube.list_pods():
             if not p.job_name or p.deleting or not p.node:

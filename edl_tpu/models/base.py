@@ -46,6 +46,16 @@ class ModelDef:
     tokens_per_example: int = 0
 
 
+def divisor_at_most(n: int, want: int) -> int:
+    """Largest divisor of ``n`` that is <= ``want`` — the shared
+    quantizer for width-like knobs that must divide a token/batch count
+    (MoE routing groups, pipeline microbatch counts)."""
+    m = max(1, min(want, n))
+    while n % m != 0:
+        m -= 1
+    return m
+
+
 _REGISTRY: Dict[str, Callable[..., ModelDef]] = {}
 
 

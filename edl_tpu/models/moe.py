@@ -24,6 +24,19 @@ einsums are MXU matmuls.  The router adds the standard load-balancing
 auxiliary loss (mean fraction x mean probability per expert) so
 training actually spreads load.
 
+Roofline (measured on one v5e at batch 8, T=2048, full-step ablations):
+the family's MFU ceiling is set by the CHASSIS, not the routing — with
+all routing machinery replaced by one dense matmul of the same width
+the step only dropped from 0.161 s to 0.141 s (r4 1536-wide experts),
+so routing costs ~12% of the step while attention + the streamed vocab
+xent dominate.  Consequences baked in below: expert d_ff follows the
+Switch convention (== dense FFN width) to put more MXU mass behind the
+fixed chassis cost, the routing group is chosen by wall time (G=256),
+and a sort+``jax.lax.ragged_dot`` formulation measured SLOWER
+(0.178 s/step) than the capacity einsums on this jaxlib — re-evaluate
+before retrying it.  Capacity drops are reported per step
+(``moe_drop_rate`` in metrics/bench) so MFU cannot hide them.
+
 Partition rules: expert weights are [E, d_model, d_ff] sharded
 ``P("ep", "fsdp", "tp")``.  Pass ``ep_mesh`` to ALSO pin the expert
 buffers' activation sharding (``with_sharding_constraint`` over the
@@ -46,16 +59,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.models.base import ModelDef, divisor_at_most, register_model
 from edl_tpu.models.transformer_lm import CausalSelfAttention
 
 
-def _group_size(n: int, want: int = 512) -> int:
-    """Largest divisor of ``n`` that is <= want (routing group width)."""
-    g = min(want, n)
-    while n % g != 0:
-        g -= 1
-    return g
+#: routing group width quantizer (shared largest-divisor helper)
+_group_size = divisor_at_most
 
 
 class MoEMlp(nn.Module):
@@ -70,6 +79,13 @@ class MoEMlp(nn.Module):
     d_ff: int
     num_experts: int
     capacity_factor: float = 1.25
+    #: routing group width (tokens).  The dispatch/combine einsums cost
+    #: ~2 * capacity_factor * group * d_model MACs PER TOKEN — linear in
+    #: the group width — so smaller groups make routing cheaper relative
+    #: to the expert MLP (2 * d_ff per token), at the price of more
+    #: capacity-drop variance within each group.  512 was the r4
+    #: default; see bench detail for the measured sweep.
+    group: int = 256
     ep_mesh: Optional[Mesh] = None
     dtype: Any = jnp.bfloat16
 
@@ -88,7 +104,7 @@ class MoEMlp(nn.Module):
         b, t, d = x.shape
         n = b * t
         e = self.num_experts
-        G = _group_size(n)  # routing group width (tokens)
+        G = _group_size(n, self.group)  # routing group width (tokens)
         g = n // G
         cap = max(1, int(self.capacity_factor * G / e))
         tokens = x.reshape(n, d)
@@ -116,6 +132,14 @@ class MoEMlp(nn.Module):
         pos = jnp.cumsum(oh_g, axis=1) - oh_g  # [g, G, E]
         pos_in_expert = jnp.sum(pos * oh_g, axis=-1).astype(jnp.int32)
         keep = pos_in_expert < cap
+        # Capacity-drop rate: fraction of tokens whose expert buffer was
+        # full (they pass through on the residual stream).  Reported so
+        # MFU numbers can't hide quality loss behind dropped compute.
+        self.sow(
+            "intermediates",
+            "drop_rate",
+            1.0 - jnp.mean(keep.astype(jnp.float32)),
+        )
         slot = jax.nn.one_hot(
             jnp.where(keep, pos_in_expert, cap), cap, dtype=jnp.float32
         )  # [g, G, C] (dropped tokens one-hot to nowhere)
@@ -155,6 +179,7 @@ class MoEBlock(nn.Module):
     d_model: int
     d_ff: int
     num_experts: int
+    group: int = 256
     sp_mesh: Optional[Mesh] = None
     ep_mesh: Optional[Mesh] = None
     dtype: Any = jnp.bfloat16
@@ -170,6 +195,7 @@ class MoEBlock(nn.Module):
             self.d_model,
             self.d_ff,
             self.num_experts,
+            group=self.group,
             ep_mesh=self.ep_mesh,
             dtype=self.dtype,
             name="moe",
@@ -184,6 +210,7 @@ class MoELM(nn.Module):
     num_layers: int
     num_experts: int
     max_len: int
+    group: int = 256
     sp_mesh: Optional[Mesh] = None
     ep_mesh: Optional[Mesh] = None
     dtype: Any = jnp.bfloat16
@@ -209,9 +236,10 @@ class MoELM(nn.Module):
                 self.d_model,
                 self.d_ff,
                 self.num_experts,
-                self.sp_mesh,
-                self.ep_mesh,
-                self.dtype,
+                group=self.group,
+                sp_mesh=self.sp_mesh,
+                ep_mesh=self.ep_mesh,
+                dtype=self.dtype,
                 name=f"layer_{i}",
             )(x)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -252,6 +280,7 @@ def moe_lm(
     tiny: bool = False,
     seq_len: Optional[int] = None,
     num_experts: Optional[int] = None,
+    group_size: Optional[int] = None,
     sp_mesh: Optional[Mesh] = None,
     ep_mesh: Optional[Mesh] = None,
 ) -> ModelDef:
@@ -260,9 +289,21 @@ def moe_lm(
         experts = num_experts or 4
         L = seq_len or 64
     else:
-        vocab, d_model, d_ff, heads, layers = 32000, 768, 1536, 12, 12
+        # Expert width follows the Switch-Transformer convention:
+        # d_ff == the dense FFN width (4 * d_model), NOT a fraction of
+        # it.  The r4 family's 1536-wide experts left so little MXU
+        # mass per routed token that the chassis (attention + vocab
+        # xent) capped MFU ~0.32; at 3072 the measured v5e figure is
+        # 0.385-0.39 at batch 8 (BENCH r5 sweep).
+        vocab, d_model, d_ff, heads, layers = 32000, 768, 3072, 12, 12
         experts = num_experts or 8
         L = seq_len or 2048
+    # Routing group 256: measured fastest tokens/s on v5e at the full
+    # size (0.1742 s/step vs 0.1782 at G=512 and 0.1780 at G=128,
+    # batch 8) — G was chosen by WALL TIME, not by credited FLOPs (the
+    # dispatch einsums' cost is linear in G, so big G inflates the
+    # credited-FLOPs MFU without moving throughput).
+    group = group_size or 256
     module = MoELM(
         vocab_size=vocab,
         d_model=d_model,
@@ -271,6 +312,7 @@ def moe_lm(
         num_layers=layers,
         num_experts=experts,
         max_len=L,
+        group=group,
         sp_mesh=sp_mesh,
         ep_mesh=ep_mesh,
     )
@@ -292,14 +334,25 @@ def moe_lm(
         loss, _ = best_vocab_xent(
             x, params["embed"]["embedding"], labels, labels != 0
         )
-        aux_leaves = jax.tree_util.tree_leaves(inter)
-        aux = (
-            sum(jnp.asarray(a) for a in aux_leaves) / max(1, len(aux_leaves))
-            if aux_leaves
-            else jnp.float32(0)
-        )
+
+        def _mean_of(key: str):
+            vals = [
+                jnp.asarray(leaf)
+                for path, leaf in jax.tree_util.tree_flatten_with_path(inter)[0]
+                if any(str(getattr(k, "key", k)) == key for k in path)
+            ]
+            return (
+                sum(vals) / len(vals) if vals else jnp.float32(0)
+            )
+
+        aux = _mean_of("aux_loss")
+        drop = _mean_of("drop_rate")
         total = loss + 0.01 * aux
-        return total, {"loss": loss, "moe_aux_loss": aux}
+        return total, {
+            "loss": loss,
+            "moe_aux_loss": aux,
+            "moe_drop_rate": drop,
+        }
 
     def synth_batch(rng: np.random.RandomState, n: int):
         start = rng.randint(3, vocab - 8, size=(n, 1))
@@ -314,7 +367,7 @@ def moe_lm(
     # width), which at G=512 is the same order as the expert MLP and
     # must not be silently dropped from MFU accounting.
     att_proj = 4 * d_model * d_model
-    G = min(512, L)
+    G = min(group, L)
     route = 2 * int(1.25 * G) * d_model
     flops = (
         6

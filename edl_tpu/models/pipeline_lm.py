@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import flax.linen as nn
 
-from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.models.base import ModelDef, divisor_at_most, register_model
 from edl_tpu.models.transformer_lm import LMBlock, lm_flops, lm_synth_batch
 from edl_tpu.parallel.pipeline import pipeline_apply
 
@@ -127,7 +127,10 @@ def pipeline_lm(
                 params["blocks"],
                 x.reshape(b, t * d),
                 pp_mesh,
-                num_microbatches=min(num_microbatches, b),
+                # Largest divisor of b (plain min could pick an M that
+                # does not divide the batch, e.g. b=6 -> M=4, and
+                # pipeline_apply would reject a valid global batch).
+                num_microbatches=divisor_at_most(b, num_microbatches),
             )
             x = flat.reshape(b, t, d)
         else:

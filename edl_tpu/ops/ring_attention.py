@@ -60,15 +60,21 @@ def _merge_norm(o1, lse1, o2, lse2):
     """Merge two NORMALIZED softmax partials: o = w1*o1 + w2*o2 with
     w_i = exp(lse_i - logaddexp(lse1, lse2)).  Safe against a partial
     whose block was fully masked (lse == NEG_INF -> weight 0)."""
-    m = jnp.maximum(lse1, lse2)
-    m = jnp.where(m <= NEG_INF / 2, 0.0, m)  # both-empty guard
+    both_empty = jnp.maximum(lse1, lse2) <= NEG_INF / 2
+    m = jnp.where(both_empty, 0.0, jnp.maximum(lse1, lse2))
     w1 = jnp.exp(lse1 - m)
     w2 = jnp.exp(lse2 - m)
     denom = jnp.maximum(w1 + w2, 1e-30)
     wt1 = (w1 / denom).transpose(0, 2, 1)[..., None]  # [B,Tq,H,1]
     wt2 = (w2 / denom).transpose(0, 2, 1)[..., None]
     o = o1 * wt1 + o2 * wt2
-    return o, m + jnp.log(denom)
+    # A both-empty merge must KEEP weight-zero semantics (lse = NEG_INF,
+    # not log(1e-30) ~= -69) so a later merge still assigns it zero
+    # weight (ADVICE r4; unreachable in current causal rings — every
+    # row sees itself at step 0 — but load-bearing if the combiner is
+    # reused with kv masking).
+    lse = jnp.where(both_empty, NEG_INF, m + jnp.log(denom))
+    return o, lse
 
 
 def _batch_spec(mesh: Mesh, batch_size: int):

@@ -127,6 +127,51 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def hint_activation(x, *entries):
+    """Pin an activation's layout on the AMBIENT mesh (a no-op when
+    there is none, or when none of the named axes exist on it).
+
+    Model code calls this with full-vocabulary entries — e.g.
+    ``hint_activation(h, ("dp", "fsdp"), None, "tp")`` for a
+    [batch, seq, ffn] tensor — and the entries are filtered to the axes
+    the current mesh actually has, so one call site serves every
+    layout.  Why it exists: partition rules constrain PARAMS only;
+    without activation pins GSPMD is free to pick mismatched layouts
+    between the forward and its transpose, and on tp meshes it resolves
+    the mismatch by replicating whole activation tensors every step
+    ("Involuntary full rematerialization" — VERDICT r4 weak-2).
+
+    Reads the ambient mesh through ``jax._src.mesh.thread_resources``
+    (private API, same caveat as the launcher's heartbeat patch):
+    guarded so drift degrades to no pinning, never to a trace error."""
+    try:
+        from jax._src import mesh as mesh_lib
+
+        mesh = mesh_lib.thread_resources.env.physical_mesh
+    except Exception:  # pragma: no cover - jax private API drift
+        return x
+    if mesh is None or mesh.empty:
+        return x
+    if mesh.devices.size == 1:
+        # Single-device mesh: a constraint can only inhibit fusion,
+        # never place anything.
+        return x
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    spec = P(*(keep(e) for e in entries))
+    if all(e is None for e in spec):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def mesh_debug_string(mesh: Mesh) -> str:
     return (
         f"Mesh(shape={dict(zip(mesh.axis_names, mesh.devices.shape))}, "

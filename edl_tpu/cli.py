@@ -236,6 +236,29 @@ def cmd_local_run(args) -> int:
     return 0
 
 
+def cmd_ingest(args) -> int:
+    """Stage a real corpus into a file-backed array store
+    (``edl_tpu.runtime.datasets``): ``edl ingest mnist`` for IDX
+    image/label pairs, ``edl ingest tokens`` for tokenized text.  The
+    produced directory plugs into ``spec.dataset_dir`` /
+    ``local-run --data-dir``."""
+    from edl_tpu.runtime.datasets import ingest_mnist_idx, ingest_tokens
+
+    if args.format == "mnist":
+        if not (args.images and args.labels):
+            print("error: ingest mnist needs --images and --labels", file=sys.stderr)
+            return 2
+        path = ingest_mnist_idx(args.out, args.images, args.labels)
+    else:
+        if not args.tokens:
+            print("error: ingest tokens needs --tokens", file=sys.stderr)
+            return 2
+        path = ingest_tokens(args.out, args.tokens, seq_len=args.seq_len)
+    with open(f"{path}/manifest.json") as f:
+        print(f.read())
+    return 0
+
+
 def cmd_controller(args) -> int:
     """Run the control plane against a real cluster: watch TrainingJob
     CRs and reconcile/autoscale forever — the reference's whole
@@ -384,6 +407,19 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     s.set_defaults(fn=cmd_local_run)
+
+    s = sub.add_parser(
+        "ingest", help="stage a real corpus into a file-backed array store"
+    )
+    s.add_argument("format", choices=["mnist", "tokens"])
+    s.add_argument("--out", required=True, help="array-store directory")
+    s.add_argument("--images", default="", help="IDX image file (mnist)")
+    s.add_argument("--labels", default="", help="IDX label file (mnist)")
+    s.add_argument("--tokens", default="", help="token corpus (.npy/.u16/.u32)")
+    s.add_argument(
+        "--seq-len", type=int, default=2048, help="row length (tokens) - 1"
+    )
+    s.set_defaults(fn=cmd_ingest)
 
     s = sub.add_parser(
         "controller", help="run the control-plane daemon against a cluster"

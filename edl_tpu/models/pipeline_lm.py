@@ -29,7 +29,7 @@ import flax.linen as nn
 
 from edl_tpu.models.base import ModelDef, divisor_at_most, register_model
 from edl_tpu.models.transformer_lm import LMBlock, lm_flops, lm_synth_batch
-from edl_tpu.parallel.pipeline import pipeline_apply
+from edl_tpu.parallel.pipeline import pipeline_1f1b_loss, pipeline_apply
 
 
 @register_model("pipeline_lm")
@@ -39,11 +39,19 @@ def pipeline_lm(
     pp_mesh: Optional[Mesh] = None,
     num_stages: Optional[int] = None,
     num_microbatches: int = 4,
+    schedule: str = "gpipe",
 ) -> ModelDef:
     """``pp_mesh``: mesh carrying the ``pp`` axis (stage count defaults
     to its size; without a mesh the stages run sequentially — same
     code path, so CPU tests and the one-chip TPU run the identical
-    model)."""
+    model).
+
+    ``schedule``: "gpipe" (scan-under-AD; activation memory O(M)
+    microbatches) or "1f1b" (one-forward-one-backward with in-schedule
+    gradients; activation memory O(S), forward recompute in the
+    backward sub-tick — see ``parallel/pipeline.pipeline_1f1b_loss``)."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if tiny:
         vocab, d_model, d_ff, heads, layers = 256, 64, 256, 4, 4
         L = seq_len or 64
@@ -140,11 +148,52 @@ def pipeline_lm(
                 )
         return ln_f.apply({"params": params["ln_f"]}, x)
 
+    def _head_fn(head_params, h_flat, labels_mb):
+        """Last-stage loss head for the 1F1B schedule: final norm +
+        tied-vocab xent on ONE microbatch, returned as (sum, count) so
+        microbatch combination is exactly the full-batch mean."""
+        from edl_tpu.ops.losses import best_vocab_xent
+
+        mb = h_flat.shape[0]
+        y = ln_f.apply(
+            {"params": head_params["ln_f"]},
+            h_flat.reshape(mb, -1, d_model),
+        )
+        valid = labels_mb != 0
+        mean, _ = best_vocab_xent(
+            y, head_params["embedding"], labels_mb, valid
+        )
+        cnt = jnp.sum(valid.astype(jnp.float32))
+        return mean * cnt, cnt
+
     def loss_fn(params, batch, rng) -> Tuple[jax.Array, Dict[str, jax.Array]]:
         from edl_tpu.ops.losses import best_vocab_xent
 
         tokens = batch["tokens"]
         labels = tokens[:, 1:]
+        piped = pp_mesh is not None and "pp" in pp_mesh.axis_names
+        if schedule == "1f1b" and piped:
+            x = outer.apply({"params": params["outer"]}, tokens[:, :-1])
+            b, t, d = x.shape
+            head_params = {
+                "ln_f": params["ln_f"],
+                # tied projection: the embedding receives gradient both
+                # here (head) and through the outer embed lookup
+                "embedding": params["outer"]["embed"]["embedding"],
+            }
+            loss = pipeline_1f1b_loss(
+                lambda p, h: stage_fn(p, h.reshape(-1, t, d)).reshape(
+                    h.shape
+                ),
+                _head_fn,
+                params["blocks"],
+                head_params,
+                x.reshape(b, t * d),
+                labels,
+                pp_mesh,
+                num_microbatches=divisor_at_most(b, num_microbatches),
+            )
+            return loss, {"loss": loss}
         x = features(params, tokens[:, :-1])
         loss, _ = best_vocab_xent(
             x,

@@ -12,17 +12,27 @@ per-step local attention IS this kernel via
 ``flash_attention_with_lse``, whose differentiable lse output feeds
 the ring's normalized-partial merge.
 
-Backward: a single blockwise kernel with saved residuals — the forward
-emits per-row logsumexp (O(T) stats, broadcast over STAT_LANES
-trailing values so tiles stay legal (sublane, lane) shapes), and ONE
-backward pass
-recomputes each probability tile once to produce dQ, dK and dV
-together (dK/dV accumulate in f32 VMEM scratch while Q tiles stream;
-the split dq/dkv formulation pays the score dot and the exp twice —
-merging them measured +15% tokens/s on the T=2048 LM).  The softmax
-correction delta = rowsum(dO * O) is computed in-kernel from the O/dO
-tiles, so nothing O(T^2) — and no extra stats array — ever hits HBM in
-either direction.
+Backward — TWO implementations behind one dispatch (``_bwd_common``):
+
+- **merged** (T <= 2048): a single blockwise kernel with saved
+  residuals — the forward emits per-row logsumexp (O(T) stats,
+  broadcast over STAT_LANES trailing values so tiles stay legal
+  (sublane, lane) shapes), and ONE backward pass recomputes each
+  probability tile once to produce dQ, dK and dV together (dK/dV
+  accumulate in f32 VMEM scratch while Q tiles stream; the split
+  dq/dkv formulation pays the score dot and the exp twice — merging
+  them measured +15% tokens/s on the T=2048 LM).  Its VMEM footprint
+  grows with T (K/V + full-T scratch resident per bh): 512 tiles fit
+  at T=2048, overflow at T=4096, nothing fits at T=8192.
+- **streaming-K** (T > 2048): K blocks become the outer grid dim, so
+  only one (block_k, d) K/V block + scratch is resident — VMEM use is
+  T-independent and T=8192 runs single-chip (measured 0.345 MFU at
+  batch 2; T=4096 0.381-0.389 vs 0.36 for the merged kernel's shrunken
+  tiles).  dQ comes out as per-K-block f32 partials summed by XLA.
+
+The softmax correction delta = rowsum(dO * O) is computed in-kernel
+from the O/dO tiles, so nothing O(T^2) — and no extra stats array —
+ever hits HBM in either direction.
 
 Masking: ``causal`` masks by absolute position inside the kernel (and
 skips fully-masked K tiles); ``kv_mask`` ([B, Tk] bool, True = valid)
@@ -78,17 +88,26 @@ def _pick_block(t: int, want: int) -> int:
     return b
 
 
-def _default_bwd_block(fwd_block: int, tk: int) -> int:
-    """Backward tile default: the forward's tile up to T=2048, shrunk
-    to 256 beyond.  The merged backward keeps K, V (bf16) AND the
-    dK/dV f32 scratch resident per bh — ~12 bytes/key-position/lane —
-    so its VMEM footprint grows with T while the tiles add their own
-    double-buffered share; measured on v5e (16MB scoped VMEM): 512
-    tiles fit at T=2048 (fastest), overflow by 256KB at T=4096 where
-    256 tiles run at 0.36 MFU, and NO tile size fits at T=8192 —
-    single-chip sequences beyond ~4k are what the sp axis (ring
-    attention) is for."""
-    return fwd_block if tk <= 2048 else min(fwd_block, 256)
+#: Context length above which the backward switches from the merged
+#: single-pass kernel (K/V + full-T dK/dV scratch resident per bh —
+#: fastest, but VMEM-bound: 512 tiles fit at T=2048, overflow at
+#: T=4096, and nothing fits at T=8192) to the streaming-K kernel
+#: (VMEM use independent of T; dQ summed from per-K-block partials).
+_MERGED_BWD_MAX_T = 2048
+
+#: Test hook: force a backward implementation ("merged" | "streamk");
+#: None = pick by _MERGED_BWD_MAX_T.
+_BWD_IMPL_OVERRIDE = None
+
+
+#: Streaming-K backward tile defaults (tk > _MERGED_BWD_MAX_T), from
+#: the v5e full-step sweep at T=4096 batch 4: 256x2048 0.1891 s,
+#: 512x1024 0.1903, 512x512 0.2144, 128x2048 0.2108; 512x2048
+#: overflows scoped VMEM by 84KB.  Tall K blocks win: fewer Q
+#: re-streams and fewer dQ partials, while the (block_k, d) scratch
+#: stays far under the VMEM roof.
+_STREAMK_BWD_BLOCK_Q = 256
+_STREAMK_BWD_BLOCK_K = 2048
 
 
 def _safe(m):
@@ -407,6 +426,184 @@ def _flash_bwd_3d(
     return dq, dk, dv
 
 
+def _bwd_streamk_kernel(
+    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, mask_ref,
+    dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale, causal, num_i, has_mask, has_glse,
+):
+    """Streaming-K backward: grid (BH, Tk/block_k, Tq/block_q).
+
+    The merged kernel (``_bwd_kernel``) keeps K/V + full-T dK/dV f32
+    scratch resident per bh, which overflows VMEM past T=2048 at 512
+    tiles and fits NOTHING at T=8192.  Here K blocks are the OUTER grid
+    dim: only one (block_k, d) K/V block and its (block_k, d) dK/dV
+    scratch are resident — VMEM use is T-independent, so 512 tiles run
+    at any context length.  The price: Q/O/dO/lse tiles re-stream per K
+    block, and dQ comes out as per-K-block PARTIALS (f32,
+    [BH, num_j, Tq, D]) summed by XLA afterwards — in-kernel dQ
+    accumulation across the grid would need non-consecutive output
+    revisits, which Pallas TPU does not keep (same dead end as the
+    fused-xent merge attempt)."""
+    j = pl.program_id(1)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, jnp.float32)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, jnp.float32)
+
+    qb = q_ref[0]  # [bq, D]
+    kb = k_ref[0]  # [bk, D]
+    vb = v_ref[0]
+    block_q = qb.shape[0]
+    block_k = kb.shape[0]
+
+    # Causal tile classification from the block indices alone.
+    if causal:
+        # max q_pos < min k_pos -> every score masked; skip everything.
+        fully_masked = (i + 1) * block_q - 1 < j * block_k
+        # min q_pos >= max k_pos -> nothing masked; skip the iota/where.
+        needs_mask_pred = i * block_q < (j + 1) * block_k - 1
+
+    def compute():
+        ob = o_ref[0].astype(jnp.float32)
+        dob = do_ref[0]
+        dob_f32 = dob.astype(jnp.float32)
+        lse = _row_stat(lse_ref[0])  # [bq, 1]
+        delta = jnp.sum(dob_f32 * ob, axis=-1, keepdims=True)
+        if has_glse:
+            delta = delta - _row_stat(glse_ref[0])
+        s = scale * jax.lax.dot_general(
+            qb, kb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1
+            )
+            s = jnp.where(
+                jnp.logical_or(
+                    jnp.logical_not(needs_mask_pred), q_pos >= k_pos
+                ),
+                s,
+                NEG_INF,
+            )
+        if has_mask:
+            valid = mask_ref[0] != 0  # [1, bk]
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            dob, vb,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = (p * (dp - delta)).astype(kb.dtype)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(dob.dtype), dob,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dk_scr[...] += jax.lax.dot_general(
+            ds, qb,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return jax.lax.dot_general(
+            ds, kb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    if causal:
+        # pl.when (not a value-returning cond): the compute branch also
+        # writes the dK/dV scratch, and divergent ref writes belong in
+        # when-blocks, not lax.cond branches.
+        @pl.when(fully_masked)
+        def _masked():
+            dqp_ref[0, 0] = jnp.zeros((block_q, qb.shape[-1]), jnp.float32)
+
+        @pl.when(jnp.logical_not(fully_masked))
+        def _live():
+            dqp_ref[0, 0] = compute()
+    else:
+        dqp_ref[0, 0] = compute()
+
+    @pl.when(i == num_i - 1)
+    def _emit():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_streamk_3d(
+    q, k, v, o, lse, do, glse, mask, causal, scale, block_q, block_k,
+    interpret,
+):
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    has_mask = mask is not None
+    has_glse = glse is not None
+    heads = bh // mask.shape[0] if has_mask else 1
+    num_i = tq // block_q
+    num_j = tk // block_k
+
+    kernel = functools.partial(
+        _bwd_streamk_kernel,
+        scale=scale, causal=causal, num_i=num_i,
+        has_mask=has_mask, has_glse=has_glse,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # q
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),       # k
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),       # v
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # o
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # do
+        pl.BlockSpec(
+            (1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)
+        ),                                                              # lse
+    ]
+    args = [q, k, v, o, do, lse]
+    if has_glse:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)
+            )
+        )
+        args.append(glse)
+    if has_mask:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1, block_k), lambda b, j, i, h=heads: (b // h, 0, j)
+            )
+        )
+        args.append(mask)
+    dqp, dk, dv = pl.pallas_call(
+        _adapt_optional(kernel, 6, (has_glse, has_mask)),
+        grid=(bh, num_j, num_i),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b, j, i: (b, j, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, num_j, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    dq = jnp.sum(dqp, axis=1).astype(q.dtype)
+    return dq, dk, dv
+
+
 # ---------------------------------------------------------------------------
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
@@ -456,7 +653,12 @@ def _bwd_common(res, g_o, glse3, causal, scale, bwd_block_q, bwd_block_k,
     cotangent in residual layout ([BH, T, STAT_LANES]) or None."""
     q, k, v, out3, lse, mask = res
     b, t, h, d = q.shape
-    dq3, dk3, dv3 = _flash_bwd_3d(
+    tk = k.shape[1]
+    impl = _BWD_IMPL_OVERRIDE or (
+        "merged" if tk <= _MERGED_BWD_MAX_T else "streamk"
+    )
+    bwd_3d = _flash_bwd_3d if impl == "merged" else _flash_bwd_streamk_3d
+    dq3, dk3, dv3 = bwd_3d(
         _to3(q), _to3(k), _to3(v), out3, lse, _to3(g_o.astype(q.dtype)),
         glse3, mask, causal, scale, bwd_block_q, bwd_block_k, interpret,
     )
@@ -539,12 +741,14 @@ def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
         raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
     block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
     block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
-    bwd_block_q = _pick_block(
-        tq, bwd_block_q or _default_bwd_block(block_q, tk)
-    )
-    bwd_block_k = _pick_block(
-        tk, bwd_block_k or _default_bwd_block(block_k, tk)
-    )
+    if tk <= _MERGED_BWD_MAX_T:
+        # Merged backward: forward-size tiles (fastest measured).
+        dq_want, dk_want = block_q, block_k
+    else:
+        # Streaming-K backward: its own sweep's optimum.
+        dq_want, dk_want = _STREAMK_BWD_BLOCK_Q, _STREAMK_BWD_BLOCK_K
+    bwd_block_q = _pick_block(tq, bwd_block_q or dq_want)
+    bwd_block_k = _pick_block(tk, bwd_block_k or dk_want)
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
     return (mask, causal, scale, block_q, block_k, bwd_block_q,
             bwd_block_k, interpret)
@@ -568,9 +772,10 @@ def flash_attention(
     ``kv_mask``: optional [B, Tk] bool (True = attend) for padded
     batches.  ``bwd_block_q``/``bwd_block_k`` tile the backward
     independently (it carries dK/dV scratch, so its VMEM ceiling —
-    and sweet spot — differ from the forward's); they default to the
-    forward tiles up to T=2048 and shrink to 256 beyond (the measured
-    v5e VMEM ceiling — see ``_default_bwd_block``).  ``interpret=None``
+    and sweet spot — differ from the forward's): up to T=2048 the
+    merged backward runs at the forward tiles; beyond, the streaming-K
+    backward runs at its own swept optimum (256 x 2048 — see
+    ``_STREAMK_BWD_BLOCK_Q/K``).  ``interpret=None``
     auto-selects: real kernel on TPU, Pallas interpreter elsewhere
     (tests on the CPU mesh take this path)."""
     return _flash(

@@ -1,4 +1,4 @@
-"""GPipe-style pipeline parallelism over the mesh's ``pp`` axis.
+"""Pipeline parallelism over the mesh's ``pp`` axis: GPipe and 1F1B.
 
 The last mesh axis to become load-bearing: stages of a homogeneous
 layer stack shard over ``pp`` (each device holds ONE stage's
@@ -7,17 +7,25 @@ hop stage-to-stage with ``lax.ppermute`` — a neighbor exchange, the
 cheapest collective, riding the lowest-bandwidth mesh axis by the
 canonical order (``parallel/mesh.py``: pipeline cuts outermost).
 
-Schedule: plain GPipe.  ``M`` microbatches over ``S`` stages run in
-``M + S - 1`` ticks; at tick ``t`` stage ``r`` processes microbatch
-``t - r`` (when in range).  The bubble fraction is ``(S-1)/(M+S-1)``
-— pick ``M >> S``.  The whole schedule is ONE ``lax.scan`` inside
-``shard_map``, so reverse-mode AD differentiates it like any scan:
-the transpose of ``ppermute`` is the reverse hop and the backward
-schedule emerges mechanically.  Correctness first: a 1F1B interleave
-(which shrinks peak activation memory from M microbatches to S) would
-require taking MANUAL control of the forward/backward interleaving —
-a custom_vjp over the whole schedule — rather than relying on scan
-AD; that is future work, not a parameter away.
+Two schedules:
+
+- ``pipeline_apply`` — plain GPipe.  ``M`` microbatches over ``S``
+  stages run in ``M + S - 1`` ticks; at tick ``t`` stage ``r``
+  processes microbatch ``t - r`` (when in range).  One ``lax.scan``
+  inside ``shard_map``; reverse-mode AD differentiates it like any
+  scan, which means the scan saves every tick's intra-stage
+  activations — peak activation memory O(M).
+- ``pipeline_1f1b_loss`` — one-forward-one-backward.  The schedule is
+  NOT differentiated: each cycle runs a forward sub-tick and a
+  backward sub-tick (explicit per-stage ``jax.vjp``), stage inputs
+  live in a (2S-1)-slot ring buffer, activation cotangents hop
+  backward via the reverse ppermute, and parameter gradients
+  accumulate in the scan carry.  Activation memory is O(S)
+  microbatches; the price is the standard recompute (each stage's
+  forward runs again inside its backward sub-tick — Megatron's
+  activation-recompute tradeoff).  An outer ``custom_vjp`` makes the
+  whole thing a differentiable scalar loss: its fwd computes (loss,
+  grads) in one pass and its bwd just scales the saved grads.
 
 Composition: batch may additionally shard over ``dp`` (the microbatch
 dim's spec), params over ``fsdp``/``tp`` within a stage — the same
@@ -183,3 +191,245 @@ def pipeline_apply(
     except TypeError:  # pragma: no cover - older jax
         fn = shard_map(local_fn, check_rep=False, **kwargs)
     return fn(stacked_params, xm).reshape(B, *x.shape[1:])
+
+
+def pipeline_1f1b_loss(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    head_fn: Callable[[Any, jax.Array, Any], Any],
+    stacked_params: Any,
+    head_params: Any,
+    x: jax.Array,
+    aux: Any,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+    batch_axis: str = "dp",
+) -> jax.Array:
+    """Mean loss through the 1F1B pipeline schedule, differentiable.
+
+    ``stage_fn(stage_params, h [mb, F]) -> h``: one stage.
+    ``head_fn(head_params, h [mb, F], aux_mb) -> (loss_sum, count)``:
+    the per-microbatch loss head run at the LAST stage (e.g. final
+    norm + tied-vocab xent); must return the SUM of per-token losses
+    and the valid-token count as f32 scalars, so the microbatch
+    combination sum(loss_sums)/sum(counts) is exactly the full-batch
+    mean regardless of per-microbatch valid counts.
+    ``aux``: [B, ...] per-example head inputs (labels), microbatched
+    alongside ``x``.
+
+    Returns the scalar mean loss.  Gradients flow to stacked_params,
+    head_params and x (the embedding upstream); the backward pass costs
+    nothing beyond scaling — the schedule already computed the grads.
+
+    Memory: the schedule is ONE un-differentiated scan whose carry
+    holds a (2S-1)-microbatch input ring buffer + param-sized grad
+    accumulators, so peak activation memory is O(S) microbatches
+    (GPipe-under-AD saves O(M) ticks of intra-stage activations).
+    Compute: each stage's forward runs twice (once in the fwd sub-tick,
+    once rematerialized inside its vjp) — the standard
+    activation-recompute tradeoff."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    S = sizes.get(axis, 1)
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible into {M} microbatches")
+    if S == 1:
+        # No pipeline axis: sequential forward + head, plain AD.
+        n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+        mb = B // M
+        ls_total = jnp.float32(0)
+        cnt_total = jnp.float32(0)
+        for m in range(M):
+            h = x[m * mb : (m + 1) * mb]
+            for s_i in range(n_stages):
+                h = stage_fn(
+                    jax.tree.map(lambda p: p[s_i], stacked_params), h
+                )
+            aux_m = jax.tree.map(lambda a: a[m * mb : (m + 1) * mb], aux)
+            ls, cnt = head_fn(head_params, h, aux_m)
+            ls_total = ls_total + ls
+            cnt_total = cnt_total + cnt
+        return ls_total / jnp.maximum(cnt_total, 1.0)
+
+    mb = B // M
+
+    out_aval = jax.eval_shape(
+        stage_fn,
+        jax.tree.map(
+            lambda p: jax.ShapeDtypeStruct(p.shape[1:], p.dtype),
+            stacked_params,
+        ),
+        jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype),
+    )
+    act_dtype = out_aval.dtype
+    feat_shape = (mb,) + x.shape[1:]
+
+    dp_size = sizes.get(batch_axis, 1)
+    bspec = batch_axis if batch_axis in sizes and mb % dp_size == 0 else None
+    x_spec = P(None, bspec, *([None] * (x.ndim - 1)))
+    aux_specs = jax.tree.map(
+        lambda a: P(None, bspec, *([None] * (a.ndim - 1))), aux
+    )
+    p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    h_spec = jax.tree.map(lambda _: P(), head_params)
+
+    R = 2 * S - 1  # ring slots; +1 trash slot appended below
+    C = M + 2 * S - 2  # cycles
+
+    def local_fn(params, head_p, xm_blk, aux_blk):
+        p_local = jax.tree.map(lambda p: p[0], params)
+        r = lax.axis_index(axis)
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+        feat = xm_blk.shape[1:]
+
+        def head_closure(hp, y, a_mb):
+            ls, cnt = head_fn(hp, y, a_mb)
+            return jnp.float32(ls), jnp.float32(cnt)
+
+        zeros_gH = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), head_p
+        )
+        zeros_gP = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), p_local
+        )
+
+        def cycle(carry, c):
+            y_prev, dh_prev, buf, gP, gH, dxb, ls, cnt = carry
+
+            # ---- forward sub-tick -------------------------------------
+            recv = lax.ppermute(y_prev, axis, fwd_perm)
+            mf = c - r
+            f_valid = jnp.logical_and(mf >= 0, mf < M)
+            feed = xm_blk[jnp.clip(mf, 0, M - 1)].astype(act_dtype)
+            h_in = jnp.where(r == 0, feed, recv)
+            # store the stage input; invalid sub-ticks write the trash
+            # slot R (a live slot must never be clobbered)
+            slot = jnp.where(f_valid, jnp.mod(mf, R), R)
+            buf = lax.dynamic_update_index_in_dim(buf, h_in, slot, 0)
+            y = stage_fn(p_local, h_in)
+
+            # head (last rank only — lax.cond keeps the vocab-sized
+            # head off every other rank's critical path)
+            a_mb = jax.tree.map(
+                lambda a: a[jnp.clip(mf, 0, M - 1)], aux_blk
+            )
+
+            def run_head(_):
+                (ls_mb, cnt_mb), h_vjp = jax.vjp(
+                    lambda hp, yy: head_closure(hp, yy, a_mb), head_p, y
+                )
+                dH, dY = h_vjp((jnp.float32(1), jnp.float32(0)))
+                return ls_mb, cnt_mb, dH, dY
+
+            def skip_head(_):
+                return (
+                    jnp.float32(0),
+                    jnp.float32(0),
+                    zeros_gH,
+                    jnp.zeros(y.shape, y.dtype),
+                )
+
+            is_last = r == S - 1
+            ls_mb, cnt_mb, dH, dY_head = lax.cond(
+                jnp.logical_and(is_last, f_valid), run_head, skip_head, None
+            )
+            ls = ls + ls_mb
+            cnt = cnt + cnt_mb
+            gH = jax.tree.map(jnp.add, gH, dH)
+
+            # ---- backward sub-tick ------------------------------------
+            recv_d = lax.ppermute(dh_prev, axis, bwd_perm)
+            mbk = c - (2 * S - 2 - r)
+            b_valid = jnp.logical_and(mbk >= 0, mbk < M)
+            dY = jnp.where(is_last, dY_head.astype(act_dtype), recv_d)
+            h_saved = lax.dynamic_index_in_dim(
+                buf, jnp.where(b_valid, jnp.mod(mbk, R), R), 0, keepdims=False
+            )
+            _, s_vjp = jax.vjp(stage_fn, p_local, h_saved)
+            dp, dh = s_vjp(dY)
+            gP = jax.tree.map(
+                lambda g, d: g + jnp.where(b_valid, d, 0.0).astype(g.dtype),
+                gP,
+                dp,
+            )
+            emit_dx = jnp.logical_and(b_valid, r == 0)
+            dxb = dxb.at[jnp.clip(mbk, 0, M - 1)].add(
+                jnp.where(emit_dx, dh, jnp.zeros_like(dh)).astype(dxb.dtype)
+            )
+            return (y, dh, buf, gP, gH, dxb, ls, cnt), None
+
+        buf0 = jnp.zeros((R + 1,) + feat, act_dtype)
+        carry0 = (
+            jnp.zeros(feat, act_dtype),              # y hop
+            jnp.zeros(feat, act_dtype),              # dh hop
+            buf0,
+            zeros_gP,
+            zeros_gH,
+            jnp.zeros((M,) + feat, jnp.float32),     # dx
+            jnp.float32(0),
+            jnp.float32(0),
+        )
+        (_, _, _, gP, gH, dxb, ls, cnt), _ = lax.scan(
+            cycle, carry0, jnp.arange(C)
+        )
+
+        # Reductions: loss/count/head grads sum over dp shards AND pp
+        # (only rank S-1 contributed); stage grads sum over dp only
+        # (each rank owns its stage); dx sums over pp only (each dp
+        # shard owns its rows).
+        red_axes = (axis, batch_axis) if bspec else (axis,)
+        ls = lax.psum(ls, red_axes)
+        cnt = lax.psum(cnt, red_axes)
+        gH = jax.tree.map(lambda g: lax.psum(g, red_axes), gH)
+        if bspec:
+            gP = jax.tree.map(lambda g: lax.psum(g, batch_axis), gP)
+        dxb = lax.psum(dxb, axis)
+        denom = jnp.maximum(cnt, 1.0)
+        # grads of the MEAN loss (the schedule accumulated d loss_sum)
+        gP = jax.tree.map(lambda g: (g / denom)[None], gP)  # restage dim
+        gH = jax.tree.map(lambda g: g / denom, gH)
+        dxb = dxb / denom
+        return ls / denom, gP, gH, dxb
+
+    kwargs = dict(
+        mesh=mesh,
+        in_specs=(p_spec, h_spec, x_spec, aux_specs),
+        out_specs=(
+            P(),
+            jax.tree.map(lambda _: P(axis), stacked_params),
+            jax.tree.map(lambda _: P(), head_params),
+            x_spec,
+        ),
+    )
+    try:
+        sharded = shard_map(local_fn, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - older jax
+        sharded = shard_map(local_fn, check_rep=False, **kwargs)
+
+    @jax.custom_vjp
+    def loss_of(sp, hp, xx, ax):
+        return loss_fwd(sp, hp, xx, ax)[0]
+
+    def loss_fwd(sp, hp, xx, ax):
+        loss, gP, gH, dxb = sharded(
+            sp,
+            hp,
+            xx.reshape(M, mb, *xx.shape[1:]),
+            jax.tree.map(lambda a: a.reshape(M, mb, *a.shape[1:]), ax),
+        )
+        return loss, (gP, gH, dxb, jax.tree.map(jnp.shape, ax))
+
+    def loss_bwd(res, g):
+        gP, gH, dxb, _ = res
+        dx_full = (g * dxb).reshape(B, *x.shape[1:]).astype(x.dtype)
+        return (
+            jax.tree.map(lambda t: (g * t).astype(t.dtype), gP),
+            jax.tree.map(lambda t: (g * t).astype(t.dtype), gH),
+            dx_full,
+            jax.tree.map(lambda a: None, aux),
+        )
+
+    loss_of.defvjp(loss_fwd, loss_bwd)
+    return loss_of(stacked_params, head_params, x, aux)

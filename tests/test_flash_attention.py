@@ -182,11 +182,78 @@ def test_flash_attention_with_lse_kv_mask_gradients():
         assert float(jnp.max(jnp.abs(a - b))) < 1e-4
 
 
-def test_bwd_block_default_shrinks_with_context():
-    """VMEM-aware backward tiles: the forward's 512 default up to
-    T=2048, 256 beyond (measured v5e ceiling — see _default_bwd_block)."""
-    from edl_tpu.ops.flash_attention import _default_bwd_block
+def test_streamk_backward_matches_merged():
+    """The streaming-K backward (T > 2048 dispatch; VMEM-independent of
+    T) must produce the same gradients as the merged kernel on every
+    masking variant, including the differentiable-lse path the ring
+    combiner uses."""
+    import importlib
 
-    assert _default_bwd_block(512, 2048) == 512
-    assert _default_bwd_block(512, 4096) == 256
-    assert _default_bwd_block(128, 4096) == 128  # explicit small stays
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    fa = importlib.import_module("edl_tpu.ops.flash_attention")
+    rng = np.random.RandomState(0)
+    B, T, H, D = 2, 64, 2, 16
+    q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
+    mask = jnp.asarray(rng.rand(B, T) > 0.2)
+
+    try:
+        for causal, use_mask in [
+            (False, False), (True, False), (False, True), (True, True)
+        ]:
+            kv = mask if use_mask else None
+
+            def loss(q, k, v, impl):
+                fa._BWD_IMPL_OVERRIDE = impl
+                o = fa.flash_attention(
+                    q, k, v, causal=causal, kv_mask=kv,
+                    block_q=16, block_k=16, interpret=True,
+                )
+                return jnp.sum(o * o * 0.37)
+
+            gm = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "merged")
+            gs = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "streamk")
+            for a, b, name in zip(gm, gs, "qkv"):
+                np.testing.assert_allclose(
+                    a, b, rtol=2e-5, atol=2e-5,
+                    err_msg=f"{causal=} {use_mask=} d{name}",
+                )
+
+        def loss_lse(q, k, v, impl):
+            fa._BWD_IMPL_OVERRIDE = impl
+            o, lse = fa.flash_attention_with_lse(
+                q, k, v, causal=True, block_q=16, block_k=16,
+                interpret=True,
+            )
+            return jnp.sum(o * o * 0.1) + jnp.sum(jnp.sin(lse))
+
+        gm = jax.grad(loss_lse, argnums=(0, 1, 2))(q, k, v, "merged")
+        gs = jax.grad(loss_lse, argnums=(0, 1, 2))(q, k, v, "streamk")
+        for a, b, name in zip(gm, gs, "qkv"):
+            np.testing.assert_allclose(
+                a, b, rtol=2e-5, atol=2e-5, err_msg=f"lse d{name}"
+            )
+    finally:
+        fa._BWD_IMPL_OVERRIDE = None
+
+
+def test_streamk_dispatch_thresholds():
+    """tk <= 2048 takes the merged kernel with forward tiles; beyond it
+    the streaming-K defaults (256 x 2048) apply."""
+    import importlib
+
+    fa = importlib.import_module("edl_tpu.ops.flash_attention")
+    import jax.numpy as jnp
+
+    q2k = jnp.zeros((1, 2048, 1, 16), jnp.bfloat16)
+    prep = fa._prep(q2k, q2k, True, None, None, None, None, None, None, True)
+    _, _, _, bq, bk, bwd_q, bwd_k, _ = prep
+    assert (bwd_q, bwd_k) == (bq, bk) == (512, 512)
+    q4k = jnp.zeros((1, 4096, 1, 16), jnp.bfloat16)
+    prep = fa._prep(q4k, q4k, True, None, None, None, None, None, None, True)
+    _, _, _, _, _, bwd_q, bwd_k, _ = prep
+    assert (bwd_q, bwd_k) == (256, 2048)

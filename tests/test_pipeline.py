@@ -155,3 +155,115 @@ def test_pipeline_lm_matches_sequential_model():
     )
     state, metrics = tr.step(state, data.device_batch(0, mesh))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_1f1b_matches_gpipe_loss_and_grads(devices8):
+    """VERDICT r4 #9 equality criterion: the 1F1B schedule's loss and
+    gradients match GPipe-under-AD on a dp2 x pp4 mesh (same math,
+    different schedule; tolerance covers bf16 cotangent hop
+    reassociation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+    from edl_tpu.runtime.data import synthetic_dataset
+
+    mesh = build_mesh(MeshSpec.create(dp=2, pp=4), devices8)
+    g = get_model("pipeline_lm", tiny=True, pp_mesh=mesh, num_microbatches=4)
+    f = get_model(
+        "pipeline_lm", tiny=True, pp_mesh=mesh, num_microbatches=4,
+        schedule="1f1b",
+    )
+    params = g.init_params(jax.random.key(0))
+    batch = {
+        k: jnp.asarray(v)
+        for k, v in synthetic_dataset(g.synth_batch, 8).items()
+    }
+    with mesh:
+        lg, _ = jax.jit(lambda p, b: g.loss_fn(p, b, None))(params, batch)
+        lf, _ = jax.jit(lambda p, b: f.loss_fn(p, b, None))(params, batch)
+        gg = jax.jit(jax.grad(lambda p, b: g.loss_fn(p, b, None)[0]))(
+            params, batch
+        )
+        gf = jax.jit(jax.grad(lambda p, b: f.loss_fn(p, b, None)[0]))(
+            params, batch
+        )
+    assert abs(float(lg) - float(lf)) < 1e-3 * max(1.0, abs(float(lg)))
+    flat_f = {
+        jax.tree_util.keystr(p): l
+        for p, l in jax.tree_util.tree_leaves_with_path(gf)
+    }
+    for p, leaf_g in jax.tree_util.tree_leaves_with_path(gg):
+        leaf_f = flat_f[jax.tree_util.keystr(p)]
+        a = jnp.asarray(leaf_g, jnp.float32)
+        b = jnp.asarray(leaf_f, jnp.float32)
+        err = float(jnp.max(jnp.abs(a - b)))
+        scale = float(jnp.max(jnp.abs(a))) + 1e-8
+        assert err / scale < 3e-2, (
+            f"{jax.tree_util.keystr(p)}: rel err {err / scale}"
+        )
+
+
+def test_1f1b_peak_memory_below_gpipe(devices8):
+    """VERDICT r4 #9 memory criterion: at M >> S the un-differentiated
+    1F1B schedule's compiled temp memory is a small fraction of
+    GPipe-under-AD's (O(S) ring buffer vs O(M) saved scan ticks).
+    Measured at M=16, S=4: ~1.8MB vs ~19.7MB."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+    from edl_tpu.runtime.data import synthetic_dataset
+
+    mesh = build_mesh(MeshSpec.create(dp=2, pp=4), devices8)
+    temps = {}
+    for sched in ("gpipe", "1f1b"):
+        m = get_model(
+            "pipeline_lm", tiny=True, pp_mesh=mesh, num_microbatches=16,
+            schedule=sched,
+        )
+        params = m.init_params(jax.random.key(0))
+        batch = {
+            k: jnp.asarray(v)
+            for k, v in synthetic_dataset(m.synth_batch, 32).items()
+        }
+        with mesh:
+            compiled = (
+                jax.jit(jax.grad(lambda p, b: m.loss_fn(p, b, None)[0]))
+                .lower(params, batch)
+                .compile()
+            )
+        temps[sched] = compiled.memory_analysis().temp_size_in_bytes
+    assert temps["1f1b"] < temps["gpipe"] / 3, temps
+
+
+def test_1f1b_trains(devices8):
+    """Optimizer steps through the 1F1B schedule descend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
+    from edl_tpu.runtime.train import Trainer
+
+    mesh = build_mesh(MeshSpec.create(dp=2, pp=4), devices8)
+    m = get_model(
+        "pipeline_lm", tiny=True, pp_mesh=mesh, num_microbatches=4,
+        schedule="1f1b",
+    )
+    tr = Trainer(m, optax.adam(1e-2), mesh)
+    state = tr.init_state()
+    data = ShardedDataIterator(
+        synthetic_dataset(m.synth_batch, 32), global_batch_size=8
+    )
+    losses = []
+    for s in range(6):
+        state, metrics = tr.step(state, data.device_batch(s, mesh))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses

@@ -429,12 +429,18 @@ def main():
         "longcontext_lm_4k",
         retries=0,
     )
-    # T=8192 single-chip: possible at all only via the streaming-K
-    # backward (the merged kernel's VMEM footprint grows with T and
-    # fits nothing at 8k).
+    # T=8192/16384 single-chip: r4's wall was T=4096 (the merged
+    # backward overflowed the DEFAULT 16MB scoped-VMEM limit, and
+    # nothing fit at 8k); the raised per-shape VMEM limit
+    # (flash_attention._vmem_limit) runs the merged kernel clean to 16k.
     lc8k = _attempt(
         lambda: bench_longcontext_lm(seq_len=8192, batch=2, steps=4),
         "longcontext_lm_8k",
+        retries=0,
+    )
+    lc16k = _attempt(
+        lambda: bench_longcontext_lm(seq_len=16384, batch=1, steps=4),
+        "longcontext_lm_16k",
         retries=0,
     )
     moe = _attempt(bench_moe_lm, "moe_lm", retries=0)
@@ -455,7 +461,8 @@ def main():
                     "detail": {"error": r["error"], "transformer_base": thr,
                                "longcontext_lm": lc,
                                "longcontext_lm_4k": lc4k,
-                               "longcontext_lm_8k": lc8k, "moe_lm": moe,
+                               "longcontext_lm_8k": lc8k,
+                               "longcontext_lm_16k": lc16k, "moe_lm": moe,
                                "cpu_cross_size": cross,
                                "restore_paths": restore},
                 }
@@ -480,6 +487,7 @@ def main():
                     "longcontext_lm": _lm_summary(lc),
                     "longcontext_lm_4k": _lm_summary(lc4k),
                     "longcontext_lm_8k": _lm_summary(lc8k),
+                    "longcontext_lm_16k": _lm_summary(lc16k),
                     "moe_lm": _lm_summary(moe),
                     "cpu_cross_size": (
                         cross

@@ -242,7 +242,11 @@ def cmd_ingest(args) -> int:
     image/label pairs, ``edl ingest tokens`` for tokenized text.  The
     produced directory plugs into ``spec.dataset_dir`` /
     ``local-run --data-dir``."""
-    from edl_tpu.runtime.datasets import ingest_mnist_idx, ingest_tokens
+    from edl_tpu.runtime.datasets import (
+        MANIFEST,
+        ingest_mnist_idx,
+        ingest_tokens,
+    )
 
     if args.format == "mnist":
         if not (args.images and args.labels):
@@ -254,7 +258,9 @@ def cmd_ingest(args) -> int:
             print("error: ingest tokens needs --tokens", file=sys.stderr)
             return 2
         path = ingest_tokens(args.out, args.tokens, seq_len=args.seq_len)
-    with open(f"{path}/manifest.json") as f:
+    import os
+
+    with open(os.path.join(path, MANIFEST)) as f:
         print(f.read())
     return 0
 

@@ -14,7 +14,7 @@ the ring's normalized-partial merge.
 
 Backward — TWO implementations behind one dispatch (``_bwd_common``):
 
-- **merged** (T <= 2048): a single blockwise kernel with saved
+- **merged** (T <= 16384): a single blockwise kernel with saved
   residuals — the forward emits per-row logsumexp (O(T) stats,
   broadcast over STAT_LANES trailing values so tiles stay legal
   (sublane, lane) shapes), and ONE backward pass recomputes each
@@ -22,17 +22,19 @@ Backward — TWO implementations behind one dispatch (``_bwd_common``):
   accumulate in f32 VMEM scratch while Q tiles stream; the split
   dq/dkv formulation pays the score dot and the exp twice — merging
   them measured +15% tokens/s on the T=2048 LM).  Its VMEM footprint
-  grows with T (K/V + full-T scratch resident per bh): 512 tiles fit
-  at T=2048, overflow at T=4096, nothing fits at T=8192.
-- **streaming-K** (T > 2048): K blocks become the outer grid dim, so
+  grows with T; past T=2048 it needs the scoped-VMEM limit raised
+  above the 16MB default (``_vmem_limit`` — v5e has the physical
+  headroom), which measures 0.428 MFU at T=4096, 0.408 at 8192 and
+  0.388 at 16384 single-chip.
+- **streaming-K** (T > 16384): K blocks become the outer grid dim, so
   only one (block_k, d) K/V block + scratch is resident — VMEM use is
-  T-independent and T=8192 runs single-chip (measured 0.345 MFU at
-  batch 2; T=4096 0.381-0.389 vs 0.36 for the merged kernel's shrunken
-  tiles).  dQ comes out as per-K-block f32 partials summed by XLA.
+  T-independent at any context length.  dQ comes out as per-K-block
+  f32 partials summed by XLA, and the softmax correction delta arrives
+  precomputed (per row, not per K block).
 
-The softmax correction delta = rowsum(dO * O) is computed in-kernel
-from the O/dO tiles, so nothing O(T^2) — and no extra stats array —
-ever hits HBM in either direction.
+In the merged kernel the softmax correction delta = rowsum(dO * O) is
+computed in-kernel from the O/dO tiles, so nothing O(T^2) — and no
+extra stats array — ever hits HBM in either direction.
 
 Masking: ``causal`` masks by absolute position inside the kernel (and
 skips fully-masked K tiles); ``kv_mask`` ([B, Tk] bool, True = valid)
@@ -89,15 +91,38 @@ def _pick_block(t: int, want: int) -> int:
 
 
 #: Context length above which the backward switches from the merged
-#: single-pass kernel (K/V + full-T dK/dV scratch resident per bh —
-#: fastest, but VMEM-bound: 512 tiles fit at T=2048, overflow at
-#: T=4096, and nothing fits at T=8192) to the streaming-K kernel
-#: (VMEM use independent of T; dQ summed from per-K-block partials).
-_MERGED_BWD_MAX_T = 2048
+#: single-pass kernel to the streaming-K kernel.  The merged kernel's
+#: residency (K/V + full-T dK/dV f32 scratch per bh) grows with T, but
+#: v5e's physical VMEM is far above the 16MB default scoped limit:
+#: raising ``vmem_limit_bytes`` (see ``_vmem_limit``) runs it clean to
+#: T=16384 — measured 0.428 MFU at T=4096 (vs 0.389 streaming-K),
+#: 0.408 at 8192, 0.388 at 16384.  Streaming-K (VMEM-independent of T)
+#: remains the fallback beyond.
+_MERGED_BWD_MAX_T = 16384
 
 #: Test hook: force a backward implementation ("merged" | "streamk");
 #: None = pick by _MERGED_BWD_MAX_T.
 _BWD_IMPL_OVERRIDE = None
+
+
+def _vmem_limit(tk: int, d: int):
+    """Scoped-VMEM limit for long-context kernels: None keeps the 16MB
+    default (T <= 2048 fits it); beyond, the merged backward's
+    residency is ~12 bytes/key-position/lane (K, V bf16 + dK/dV f32
+    scratch), so grant 4x that over the baseline, capped at 100MB
+    (64MB measured sufficient at T=16384 on v5e)."""
+    if tk <= 2048:
+        return None
+    return min(16 * 1024 * 1024 + 4 * tk * d * 12, 100 * 1024 * 1024)
+
+
+def _compiler_params(tk: int, d: int):
+    limit = _vmem_limit(tk, d)
+    if limit is None:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(vmem_limit_bytes=limit)
+    }
 
 
 #: Streaming-K backward tile defaults (tk > _MERGED_BWD_MAX_T), from
@@ -258,6 +283,7 @@ def _flash_fwd_3d(q, k, v, mask, causal, scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, tq, STAT_LANES), jnp.float32),
         ],
         interpret=interpret,
+        **_compiler_params(tk, d),
     )(*args)
 
 
@@ -422,14 +448,15 @@ def _flash_bwd_3d(
             pltpu.VMEM((tk, d), jnp.float32),
         ],
         interpret=interpret,
+        **_compiler_params(tk, d),
     )(*args)
     return dq, dk, dv
 
 
 def _bwd_streamk_kernel(
-    q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, mask_ref,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
     dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-    *, scale, causal, num_i, has_mask, has_glse,
+    *, scale, causal, num_i, has_mask,
 ):
     """Streaming-K backward: grid (BH, Tk/block_k, Tq/block_q).
 
@@ -438,12 +465,18 @@ def _bwd_streamk_kernel(
     tiles and fits NOTHING at T=8192.  Here K blocks are the OUTER grid
     dim: only one (block_k, d) K/V block and its (block_k, d) dK/dV
     scratch are resident — VMEM use is T-independent, so 512 tiles run
-    at any context length.  The price: Q/O/dO/lse tiles re-stream per K
+    at any context length.  The price: Q/dO/stat tiles re-stream per K
     block, and dQ comes out as per-K-block PARTIALS (f32,
     [BH, num_j, Tq, D]) summed by XLA afterwards — in-kernel dQ
     accumulation across the grid would need non-consecutive output
     revisits, which Pallas TPU does not keep (same dead end as the
-    fused-xent merge attempt)."""
+    fused-xent merge attempt).
+
+    Unlike the merged kernel, the softmax correction delta =
+    rowsum(dO * O) [- glse] arrives PRECOMPUTED (one cheap XLA
+    elementwise reduce per backward): computing it in-kernel would
+    re-read the O tile and redo the rowsum once per K block instead of
+    once per row."""
     j = pl.program_id(1)
     i = pl.program_id(2)
 
@@ -466,13 +499,9 @@ def _bwd_streamk_kernel(
         needs_mask_pred = i * block_q < (j + 1) * block_k - 1
 
     def compute():
-        ob = o_ref[0].astype(jnp.float32)
         dob = do_ref[0]
-        dob_f32 = dob.astype(jnp.float32)
         lse = _row_stat(lse_ref[0])  # [bq, 1]
-        delta = jnp.sum(dob_f32 * ob, axis=-1, keepdims=True)
-        if has_glse:
-            delta = delta - _row_stat(glse_ref[0])
+        delta = _row_stat(delta_ref[0])  # [bq, 1]
         s = scale * jax.lax.dot_general(
             qb, kb,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -550,29 +579,34 @@ def _flash_bwd_streamk_3d(
     num_i = tq // block_q
     num_j = tk // block_k
 
+    # Precompute the softmax correction once per ROW (the merged kernel
+    # derives it per Q tile from the O/dO tiles; here every K block
+    # would redo it): delta = rowsum(dO * O) [- glse], STAT_LANES-
+    # broadcast like the lse residual.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # [bh, tq]
+    if has_glse:
+        delta = delta - glse[:, :, 0]
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, tq, STAT_LANES))
+
     kernel = functools.partial(
         _bwd_streamk_kernel,
-        scale=scale, causal=causal, num_i=num_i,
-        has_mask=has_mask, has_glse=has_glse,
+        scale=scale, causal=causal, num_i=num_i, has_mask=has_mask,
     )
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # q
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),       # k
         pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),       # v
-        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # o
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),       # do
         pl.BlockSpec(
             (1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)
         ),                                                              # lse
+        pl.BlockSpec(
+            (1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)
+        ),                                                              # delta
     ]
-    args = [q, k, v, o, do, lse]
-    if has_glse:
-        in_specs.append(
-            pl.BlockSpec(
-                (1, block_q, STAT_LANES), lambda b, j, i: (b, i, 0)
-            )
-        )
-        args.append(glse)
+    args = [q, k, v, do, lse, delta]
     if has_mask:
         in_specs.append(
             pl.BlockSpec(
@@ -581,7 +615,7 @@ def _flash_bwd_streamk_3d(
         )
         args.append(mask)
     dqp, dk, dv = pl.pallas_call(
-        _adapt_optional(kernel, 6, (has_glse, has_mask)),
+        _adapt_optional(kernel, 6, (has_mask,)),
         grid=(bh, num_j, num_i),
         in_specs=in_specs,
         out_specs=[
@@ -599,6 +633,7 @@ def _flash_bwd_streamk_3d(
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        **_compiler_params(block_k, d),
     )(*args)
     dq = jnp.sum(dqp, axis=1).astype(q.dtype)
     return dq, dk, dv
@@ -745,8 +780,14 @@ def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
         # Merged backward: forward-size tiles (fastest measured).
         dq_want, dk_want = block_q, block_k
     else:
-        # Streaming-K backward: its own sweep's optimum.
-        dq_want, dk_want = _STREAMK_BWD_BLOCK_Q, _STREAMK_BWD_BLOCK_K
+        # Streaming-K backward: its swept optimum, with block_k scaled
+        # up at extreme T so the dQ partial buffer ([bh, tk/block_k,
+        # tq, d] f32) stays bounded at <= 8 K blocks' worth — the
+        # fallback must not trade a VMEM wall for an HBM one.  Contexts
+        # this long are really the sp ring axis's job (O(T/ring) per
+        # chip); this just keeps single-chip correctness available.
+        dq_want = _STREAMK_BWD_BLOCK_Q
+        dk_want = max(_STREAMK_BWD_BLOCK_K, tk // 8)
     bwd_block_q = _pick_block(tq, bwd_block_q or dq_want)
     bwd_block_k = _pick_block(tk, bwd_block_k or dk_want)
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]
@@ -772,10 +813,11 @@ def flash_attention(
     ``kv_mask``: optional [B, Tk] bool (True = attend) for padded
     batches.  ``bwd_block_q``/``bwd_block_k`` tile the backward
     independently (it carries dK/dV scratch, so its VMEM ceiling —
-    and sweet spot — differ from the forward's): up to T=2048 the
-    merged backward runs at the forward tiles; beyond, the streaming-K
-    backward runs at its own swept optimum (256 x 2048 — see
-    ``_STREAMK_BWD_BLOCK_Q/K``).  ``interpret=None``
+    and sweet spot — differ from the forward's): up to T=16384 the
+    merged backward runs at the forward tiles under a per-shape
+    raised VMEM limit (``_vmem_limit``); beyond, the streaming-K
+    backward runs at its swept optimum (256 x 2048, block_k scaled so
+    its dQ-partials buffer stays bounded).  ``interpret=None``
     auto-selects: real kernel on TPU, Pallas interpreter elsewhere
     (tests on the CPU mesh take this path)."""
     return _flash(

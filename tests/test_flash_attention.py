@@ -183,10 +183,10 @@ def test_flash_attention_with_lse_kv_mask_gradients():
 
 
 def test_streamk_backward_matches_merged():
-    """The streaming-K backward (T > 2048 dispatch; VMEM-independent of
-    T) must produce the same gradients as the merged kernel on every
-    masking variant, including the differentiable-lse path the ring
-    combiner uses."""
+    """The streaming-K backward (the T > 16384 fallback; VMEM use
+    independent of T) must produce the same gradients as the merged
+    kernel on every masking variant, including the differentiable-lse
+    path the ring combiner uses."""
     import importlib
 
     import jax
@@ -242,18 +242,26 @@ def test_streamk_backward_matches_merged():
 
 
 def test_streamk_dispatch_thresholds():
-    """tk <= 2048 takes the merged kernel with forward tiles; beyond it
-    the streaming-K defaults (256 x 2048) apply."""
+    """The merged kernel (forward-size tiles + raised VMEM limit)
+    serves up to T=16384; beyond it the streaming-K defaults
+    (256 x 2048) apply."""
     import importlib
 
     fa = importlib.import_module("edl_tpu.ops.flash_attention")
     import jax.numpy as jnp
 
-    q2k = jnp.zeros((1, 2048, 1, 16), jnp.bfloat16)
-    prep = fa._prep(q2k, q2k, True, None, None, None, None, None, None, True)
-    _, _, _, bq, bk, bwd_q, bwd_k, _ = prep
-    assert (bwd_q, bwd_k) == (bq, bk) == (512, 512)
-    q4k = jnp.zeros((1, 4096, 1, 16), jnp.bfloat16)
-    prep = fa._prep(q4k, q4k, True, None, None, None, None, None, None, True)
+    for t in (2048, 4096, 16384):
+        q = jnp.zeros((1, t, 1, 16), jnp.bfloat16)
+        prep = fa._prep(q, q, True, None, None, None, None, None, None, True)
+        _, _, _, bq, bk, bwd_q, bwd_k, _ = prep
+        assert (bwd_q, bwd_k) == (bq, bk) == (512, 512), (t, prep)
+    q32k = jnp.zeros((1, 32768, 1, 16), jnp.bfloat16)
+    prep = fa._prep(q32k, q32k, True, None, None, None, None, None, None, True)
     _, _, _, _, _, bwd_q, bwd_k, _ = prep
-    assert (bwd_q, bwd_k) == (256, 2048)
+    # block_k scales with T past the merged ceiling so the dQ-partials
+    # buffer stays bounded at <= 8 K blocks' worth.
+    assert (bwd_q, bwd_k) == (256, 32768 // 8)
+    # VMEM policy: default limit at short T, scaled + capped beyond.
+    assert fa._vmem_limit(2048, 64) is None
+    assert fa._vmem_limit(4096, 64) == 16 * 1024 * 1024 + 4 * 4096 * 64 * 12
+    assert fa._vmem_limit(1 << 20, 64) == 100 * 1024 * 1024

@@ -27,10 +27,12 @@ Backward — TWO implementations behind one dispatch (``_bwd_common``):
   headroom), which measures 0.428 MFU at T=4096, 0.408 at 8192 and
   0.388 at 16384 single-chip.
 - **streaming-K** (T > 16384): K blocks become the outer grid dim, so
-  only one (block_k, d) K/V block + scratch is resident — VMEM use is
-  T-independent at any context length.  dQ comes out as per-K-block
-  f32 partials summed by XLA, and the softmax correction delta arrives
-  precomputed (per row, not per K block).
+  only one (block_k, d) K/V block + scratch is resident — VMEM use
+  depends on block_k, not T (block_k grows with T for fewer Q
+  re-streams, capped at 16384 to stay inside the VMEM grant; the dQ
+  partials buffer makes HBM the eventual bound at extreme T).  dQ
+  comes out as per-K-block f32 partials summed by XLA, and the softmax
+  correction delta arrives precomputed (per row, not per K block).
 
 In the merged kernel the softmax correction delta = rowsum(dO * O) is
 computed in-kernel from the O/dO tiles, so nothing O(T^2) — and no
@@ -464,8 +466,9 @@ def _bwd_streamk_kernel(
     scratch resident per bh, which overflows VMEM past T=2048 at 512
     tiles and fits NOTHING at T=8192.  Here K blocks are the OUTER grid
     dim: only one (block_k, d) K/V block and its (block_k, d) dK/dV
-    scratch are resident — VMEM use is T-independent, so 512 tiles run
-    at any context length.  The price: Q/dO/stat tiles re-stream per K
+    scratch are resident — VMEM use depends on block_k, not T (see
+    ``_prep`` for the growth/cap policy); HBM for the dQ partials is
+    the eventual bound.  The price: Q/dO/stat tiles re-stream per K
     block, and dQ comes out as per-K-block PARTIALS (f32,
     [BH, num_j, Tq, D]) summed by XLA afterwards — in-kernel dQ
     accumulation across the grid would need non-consecutive output
@@ -782,12 +785,14 @@ def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
     else:
         # Streaming-K backward: its swept optimum, with block_k scaled
         # up at extreme T so the dQ partial buffer ([bh, tk/block_k,
-        # tq, d] f32) stays bounded at <= 8 K blocks' worth — the
-        # fallback must not trade a VMEM wall for an HBM one.  Contexts
-        # this long are really the sp ring axis's job (O(T/ring) per
-        # chip); this just keeps single-chip correctness available.
+        # tq, d] f32) stays near 8 K blocks' worth — the fallback must
+        # not trade a VMEM wall for an HBM one — but capped so the
+        # (block_k, d) f32 scratch pair itself stays well inside the
+        # raised VMEM grant.  Contexts this long are really the sp
+        # ring axis's job (O(T/ring) per chip); this just keeps
+        # single-chip correctness available as far as HBM allows.
         dq_want = _STREAMK_BWD_BLOCK_Q
-        dk_want = max(_STREAMK_BWD_BLOCK_K, tk // 8)
+        dk_want = min(max(_STREAMK_BWD_BLOCK_K, tk // 8), 16384)
     bwd_block_q = _pick_block(tq, bwd_block_q or dq_want)
     bwd_block_k = _pick_block(tk, bwd_block_k or dk_want)
     mask = None if kv_mask is None else kv_mask.astype(jnp.int32)[:, None, :]

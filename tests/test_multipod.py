@@ -897,3 +897,75 @@ def test_multipod_durable_checkpoint_survives_whole_world_loss(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+def test_multipod_layout_with_durable_checkpoint_massacre(tmp_path):
+    """The round's two headline features COMPOSED: a dp x fsdp layout
+    job (EDL_PARALLELISM=fsdp=2, params sharded over each pod's 2
+    chips) with a durable checkpoint dir survives a whole-world SIGKILL
+    — the replacement pods cold-load the spilled (host-assembled,
+    full-value) checkpoint and reshard it onto the rebuilt layout mesh."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    ckpt_dir = tmp_path / "durable"
+    coord = LocalCoordinator(
+        target_world=2, max_world=2, heartbeat_timeout=15.0, legal_sizes=[1, 2]
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {w: tmp_path / f"{w}.jsonl" for w in ("l1", "l2", "l3", "l4")}
+    procs = []
+    env = {"EDL_CHECKPOINT_DIR": str(ckpt_dir)}
+
+    def spawn(name, base_port):
+        return _spawn_worker(
+            procs, hist, name, base_port, caddr,
+            devices=2, gbs=16, entrypoint="mnist", parallelism="fsdp=2",
+            checkpoint_interval=3, extra_env=env,
+        )
+
+    try:
+        spawn("l1", 11600)
+        spawn("l2", 11660)
+        _wait_for(
+            lambda: len(_read_history(hist["l1"])) >= 7
+            and any(ckpt_dir.glob("ckpt-*.json")),
+            300,
+            "sharded world past a durable checkpoint",
+            procs,
+        )
+        for p in list(procs):
+            p.kill()
+            p.wait(timeout=30)
+        procs.clear()
+        spilled = sorted(
+            int(f.name[len("ckpt-"):-len(".json")])
+            for f in ckpt_dir.glob("ckpt-*.json")
+        )
+        assert spilled and spilled[-1] > 0
+
+        spawn("l3", 11720)
+        spawn("l4", 11780)
+        _wait_for(
+            lambda: len(_read_history(hist["l3"])) >= 5,
+            300,
+            "restarted sharded world stepping",
+            procs,
+        )
+        post = _read_history(hist["l3"])
+        assert min(r["step"] for r in post) >= spilled[0], (
+            f"replayed from {min(r['step'] for r in post)}, had {spilled}"
+        )
+        cold = _read_resizes(hist["l3"])[-1]
+        assert cold["restored_step"] >= spilled[0] > 0, cold
+        assert all(math.isfinite(r["loss"]) for r in post)
+        # The restarted world is STILL the layout mesh (2 pods x 2
+        # chips, dp2 x fsdp2): its formations span 4 devices.
+        formations = _read_formations(hist["l3"])
+        assert formations and formations[-1]["devices"] == 4
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

@@ -4,7 +4,14 @@ Importing this package registers all built-in models with
 ``edl_tpu.models.base.get_model``.
 """
 
-from edl_tpu.models.base import ModelDef, get_model, register_model, registered_models
+from edl_tpu.models.base import (
+    ModelDef,
+    bind_model,
+    get_model,
+    load_workspace_factory,
+    register_model,
+    registered_models,
+)
 
 # Built-ins register on import.
 import edl_tpu.models.fit_a_line  # noqa: F401
@@ -15,4 +22,11 @@ import edl_tpu.models.transformer_lm  # noqa: F401
 import edl_tpu.models.moe  # noqa: F401
 import edl_tpu.models.pipeline_lm  # noqa: F401
 
-__all__ = ["ModelDef", "get_model", "register_model", "registered_models"]
+__all__ = [
+    "ModelDef",
+    "bind_model",
+    "get_model",
+    "load_workspace_factory",
+    "register_model",
+    "registered_models",
+]

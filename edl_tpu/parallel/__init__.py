@@ -10,8 +10,9 @@ from edl_tpu.parallel.mesh import (
     dp_mesh,
     batch_sharding,
     replicated_sharding,
+    hint_activation,
 )
-from edl_tpu.parallel.pipeline import pipeline_apply
+from edl_tpu.parallel.pipeline import pipeline_1f1b_loss, pipeline_apply
 
 __all__ = [
     "AXIS_DP",
@@ -25,5 +26,7 @@ __all__ = [
     "dp_mesh",
     "batch_sharding",
     "replicated_sharding",
+    "hint_activation",
     "pipeline_apply",
+    "pipeline_1f1b_loss",
 ]

@@ -1,4 +1,20 @@
 from edl_tpu.runtime.train import TrainState, Trainer
 from edl_tpu.runtime.data import ShardedDataIterator
+from edl_tpu.runtime.datasets import (
+    ingest_mnist_idx,
+    ingest_tokens,
+    load_array_store,
+    save_array_store,
+    stage_synthetic,
+)
 
-__all__ = ["TrainState", "Trainer", "ShardedDataIterator"]
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "ShardedDataIterator",
+    "ingest_mnist_idx",
+    "ingest_tokens",
+    "load_array_store",
+    "save_array_store",
+    "stage_synthetic",
+]

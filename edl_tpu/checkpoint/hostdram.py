@@ -276,13 +276,31 @@ class HostDRAMStore:
         th.start()
         return th
 
-    def wait(self):
-        """Block until all in-flight saves have landed; re-raise errors."""
+    def wait(self, timeout: Optional[float] = None):
+        """Block until all in-flight saves have landed; re-raise errors.
+
+        ``timeout``: optional TOTAL seconds to wait across all pending
+        saves.  On expiry the still-running threads are re-tracked (a
+        later wait can finish the join) and the method returns after
+        the usual error drain — the broken-world path uses this so a
+        save blocked on a dead peer's collective cannot hang recovery
+        (it proceeds and leaks the thread, matching the leak-not-wait
+        philosophy of the rest of that path)."""
         with self._lock:
             pending = list(self._pending)
             self._pending.clear()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        still_alive = []
         for th in pending:
-            th.join()
+            if deadline is None:
+                th.join()
+            else:
+                th.join(max(0.0, deadline - time.monotonic()))
+                if th.is_alive():
+                    still_alive.append(th)
+        if still_alive:
+            with self._lock:
+                self._pending.extend(still_alive)
         with self._lock:
             if self._save_errors:
                 err = self._save_errors[0]

@@ -232,6 +232,18 @@ def make_world_builder(
 
     import jax
 
+    # Elastic worlds do not use jax's preemption sync service (our
+    # preemption/failure handling is lease-based through the job
+    # coordinator), and its polling thread is one of the C++ threads
+    # that can terminate() a SURVIVOR after an ungraceful peer death
+    # (observed: "Failed to retrieve preemption notice ... Socket
+    # closed" followed by std::bad_cast while holding for a missing
+    # cross-pod-tp peer).  Disable it outright.
+    try:
+        jax.config.update("jax_enable_preemption_service", False)
+    except Exception:  # pragma: no cover - option renamed/removed
+        pass
+
     # Defuse the coordination service's poison pill.  By default the
     # distributed client's missed-heartbeat callback LOG(QFATAL)s the
     # process when the service reports a peer failure OR when a
@@ -241,7 +253,18 @@ def make_world_builder(
     # log-only callback, so peer death surfaces as a *catchable*
     # collective error in the step (handled by ElasticTrainer's
     # broken-world path) instead of process termination.
-    _install_nonfatal_heartbeat_callback()
+    if os.environ.get("EDL_NO_HB_PATCH") == "1":
+        # Diagnostic escape hatch only: without the patch, ANY peer
+        # failure terminates every pod via the default QFATAL callback.
+        import sys as _sys
+
+        print(
+            "[edl] EDL_NO_HB_PATCH=1: heartbeat patch DISABLED — "
+            "ungraceful peer death will terminate peer processes",
+            file=_sys.stderr,
+        )
+    else:
+        _install_nonfatal_heartbeat_callback()
 
     broken = [False]
     #: dead worlds' distributed handles, kept referenced so their C++

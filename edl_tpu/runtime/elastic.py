@@ -655,14 +655,31 @@ class ElasticTrainer:
 
     def _world_broken(self) -> None:
         """The live process group failed mid-step.  Drop every handle to
-        it and hold for a fresh generation (see maybe_resize).  Tell the
-        world builder the group is unbarrierable so its next teardown
-        skips the shutdown barrier (dead peers never arrive, and the
-        barrier-failure propagation can kill the survivor from a C++
-        thread — see launcher.make_world_builder)."""
-        mark = getattr(self.world_builder, "mark_broken", None)
-        if mark is not None:
-            mark()
+        it and hold for a fresh generation (see maybe_resize).
+
+        The dead world's distributed handles are graveyarded NOW (via
+        the builder's barrier-free ``leak_dead_world``), not at the
+        next formation: when no legal world exists (e.g. a cross-pod
+        tp layout missing a peer), the hold can last minutes, and a
+        still-installed client's error-polling thread will terminate()
+        the survivor from C++ (std::bad_cast) once the coordination
+        service notices the dead peer's dropped connection — observed
+        in the cross-pod tp SIGKILL test.  Burying immediately also
+        keeps the next formation's teardown a no-op."""
+        # Drain in-flight checkpoint saves first (bounded: a save
+        # blocked in a dead peer's collective must not hang recovery —
+        # on expiry the thread is leaked like the world's handles):
+        # burying clears the backends, and a save thread mid-device_get
+        # should not have the buffers die under it.  Errors are
+        # expected (the world the save was reading is dead) and must
+        # not linger in the store — a LATER healthy flush's wait()
+        # would re-raise them and spuriously degrade an unrelated
+        # resize to the replay path.
+        try:
+            self.store.wait(timeout=5.0)
+        except Exception:
+            pass
+        self._leak_dead_world()
         self.state = None
         self._world_members = ()
         self._trainers.clear()
@@ -748,10 +765,11 @@ class ElasticTrainer:
                 elif hold_started is None:
                     hold_started = now
                 elif now - hold_started > self.barrier_timeout:
-                    # A broken world's handles may still be live here
-                    # (teardown only runs at the NEXT formation, which
-                    # never came): abandon them barrier-free so exit
-                    # destructors can't mask this diagnostic.
+                    # BROKEN worlds were already buried by _world_broken;
+                    # this covers the un-broken case (a healthy world
+                    # whose plan shrank to unformable): abandon its
+                    # handles barrier-free so exit destructors can't
+                    # mask this diagnostic.
                     self._leak_dead_world()
                     raise RuntimeError(
                         f"held at resize barrier > {self.barrier_timeout}s "
@@ -862,12 +880,18 @@ class ElasticTrainer:
 
     def _leak_dead_world(self) -> None:
         """Best-effort barrier-free abandonment of the current world's
-        distributed handles, for fatal exit paths (see
-        launcher.make_world_builder's leak_dead_world)."""
+        distributed handles (see launcher.make_world_builder's
+        leak_dead_world).  FatalWorldError — the graveyard's leak
+        budget — must keep propagating: the broken-world recovery path
+        calls this too, and a process that survives 32 ungraceful
+        world deaths must exit loudly, not swallow the cap and leak
+        clients/ports forever."""
         leak = getattr(self.world_builder, "leak_dead_world", None)
         if leak is not None:
             try:
                 leak()
+            except FatalWorldError:
+                raise
             except Exception:
                 pass
 

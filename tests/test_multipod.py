@@ -969,3 +969,124 @@ def test_multipod_layout_with_durable_checkpoint_massacre(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+def test_multipod_cross_pod_tensor_parallel_hold_and_recover(tmp_path):
+    """A tp=2 layout SPANNING pods (1 chip each): the model's kernels
+    shard across processes and every forward runs cross-pod
+    collectives.  With the layout, only even worlds are legal, so when
+    one pod is SIGKILLed there is NO formable world until a
+    replacement arrives.  The SYSTEM must recover: ideally the
+    survivor holds at the resize barrier (world_size 0) and re-forms
+    when the replacement registers; jaxlib's coordination service can
+    also terminate() pods from its C++ error-poll thread
+    (std::bad_cast — no Python-level defense exists), in which case
+    the Job controller restarts them and recovery flows through the
+    durable checkpoint dir.  This test emulates the Job controller (a
+    restart pool, like kubelet + backoffLimit) and requires that SOME
+    re-formed 2-pod sharded world trains past the pre-kill step
+    without ever replaying from step 0."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(
+        target_world=2, max_world=2, heartbeat_timeout=8.0, legal_sizes=[2]
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {}
+    procs = []
+    env = {"EDL_CHECKPOINT_DIR": str(tmp_path / "durable")}
+    next_id = [0]
+
+    def spawn():
+        name = f"t{next_id[0]}"
+        next_id[0] += 1
+        hist[name] = tmp_path / f"{name}.jsonl"
+        return _spawn_worker(
+            procs, hist, name, 11900 + 30 * next_id[0], caddr,
+            devices=1, gbs=16, entrypoint="mnist", parallelism="tp=2",
+            checkpoint_interval=3, extra_env=env,
+        )
+
+    try:
+        spawn()
+        t_victim = spawn()
+        first = hist["t0"]
+        _wait_for(
+            lambda: len(_read_history(first)) >= 7,
+            300,
+            "the cross-pod tp world to step",
+            procs,
+        )
+        mark = max(r["step"] for r in _read_history(first))
+
+        # Ungraceful peer death: no formable world remains.
+        t_victim.kill()
+        t_victim.wait(timeout=30)
+        procs.remove(t_victim)
+        spawn()  # the replacement pod
+
+        # Job-controller emulation: restart any pod the coordination
+        # service's error propagation kills, up to a restart budget.
+        deadline = time.monotonic() + 300
+        restarts = 0
+        while time.monotonic() < deadline:
+            if any(
+                r["step"] > mark + 3
+                for h in hist.values()
+                for r in _read_history(h)
+            ):
+                break
+            for pr in list(procs):
+                if pr.poll() is not None:
+                    procs.remove(pr)
+                    restarts += 1
+                    assert restarts <= 6, "restart budget exhausted"
+                    spawn()
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                "re-formed tp world never passed the pre-kill step; "
+                f"restarts={restarts}"
+            )
+
+        all_recs = [r for h in hist.values() for r in _read_history(h)]
+        assert all(math.isfinite(r["loss"]) for r in all_recs)
+        # THE recovery property: every pod spawned AFTER the kill (t2+)
+        # resumed from a checkpoint — a from-scratch re-init would
+        # record step 0 and, with deterministic init+data, silently
+        # reproduce the original losses, so only this step floor
+        # catches that regression.
+        for name, h in hist.items():
+            if name in ("t0", "t1"):
+                continue
+            steps = [r["step"] for r in _read_history(h)]
+            if steps:
+                assert min(steps) > 0, (
+                    f"{name} replayed from step {min(steps)} — "
+                    "recovery did not come from a checkpoint"
+                )
+        post = [
+            r
+            for h in hist.values()
+            for r in _read_history(h)
+            if h != hist["t1"]  # the SIGKILLed victim's partial log
+        ]
+        by_step = {}
+        for r in sorted(post, key=lambda r: r["step"]):
+            if r["step"] in by_step:
+                # replays are deterministic (same restored state +
+                # deterministic data)
+                assert abs(r["loss"] - by_step[r["step"]]) < 1e-4
+            by_step[r["step"]] = r["loss"]
+        # Every formation spans exactly 2 single-chip pods (the
+        # sharded layout, never a degenerate world).
+        for h in hist.values():
+            for f in _read_formations(h):
+                assert f["devices"] == 2, f
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

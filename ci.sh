@@ -9,4 +9,8 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 python tools/lint.py
-python -m pytest tests/ -x -q "$@"
+# Tier-1: the full quick suite INCLUDING the seeded single-cycle chaos
+# soak (tests/test_chaos.py).  The multi-cycle soak is marked `slow`
+# and excluded so the tier-1 budget (870s) holds; run it explicitly
+# with `./ci.sh -m slow` (the -m below is overridden by a later -m).
+python -m pytest tests/ -x -q -m "not slow" "$@"

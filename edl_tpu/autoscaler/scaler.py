@@ -199,6 +199,10 @@ class Autoscaler:
         controller choose its own victims (``pkg/autoscaler.go:
         339-376``), which can kill an active-world member and turn a
         graceful resize into a lease-timeout + replay."""
+        import sys
+
+        from edl_tpu.cluster.cluster import ParallelismUpdateError
+
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
@@ -208,7 +212,18 @@ class Autoscaler:
                 client = self._retarget(job, parallelism)
                 if client is not None:
                     self._delete_dropped_members(job, client)
-            self.cluster.update_parallelism(job, parallelism)
+            try:
+                self.cluster.update_parallelism(job, parallelism)
+            except ParallelismUpdateError as e:
+                # Conflict storm outlasted the bounded retry policy:
+                # skip THIS job this tick (the dry run recomputes from
+                # live state in 5s) instead of crashing the whole tick.
+                print(
+                    f"[edl-autoscaler] parallelism PUT for {name} -> "
+                    f"{parallelism} gave up ({e}); retrying next tick",
+                    file=sys.stderr,
+                )
+                continue
             if not scale_down:
                 self._retarget(job, parallelism)
 

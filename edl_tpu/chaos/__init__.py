@@ -1,0 +1,28 @@
+"""Deterministic chaos injection for the EDL-TPU stack.
+
+One seeded ``FaultSchedule`` drives named injection points through the
+four layers where real failures happen — coordinator membership, the
+coord_service HTTP transport, the checkpoint store, and kube actuation
+— so every chaos run is bit-reproducible and every robustness claim
+has a test (``tests/test_chaos.py``).  See README.md "Fault model &
+chaos harness".
+"""
+
+from edl_tpu.chaos.schedule import KNOWN_POINTS, FaultEvent, FaultSchedule
+from edl_tpu.chaos.membership import ChaosCoordinator
+from edl_tpu.chaos.transport import ChaosHTTPCoordinator
+from edl_tpu.chaos.kubeapi import ChaosKube
+from edl_tpu.chaos.storage import corrupt_checkpoint, corrupt_newest
+from edl_tpu.chaos.monkey import ChaosMonkey
+
+__all__ = [
+    "KNOWN_POINTS",
+    "FaultEvent",
+    "FaultSchedule",
+    "ChaosCoordinator",
+    "ChaosHTTPCoordinator",
+    "ChaosKube",
+    "ChaosMonkey",
+    "corrupt_checkpoint",
+    "corrupt_newest",
+]

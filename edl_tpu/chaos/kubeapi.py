@@ -1,0 +1,61 @@
+"""Kube actuation chaos: ConflictError storms and scheduling holds.
+
+``ChaosKube`` wraps any ``KubeAPI`` (normally ``FakeKube``) so the
+control plane's actuation path — ``Cluster.update_parallelism`` and
+the autoscaler tick above it — runs against the failure modes a real
+API server produces:
+
+- ``kube.conflict``: the next N ``update_workload`` calls raise
+  ``ConflictError`` (optimistic-concurrency storm: a hot controller
+  fighting over the same Job object).  Budgets above the retry
+  policy's attempts exercise the typed give-up path
+  (``cluster.ParallelismUpdateError``) the autoscaler must log-and-skip.
+- ``kube.hold`` / ``kube.release``: a job's pods stick ``Pending``
+  (scheduling hold — capacity crunch, taints) and later release.
+  Requires a ``FakeKube`` inner (uses its ``hold_pending`` knob).
+"""
+
+from __future__ import annotations
+
+from edl_tpu.chaos.schedule import FaultSchedule
+from edl_tpu.cluster.kube import ConflictError
+
+
+class ChaosKube:
+    """Delegating ``KubeAPI`` wrapper; pass anywhere a ``KubeAPI``
+    goes (``Cluster(ChaosKube(FakeKube(...), schedule))``)."""
+
+    def __init__(self, inner, schedule: FaultSchedule):
+        self._inner = inner
+        self.schedule = schedule
+        self._conflict_budget = 0
+        self.injected_conflicts = 0
+
+    def _pull_events(self) -> None:
+        for ev in self.schedule.due("kube.conflict"):
+            self._conflict_budget += int(ev.arg or 1)
+        for ev in self.schedule.due("kube.hold"):
+            self._inner.hold_pending.add(ev.arg)
+        released = False
+        for ev in self.schedule.due("kube.release"):
+            self._inner.hold_pending.discard(ev.arg)
+            released = True
+        if released and hasattr(self._inner, "retry_scheduling"):
+            self._inner.retry_scheduling()
+
+    def update_workload(self, w):
+        self._pull_events()
+        if self._conflict_budget > 0:
+            self._conflict_budget -= 1
+            self.injected_conflicts += 1
+            raise ConflictError(
+                f"chaos: conflict storm (step {self.schedule.now})"
+            )
+        return self._inner.update_workload(w)
+
+    def list_pods(self):
+        self._pull_events()  # holds/releases land on the read path too
+        return self._inner.list_pods()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

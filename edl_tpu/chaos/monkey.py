@@ -1,0 +1,139 @@
+"""The chaos driver: applies a ``FaultSchedule`` to a live world.
+
+``ChaosMonkey`` binds the schedule to the objects that make up one
+training world — the trainer, its coordinator (possibly wrapped in
+``ChaosCoordinator``, possibly reached over a chaos HTTP transport),
+and its checkpoint store — and delivers the *driver-verb* events at
+step boundaries via ``ElasticTrainer.run(on_step=monkey.on_step)``:
+
+- ``scale.target``: the autoscaler's retarget (arg: new world size).
+- ``member.kill``: a trainer pod dies (graceful from the survivors'
+  view: their state is intact, the resize flushes).  arg: trainer id.
+- ``member.die_with_state``: a death that takes the live device state
+  with it (host loss mid-step): the next resize must fall back to the
+  last async checkpoint and REPLAY — deterministically, because data
+  is a pure function of (seed, step) (``runtime/data.py``).
+- ``member.restart``: a killed trainer rejoins.  arg: trainer id.
+- ``checkpoint.corrupt``: silently corrupt the newest stored snapshot
+  (see ``chaos.storage``); restore must detect via CRC and fall back.
+- ``coord.restart``: the coordinator loses all state; the monkey
+  re-registers the members it knows are live (the pods' own
+  re-register path, exercised separately, is timing-driven).
+
+Transport and in-store faults fire at their own injection points; the
+monkey only advances the shared chaos clock they read.
+
+Kills deregister through the coordinator's public API (the graceful-
+leave path).  Eviction-by-lease-timeout is real-time-driven and
+therefore lives in the non-deterministic chaos tests, not in the
+bit-reproducible soak.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from edl_tpu.chaos.schedule import FaultSchedule
+from edl_tpu.chaos.storage import corrupt_newest
+
+
+class ChaosMonkey:
+    """Step-boundary fault applier.  Pass ``on_step`` to
+    ``ElasticTrainer.run``; call ``live_members`` to seed the initial
+    membership it tracks."""
+
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        trainer,
+        coordinator=None,
+        store=None,
+        coordinator_factory: Optional[Callable[[], object]] = None,
+    ):
+        """``coordinator``: the handle the monkey kills/registers
+        through (defaults to ``trainer.coordinator``).
+        ``coordinator_factory``: builds a fresh inner coordinator for
+        ``coord.restart`` events (requires ``coordinator`` — or the
+        object it reaches — to be a ``ChaosCoordinator``)."""
+        self.schedule = schedule
+        self.trainer = trainer
+        self.coordinator = (
+            coordinator if coordinator is not None else trainer.coordinator
+        )
+        self.store = store if store is not None else trainer.store
+        self.coordinator_factory = coordinator_factory
+        self.live: List[str] = []
+        self.log: List[tuple] = []  # (step, verb, arg) as applied
+
+    def track(self, member_ids) -> "ChaosMonkey":
+        self.live = list(member_ids)
+        return self
+
+    # -- the hook ------------------------------------------------------------
+    def on_step(self, rec) -> None:
+        """ElasticTrainer.run on_step callback: advance the chaos clock
+        and apply every membership/storage event now due."""
+        step = rec.step
+        self.schedule.advance(step)
+        for ev in self.schedule.due("scale.target"):
+            self.coordinator.set_target_world(int(ev.arg))
+            self.log.append((step, "scale.target", ev.arg))
+        for ev in self.schedule.due("member.kill"):
+            self._kill(ev.arg)
+            self.log.append((step, "member.kill", ev.arg))
+        for ev in self.schedule.due("member.die_with_state"):
+            # Quiesce in-flight saves first so the restore point is
+            # the deterministic latest interval snapshot (the soak's
+            # bit-reproducibility contract); the "save still in flight
+            # at death" variant is non-deterministic by nature and is
+            # exercised by the save-thread chaos unit tests instead.
+            self.store.wait()
+            self.trainer.inject_failure()
+            self._kill(ev.arg)
+            self.log.append((step, "member.die_with_state", ev.arg))
+        for ev in self.schedule.due("member.restart"):
+            if ev.arg not in self.live:
+                self.live.append(ev.arg)
+            self.coordinator.register(ev.arg)
+            self.log.append((step, "member.restart", ev.arg))
+        for ev in self.schedule.due("checkpoint.corrupt"):
+            # Let in-flight saves land so the newest INTERVAL snapshot
+            # is the victim (deterministic: saves are step-indexed).
+            self.store.wait()
+            victim = corrupt_newest(self.store)
+            self.log.append((step, "checkpoint.corrupt", victim))
+        for ev in self.schedule.due("coord.restart"):
+            self._restart_coordinator()
+            self.log.append((step, "coord.restart", None))
+
+    # -- verbs ---------------------------------------------------------------
+    def _kill(self, member_id: str) -> None:
+        if member_id in self.live:
+            self.live.remove(member_id)
+        # The dead pod stops beating before it stops being registered
+        # (a kill is not a lease timeout here — see module docstring).
+        if member_id in getattr(self.trainer, "heartbeat_ids", ()):
+            self.trainer.heartbeat_ids.remove(member_id)
+        self.coordinator.deregister(member_id)
+
+    def _restart_coordinator(self) -> None:
+        if self.coordinator_factory is None:
+            raise ValueError(
+                "coord.restart scheduled but no coordinator_factory given"
+            )
+        target = self.coordinator
+        # The restart verb lives on ChaosCoordinator; reach it through
+        # an HTTP client is not possible — the soak hands the monkey
+        # the server-side wrapper in that case.
+        restart = getattr(target, "restart", None)
+        if restart is None:
+            raise TypeError(
+                "coord.restart needs a ChaosCoordinator (got "
+                f"{type(target).__name__})"
+            )
+        restart(self.coordinator_factory)
+        # Surviving pods re-register (their heartbeat KeyError path
+        # does this in deployment; the monkey does it synchronously so
+        # the soak stays step-deterministic).
+        for tid in self.live:
+            target.register(tid)

@@ -1,0 +1,168 @@
+"""Deterministic, seeded fault schedule — the clock of every chaos run.
+
+The reference EDL contract is "I will add and remove trainers at any
+time; you must tolerate membership churn" (PAPER.md §0).  Testing that
+contract with ad-hoc monkeypatching (the pre-chaos state of this repo,
+e.g. ``tests/test_elastic.py``'s hand-rolled "simulated collective
+failure") gives one-off, unreproducible failures.  This module gives
+every failure a **name**, a **step**, and a **seed**:
+
+- A ``FaultEvent`` is (step, point, arg): at/after global training step
+  ``step``, injection point ``point`` fires once with payload ``arg``.
+- A ``FaultSchedule`` holds the seed, the event list, and the current
+  step (advanced by the driver at step boundaries).  Consumers pull
+  their due events with ``due(point)``; one-shot semantics make a
+  replayed schedule fire the identical faults at the identical steps.
+- ``roll``/``rng`` derive per-point deterministic randomness from the
+  seed for rate-based faults (each point keeps its own draw counter, so
+  two points never share a stream).
+
+Injection points are free-form dotted names; the ones wired through
+the stack are listed in ``KNOWN_POINTS`` (docs + typo guard).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+#: every injection point threaded through the four layers (see the
+#: chaos wrappers); ``FaultSchedule(strict=True)`` rejects events
+#: naming anything else.
+KNOWN_POINTS = (
+    # (1) coordinator membership (chaos.membership + chaos.monkey)
+    "coord.heartbeat.drop",      # swallow the next N heartbeats
+    "coord.heartbeat.delay",     # back-date a member's lease by arg s
+    "coord.restart",             # coordinator process restart (state loss)
+    "member.kill",               # trainer pod dies mid-step (arg: id)
+    "member.die_with_state",     # kill + device state loss -> replay
+    "member.restart",            # killed trainer rejoins (arg: id)
+    "scale.target",              # autoscaler retarget (arg: world)
+    # (2) coord_service HTTP transport (chaos.transport)
+    "transport.refuse",          # next N requests: connection refused
+    "transport.timeout",         # next N requests: socket timeout
+    "transport.slow",            # next request delayed arg seconds
+    "transport.torn",            # next N responses: truncated JSON
+    # (3) checkpoint store (chaos.storage + hostdram hooks)
+    "checkpoint.save_thread",    # async save worker dies
+    "checkpoint.corrupt",        # flip bytes in the newest snapshot
+    "checkpoint.spill",          # spill-dir I/O error
+    # (4) kube actuation (chaos.kubeapi)
+    "kube.conflict",             # next N update_workload: ConflictError
+    "kube.hold",                 # job's pods stick Pending (arg: job)
+    "kube.release",              # release a held job (arg: job)
+)
+
+
+@dataclass(frozen=True)
+class FaultEvent:
+    """One scheduled fault: at/after step ``step``, point ``point``
+    fires once with payload ``arg`` (trainer id, duration, count...)."""
+
+    step: int
+    point: str
+    arg: Any = None
+
+
+class FaultSchedule:
+    """Seed + step-indexed event list; every chaos run driven by the
+    same schedule is bit-reproducible.
+
+    Thread-safe: transport wrappers consult it from retry loops and the
+    checkpoint store from its save threads while the driver advances
+    the step from the training loop."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        events: Sequence[FaultEvent] = (),
+        strict: bool = True,
+    ):
+        self.seed = seed
+        if strict:
+            for ev in events:
+                if ev.point not in KNOWN_POINTS:
+                    raise ValueError(
+                        f"unknown injection point {ev.point!r} "
+                        f"(known: {', '.join(KNOWN_POINTS)})"
+                    )
+        # Stable order: (step, original index) so same-step events fire
+        # in authoring order on every run.
+        self._events: List[FaultEvent] = [
+            ev
+            for _, ev in sorted(
+                enumerate(events), key=lambda t: (t[1].step, t[0])
+            )
+        ]
+        self._lock = threading.Lock()
+        self._now = -1
+        self._draws: Dict[str, int] = {}
+        self._fired: List[FaultEvent] = []
+
+    # -- clock ---------------------------------------------------------------
+    def advance(self, step: int) -> None:
+        """Move the chaos clock to global training step ``step``
+        (monotonic; the driver calls this at each step boundary)."""
+        with self._lock:
+            if step > self._now:
+                self._now = step
+
+    @property
+    def now(self) -> int:
+        with self._lock:
+            return self._now
+
+    # -- event delivery ------------------------------------------------------
+    def due(self, point: str, step: Optional[int] = None) -> List[FaultEvent]:
+        """Pop (one-shot) every not-yet-fired event for ``point`` whose
+        step is <= the chaos clock (or explicit ``step``)."""
+        with self._lock:
+            now = self._now if step is None else step
+            hits = [
+                ev
+                for ev in self._events
+                if ev.point == point and ev.step <= now
+            ]
+            for ev in hits:
+                self._events.remove(ev)
+            self._fired.extend(hits)
+            return hits
+
+    def pending(self) -> List[FaultEvent]:
+        """Events not yet delivered (a finished soak asserts this is
+        empty — every scheduled fault actually fired)."""
+        with self._lock:
+            return list(self._events)
+
+    def fired(self) -> List[FaultEvent]:
+        with self._lock:
+            return list(self._fired)
+
+    def maybe_raise(
+        self, point: str, exc: Type[BaseException] = RuntimeError
+    ) -> None:
+        """Raise ``exc`` if an event for ``point`` is due — the hook
+        shape production code embeds (one branch, zero cost when no
+        chaos is installed)."""
+        if self.due(point):
+            raise exc(f"chaos[{point}] injected at step {self.now}")
+
+    # -- derived determinism -------------------------------------------------
+    def roll(self, point: str, p: float) -> bool:
+        """Deterministic Bernoulli(p) draw for ``point``: the n-th draw
+        of a point is a pure function of (seed, point, n)."""
+        with self._lock:
+            n = self._draws.get(point, 0)
+            self._draws[point] = n + 1
+        h = zlib.crc32(f"{self.seed}:{point}:{n}".encode()) / 2**32
+        return h < p
+
+    def rng(self, point: str) -> random.Random:
+        """A fresh per-point ``random.Random`` stream derived from the
+        seed (for fault payloads like delay durations)."""
+        return random.Random(
+            zlib.crc32(f"{self.seed}:{point}".encode())
+        )

@@ -1,0 +1,50 @@
+"""Checkpoint-store chaos helpers.
+
+The in-store injection points (``checkpoint.save_thread``,
+``checkpoint.spill``) live inside ``HostDRAMStore`` itself (pass the
+``FaultSchedule`` as its ``chaos``).  What lives here is the fault
+that by nature strikes from OUTSIDE the save path: silent corruption
+of an already-stored snapshot (DRAM bit flip, torn durable write that
+round-tripped).  The flip deliberately does NOT refresh the recorded
+digest — that is the whole point: ``HostCheckpoint.verify()`` /
+``HostDRAMStore.latest_verified()`` must catch the mismatch at restore
+time and fall back to the next-oldest snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from edl_tpu.checkpoint.hostdram import HostCheckpoint, HostDRAMStore
+
+
+def corrupt_checkpoint(ckpt: HostCheckpoint) -> None:
+    """Flip one byte in the first non-empty leaf, leaving the recorded
+    digest stale (silent corruption)."""
+    for i, leaf in enumerate(ckpt.leaves):
+        if leaf.nbytes == 0:
+            continue
+        bad = np.array(leaf, copy=True)
+        flat = bad.reshape(-1).view(np.uint8)
+        flat[0] ^= 0xFF
+        ckpt.leaves[i] = bad
+        return
+    raise ValueError("checkpoint has no bytes to corrupt")
+
+
+def corrupt_newest(store: HostDRAMStore) -> Optional[int]:
+    """Corrupt the newest materialized checkpoint in ``store``;
+    returns its step (None when the store is empty).  Callers that
+    need the newest *interval* save to be the victim should
+    ``store.wait()`` first."""
+    ckpt = store.latest()
+    if ckpt is None:
+        return None
+    # Force the digest to be recorded BEFORE the flip (normally the
+    # save worker already did this; put() too) so verify() has a
+    # pre-corruption fingerprint to disagree with.
+    ckpt.digest()
+    corrupt_checkpoint(ckpt)
+    return ckpt.step

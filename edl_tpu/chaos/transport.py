@@ -1,0 +1,101 @@
+"""HTTP transport chaos: the coord_service wire under fault injection.
+
+Wraps ``HTTPCoordinator`` at its single raw-I/O seam (``_open``) so
+every fault passes through the PRODUCTION retry path
+(``utils.retry.RetryPolicy``) — nothing is mocked above the socket.
+
+Injection points (all step-indexed, one-shot, budget-style: the event
+arg is "how many of the next requests fault"):
+
+- ``transport.refuse``: connection refused (coordinator pod gone /
+  Service not yet routing).
+- ``transport.timeout``: socket timeout (network partition, GC pause).
+- ``transport.slow``: the next request is delayed ``arg`` seconds
+  (slow response — exercises caller deadlines, not correctness).
+- ``transport.torn``: the response body is truncated mid-JSON (torn
+  write / proxy reset) — must be treated as transient and retried.
+
+Faults budgeted below the client's ``retries`` are invisible to
+training state (the retry absorbs them), which is what keeps a seeded
+soak bit-reproducible even though retry counts vary with wall clock.
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+import threading
+import time
+import urllib.error
+
+from edl_tpu.chaos.schedule import FaultSchedule
+from edl_tpu.runtime.coord_service import HTTPCoordinator
+
+
+class ChaosHTTPCoordinator(HTTPCoordinator):
+    """Drop-in ``HTTPCoordinator`` whose wire faults come from a
+    ``FaultSchedule``.  Interface-identical, so ``ElasticTrainer`` and
+    the control plane take it unchanged.
+
+    Budget mutations are locked: the trainer's heartbeat thread and the
+    step loop share one client, and a budget of N must inject exactly N
+    faults regardless of thread interleaving (the soak asserts exact
+    injection counts)."""
+
+    def __init__(self, address: str, schedule: FaultSchedule, **kwargs):
+        super().__init__(address, **kwargs)
+        self.schedule = schedule
+        self._budget_lock = threading.Lock()
+        self._refuse_budget = 0
+        self._timeout_budget = 0
+        self._torn_budget = 0
+        self._slow_for = 0.0
+        self.injected = {
+            "refuse": 0, "timeout": 0, "slow": 0, "torn": 0
+        }  # observability: the soak asserts faults actually fired
+
+    def _pull_events(self) -> None:
+        """Pull due transport events and decide THIS request's fate
+        under one lock (pre-request faults only)."""
+        for ev in self.schedule.due("transport.refuse"):
+            self._refuse_budget += int(ev.arg or 1)
+        for ev in self.schedule.due("transport.timeout"):
+            self._timeout_budget += int(ev.arg or 1)
+        for ev in self.schedule.due("transport.torn"):
+            self._torn_budget += int(ev.arg or 1)
+        for ev in self.schedule.due("transport.slow"):
+            self._slow_for = max(self._slow_for, float(ev.arg or 0.05))
+
+    def _open(self, req) -> bytes:
+        with self._budget_lock:
+            self._pull_events()
+            refuse = timeout = False
+            slow = 0.0
+            if self._refuse_budget > 0:
+                self._refuse_budget -= 1
+                self.injected["refuse"] += 1
+                refuse = True
+            elif self._timeout_budget > 0:
+                self._timeout_budget -= 1
+                self.injected["timeout"] += 1
+                timeout = True
+            elif self._slow_for > 0:
+                slow, self._slow_for = self._slow_for, 0.0
+                self.injected["slow"] += 1
+        if refuse:
+            raise urllib.error.URLError(
+                OSError(errno.ECONNREFUSED, "chaos: connection refused")
+            )
+        if timeout:
+            raise socket.timeout("chaos: request timed out")
+        if slow > 0:
+            time.sleep(slow)
+        body = super()._open(req)
+        with self._budget_lock:
+            if self._torn_budget > 0:
+                self._torn_budget -= 1
+                self.injected["torn"] += 1
+                # Truncate mid-payload: json.loads fails, the retry
+                # policy classifies it transient and re-requests.
+                return body[: max(1, len(body) // 2)]
+        return body

@@ -104,6 +104,16 @@ class HostCheckpoint:
     def nbytes(self) -> int:
         return sum(x.nbytes for x in self.leaves)
 
+    def _crc(self) -> int:
+        """Fresh crc32 pass over all leaves (no cache)."""
+        import zlib
+
+        crc = 0
+        for leaf in self.leaves:
+            arr = np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
+            crc = zlib.crc32(arr, crc)
+        return crc
+
     def digest(self) -> int:
         """Content fingerprint (crc32 chained over all leaves), cached.
 
@@ -112,14 +122,20 @@ class HostCheckpoint:
         skip the full-state broadcast (joiner-only restore).  One host
         memory pass on first call; O(1) after."""
         if self._digest is None:
-            import zlib
-
-            crc = 0
-            for leaf in self.leaves:
-                arr = np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
-                crc = zlib.crc32(arr, crc)
-            self._digest = crc
+            self._digest = self._crc()
         return self._digest
+
+    def verify(self) -> bool:
+        """Whether the leaves still hash to the digest recorded when it
+        was first computed (at save/adoption time) — the restore-side
+        check that turns silent corruption into a detected, recoverable
+        fault.  Full memory pass; runs only on the (rare) restore path.
+        With no recorded digest there is nothing to check against:
+        record one now and report clean."""
+        if self._digest is None:
+            self.digest()
+            return True
+        return self._crc() == self._digest
 
     _digest: Optional[int] = field(default=None, repr=False, compare=False)
 
@@ -135,9 +151,20 @@ class HostDRAMStore:
     rename, so concurrent saves can never corrupt or race each other.
     """
 
-    def __init__(self, keep: int = 2, spill_dir: Optional[str] = None):
+    def __init__(
+        self,
+        keep: int = 2,
+        spill_dir: Optional[str] = None,
+        chaos=None,
+    ):
+        """``chaos``: optional ``edl_tpu.chaos.FaultSchedule``; when set
+        the save worker and the spill path consult their named
+        injection points (``checkpoint.save_thread``,
+        ``checkpoint.spill``).  None in production — one branch per
+        save, no other cost."""
         self.keep = keep
         self.spill_dir = spill_dir
+        self.chaos = chaos
         self._lock = threading.Lock()
         self._checkpoints: Dict[int, HostCheckpoint] = {}  # step -> ckpt
         self._pending: List[threading.Thread] = []
@@ -230,6 +257,12 @@ class HostDRAMStore:
 
         def work():
             try:
+                if self.chaos is not None:
+                    # chaos[checkpoint.save_thread]: the async save
+                    # worker dies (OOM-kill, host fault) — lands in
+                    # _save_errors; the next wait() must surface it and
+                    # the resize path must degrade to replay.
+                    self.chaos.maybe_raise("checkpoint.save_thread")
                 host_leaves = [
                     l.assemble()
                     if isinstance(l, _ShardAssembly)
@@ -330,6 +363,34 @@ class HostDRAMStore:
         with self._lock:
             return self._checkpoints.get(step)
 
+    def latest_verified(self) -> Optional[HostCheckpoint]:
+        """Newest checkpoint whose bytes still match the digest
+        recorded at save time; a corrupted snapshot is dropped (with a
+        stderr note) and the next-oldest is tried.  The restore paths
+        use this instead of ``latest()`` so silent DRAM/storage
+        corruption becomes a detected fault with a bounded cost (one
+        extra replay interval), not a poisoned training run.  The crc
+        pass per candidate runs only on the rare restore path."""
+        import sys
+
+        while True:
+            with self._lock:
+                if not self._checkpoints:
+                    return None
+                step = max(self._checkpoints)
+                ckpt = self._checkpoints[step]
+            if ckpt.verify():
+                return ckpt
+            print(
+                f"[edl] checkpoint step {step} failed CRC verification "
+                "(corrupted in store); discarding and falling back to "
+                "the next-oldest snapshot",
+                file=sys.stderr,
+            )
+            with self._lock:
+                if self._checkpoints.get(step) is ckpt:
+                    del self._checkpoints[step]
+
     def steps(self) -> List[int]:
         with self._lock:
             return sorted(self._checkpoints)
@@ -375,6 +436,11 @@ class HostDRAMStore:
 
     # -- disk spill (durability; not on the resize fast path) ---------------
     def _spill(self, ckpt: HostCheckpoint):
+        if self.chaos is not None:
+            # chaos[checkpoint.spill]: durable-volume I/O error (full
+            # disk, detached PD) — surfaces through _save_errors while
+            # the DRAM copy stays warm and restorable.
+            self.chaos.maybe_raise("checkpoint.spill", OSError)
         os.makedirs(self.spill_dir, exist_ok=True)
         with self._lock:
             self._tmp_counter += 1
@@ -389,6 +455,10 @@ class HostDRAMStore:
             "generation": ckpt.generation,
             "created_at": ckpt.created_at,
             "n_leaves": len(ckpt.leaves),
+            # Content fingerprint (already cached by the save worker):
+            # load_from_disk re-hashes the loaded bytes against it so a
+            # torn/bit-rotted spill is detected, not restored.
+            "digest": ckpt.digest(),
         }
         tmp_json = f"{path}.{tag}.tmp.json"
         with open(tmp_json, "w") as f:
@@ -419,22 +489,40 @@ class HostDRAMStore:
         the treedef (the caller knows the model; leaves are positional)."""
         if not self.spill_dir:
             raise ValueError("store has no spill_dir")
+        import sys
+
+        _, treedef = jax.tree_util.tree_flatten(template_state)
         # FileNotFoundError means exactly "nothing spilled" (callers
         # treat it as a fresh job).  A manifest whose .npz is missing is
         # NOT that: it is either a concurrent prune by a peer pod
         # (retry the scan — a newer checkpoint replaced it) or real
         # corruption, which must raise loudly rather than silently
-        # restart training at step 0.
-        for attempt in range(3):
+        # restart training at step 0.  A manifest whose bytes load but
+        # fail the recorded CRC digest is corruption too: fall back to
+        # the next-oldest spill; only when EVERY spill is corrupt (or a
+        # specific requested step is) does the load raise.
+        corrupt: set = set()
+        race_retries = 0
+        while True:
             names = sorted(
                 f
                 for f in os.listdir(self.spill_dir)
                 if f.endswith(".json") and ".tmp." not in f
             )
-            if not names:
-                raise FileNotFoundError(f"no checkpoints in {self.spill_dir}")
             if step is None:
-                name = names[-1]
+                intact = [n for n in names if n not in corrupt]
+                if not intact:
+                    if corrupt:
+                        raise RuntimeError(
+                            f"all {len(corrupt)} durable checkpoint(s) in "
+                            f"{self.spill_dir} failed CRC verification "
+                            "(corrupt volume?); refusing to silently "
+                            "restart from step 0"
+                        )
+                    raise FileNotFoundError(
+                        f"no checkpoints in {self.spill_dir}"
+                    )
+                name = intact[-1]
             else:
                 name = f"ckpt-{step:012d}.json"
                 if name not in names:
@@ -448,27 +536,44 @@ class HostDRAMStore:
                     leaves = [
                         z[f"leaf_{i}"] for i in range(manifest["n_leaves"])
                     ]
-                break
             except (FileNotFoundError, OSError):
-                if attempt == 2:
+                race_retries += 1
+                if race_retries >= 3:
                     raise RuntimeError(
                         f"durable checkpoint {name} in {self.spill_dir} has "
                         "a manifest but unreadable bytes (corrupt volume?); "
                         "refusing to silently restart from step 0"
                     ) from None
                 time.sleep(0.2)
-        _, treedef = jax.tree_util.tree_flatten(template_state)
-        if treedef.num_leaves != len(leaves):
-            raise ValueError(
-                f"template has {treedef.num_leaves} leaves, checkpoint has {len(leaves)}"
+                continue
+            if treedef.num_leaves != len(leaves):
+                raise ValueError(
+                    f"template has {treedef.num_leaves} leaves, "
+                    f"checkpoint has {len(leaves)}"
+                )
+            ckpt = HostCheckpoint(
+                step=manifest["step"],
+                generation=manifest["generation"],
+                leaves=leaves,
+                treedef=treedef,
+                created_at=manifest["created_at"],
             )
-        ckpt = HostCheckpoint(
-            step=manifest["step"],
-            generation=manifest["generation"],
-            leaves=leaves,
-            treedef=treedef,
-            created_at=manifest["created_at"],
-        )
+            # Older manifests carry no digest: nothing to verify
+            # against (verify() then records a fresh one and passes).
+            ckpt._digest = manifest.get("digest")
+            if ckpt.verify():
+                break
+            if step is not None:
+                raise RuntimeError(
+                    f"durable checkpoint {name} in {self.spill_dir} "
+                    "failed CRC verification (corrupt volume?)"
+                )
+            print(
+                f"[edl] durable checkpoint {name} failed CRC "
+                "verification; falling back to the next-oldest spill",
+                file=sys.stderr,
+            )
+            corrupt.add(name)
         with self._lock:
             self._checkpoints[ckpt.step] = ckpt
         return ckpt

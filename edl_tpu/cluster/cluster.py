@@ -20,11 +20,28 @@ from typing import Dict, List, Optional, Tuple
 from edl_tpu.cluster.kube import KubeAPI, WorkloadInfo
 from edl_tpu.cluster.resources import ClusterResource, Nodes
 from edl_tpu.resource.training_job import TrainingJob
+from edl_tpu.utils.retry import GiveUpError, RetryPolicy
+
+
+class ParallelismUpdateError(GiveUpError):
+    """``update_parallelism`` gave up: the optimistic-concurrency
+    conflict storm outlasted the retry policy.  Typed so the autoscaler
+    tick can log-and-skip the one job (the next 5s tick retries) while
+    anything else failing still surfaces."""
+
+
+#: Conflict-retry default: 5 attempts (the reference's ``scaleAllJobs``
+#: count, ``pkg/autoscaler.go:346-370``) with a short jittered backoff
+#: and a total deadline well inside the 5s control tick.
+CONFLICT_RETRY = RetryPolicy(
+    max_attempts=5, base_delay=0.05, max_delay=0.5, deadline=2.0
+)
 
 
 class Cluster:
-    def __init__(self, kube: KubeAPI):
+    def __init__(self, kube: KubeAPI, conflict_retry: RetryPolicy = None):
         self.kube = kube
+        self.conflict_retry = conflict_retry or CONFLICT_RETRY
 
     # -- inventory (ref InquiryResource) ------------------------------------
     def inquiry_resource(self) -> ClusterResource:
@@ -142,17 +159,21 @@ class Cluster:
             )
         return singles
 
-    def update_parallelism(self, job: TrainingJob, parallelism: int, retries: int = 5) -> bool:
+    def update_parallelism(self, job: TrainingJob, parallelism: int) -> bool:
         """Set the trainer replica count.
 
-        Single-host: rewrite the batch Job's parallelism with
-        optimistic-concurrency retries (ref ``scaleAllJobs``'s 5-retry
-        loop, ``pkg/autoscaler.go:346-370``, moved down here so the
-        decision plane stays pure).  Multi-host: a replica is a whole
-        Indexed Job, so scaling creates the missing ``<job>-trainer-<r>``
-        Jobs (r ascending) or deletes the highest-indexed extras — the
-        same highest-index-first order the coordinator's replica
-        grouping drops, so control plane and world agree on victims."""
+        Single-host: rewrite the batch Job's parallelism under the
+        ``conflict_retry`` policy — bounded attempts, jittered backoff,
+        a deadline inside the control tick (the reference's bare
+        5-retry loop, ``pkg/autoscaler.go:346-370``, with the retry
+        behavior made uniform via ``utils.retry``).  Exhaustion raises
+        the typed ``ParallelismUpdateError`` so the autoscaler tick can
+        log-and-skip.  Returns False when the workload does not exist.
+        Multi-host: a replica is a whole Indexed Job, so scaling
+        creates the missing ``<job>-trainer-<r>`` Jobs (r ascending) or
+        deletes the highest-indexed extras — the same
+        highest-index-first order the coordinator's replica grouping
+        drops, so control plane and world agree on victims."""
         from edl_tpu.cluster.kube import ConflictError
 
         if job.hosts_per_replica() > 1:
@@ -189,17 +210,35 @@ class Cluster:
                 idx += 1
             return ok
 
-        for _ in range(retries):
+        missing = object()  # sentinel threaded out of the retry closure
+
+        def put():
             w = self.kube.get_workload(job.trainer_job_name())
             if w is None:
-                return False
+                return missing
             w.parallelism = parallelism
-            try:
-                self.kube.update_workload(w)
-                return True
-            except ConflictError:
-                continue
-        return False
+            self.kube.update_workload(w)
+            return True
+
+        import zlib
+
+        try:
+            result = self.conflict_retry.run(
+                put,
+                retryable=lambda e: isinstance(e, ConflictError),
+                # Per-job jitter stream: concurrent controllers fighting
+                # over different Jobs decorrelate their retries.
+                seed=zlib.crc32(job.name.encode()),
+                describe=f"parallelism PUT for {job.name}",
+            )
+        except GiveUpError as e:
+            raise ParallelismUpdateError(
+                f"parallelism PUT for {job.name} -> {parallelism} gave up "
+                f"after {e.attempts} conflict(s)",
+                last_error=e.last_error,
+                attempts=e.attempts,
+            ) from e.last_error
+        return result is not missing
 
     # -- pod counting (ref JobPods) -----------------------------------------
     def job_pods(self, job: TrainingJob) -> Tuple[int, int, int, int]:

@@ -43,15 +43,32 @@ def coordinator_address(job: TrainingJob) -> str:
 
 
 def make_coord_client(
-    job: TrainingJob, timeout: float = 2.0, retries: int = 1
+    job: TrainingJob,
+    timeout: float = 2.0,
+    retries: int = 1,
+    retry_base_delay: float = 0.1,
+    retry_deadline: float = None,
 ):
     """HTTP client for the job's coordinator.  Short timeout + a single
     try by default: the caller runs inside the 5s control loop and must
     tolerate a coordinator that is still scheduling (callers catch
     ``ConnectionError`` and retry on the next tick — the handshake is
-    level-triggered, see ``Controller.reconcile_targets``)."""
+    level-triggered, see ``Controller.reconcile_targets``).  When
+    ``retries`` > 1 the backoff comes from ``utils.retry.RetryPolicy``
+    (jittered, deadline-bounded) so a flapping coordinator can never
+    hold a control tick hostage.  ``retry_deadline`` defaults to
+    ``retries * (timeout + retry_base_delay)`` — sized so every
+    requested attempt can actually run even when each one blocks its
+    full connect timeout (a deadline at or below ``timeout`` would
+    silently cap timeout-class failures at one attempt)."""
     from edl_tpu.runtime.coord_service import HTTPCoordinator
 
+    if retry_deadline is None:
+        retry_deadline = retries * (timeout + retry_base_delay)
     return HTTPCoordinator(
-        coordinator_address(job), timeout=timeout, retries=retries
+        coordinator_address(job),
+        timeout=timeout,
+        retries=retries,
+        retry_base_delay=retry_base_delay,
+        retry_deadline=retry_deadline,
     )

@@ -165,34 +165,67 @@ class HTTPCoordinator:
     types, network underneath.  Injected into ``ElasticTrainer`` by the
     launcher when ``EDL_COORDINATOR_ADDR`` is set."""
 
-    def __init__(self, address: str, timeout: float = 5.0, retries: int = 3):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 5.0,
+        retries: int = 3,
+        retry_base_delay: float = 0.2,
+        retry_deadline: Optional[float] = None,
+        retry_policy=None,
+    ):
+        """``retries``/``retry_base_delay``/``retry_deadline``
+        parameterize the transient-failure backoff (previously
+        hardcoded ``0.2 * 2**attempt`` with no deadline): callers
+        inside a bounded control tick pass a deadline, the step loop
+        keeps the default.  ``retry_policy`` overrides wholesale."""
+        from edl_tpu.utils.retry import RetryPolicy
+
         if "://" not in address:
             address = f"http://{address}"
         self.address = address.rstrip("/")
         self.timeout = timeout
         self.retries = retries
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=retries,
+            base_delay=retry_base_delay,
+            max_delay=2.0,
+            deadline=retry_deadline,
+        )
+
+    def _open(self, req) -> bytes:
+        """One raw HTTP round-trip.  The chaos transport wrapper
+        (``edl_tpu.chaos.transport``) overrides exactly this seam to
+        inject refused connections, timeouts, slow responses, and torn
+        JSON under the production retry path."""
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return r.read()
 
     def _request(self, req) -> dict:
         """All coordinator calls are idempotent (register/heartbeat/ack/
         target re-apply cleanly), so transient network failures retry
-        with backoff instead of raising into the step loop."""
-        import time as _time
+        under ``retry_policy`` instead of raising into the step loop."""
         import urllib.error
 
-        last: Optional[Exception] = None
-        for attempt in range(self.retries):
-            try:
-                with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                    return json.loads(r.read())
-            except urllib.error.HTTPError:
-                raise  # the server answered; not transient
-            except Exception as e:  # URLError, timeout, connection reset
-                last = e
-                if attempt + 1 < self.retries:  # no sleep after the last try
-                    _time.sleep(0.2 * (2**attempt))
-        raise ConnectionError(
-            f"coordinator unreachable after {self.retries} tries"
-        ) from last
+        from edl_tpu.utils.retry import GiveUpError
+
+        import zlib
+
+        try:
+            return self.retry_policy.run(
+                lambda: json.loads(self._open(req)),
+                # An HTTPError means the server answered: not transient.
+                retryable=lambda e: not isinstance(e, urllib.error.HTTPError),
+                # Per-client jitter stream (stable, so replays are
+                # deterministic; distinct, so N clients retrying after
+                # a coordinator restart don't re-hit it in lockstep).
+                seed=zlib.crc32(self.address.encode()),
+                describe="coordinator request",
+            )
+        except GiveUpError as e:
+            raise ConnectionError(
+                f"coordinator unreachable after {e.attempts} tries"
+            ) from e.last_error
 
     def _get(self, path: str) -> dict:
         return self._request(f"{self.address}{path}")

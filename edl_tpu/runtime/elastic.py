@@ -487,8 +487,14 @@ class ElasticTrainer:
         despite durable state existing.  A checkpoint that exists but
         cannot be loaded (wrong model's leaves, truncated bytes) raises
         loudly: re-initializing over it would destroy the very state
-        the operator mounted the volume to keep."""
-        ckpt = self.store.latest()
+        the operator mounted the volume to keep.
+
+        DRAM candidates are CRC-verified against the digest recorded
+        at save time (``latest_verified``): a corrupted snapshot is
+        detected here — the last moment before it would be placed on
+        the new mesh — and the next-oldest snapshot restores instead
+        (one extra replay interval, not a poisoned run)."""
+        ckpt = self.store.latest_verified()
         if ckpt is not None or not self.store.spill_dir:
             return ckpt
         # treedef template from the model's abstract init: no allocation

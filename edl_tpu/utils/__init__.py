@@ -6,6 +6,7 @@ from edl_tpu.utils.quantity import (
     format_memory_mega,
     add_resource_list,
 )
+from edl_tpu.utils.retry import GiveUpError, RetryPolicy
 
 __all__ = [
     "parse_cpu_milli",
@@ -14,4 +15,6 @@ __all__ = [
     "format_cpu_milli",
     "format_memory_mega",
     "add_resource_list",
+    "GiveUpError",
+    "RetryPolicy",
 ]

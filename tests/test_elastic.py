@@ -478,6 +478,7 @@ def test_cold_start_restores_from_durable_dir(tmp_path):
     assert [r.step for r in hist] == list(range(10, 15))
 
     # A THIRD start now sees the second run's newer spill (step 15).
+    second.store.wait()  # let the step-15 spill land (as for `first`)
     third = world(HostDRAMStore(spill_dir=spill))
     third.run(16)
     assert third.resize_events[0].restored_step == 15

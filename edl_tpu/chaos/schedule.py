@@ -50,6 +50,9 @@ KNOWN_POINTS = (
     "checkpoint.save_thread",    # async save worker dies
     "checkpoint.corrupt",        # flip bytes in the newest snapshot
     "checkpoint.spill",          # spill-dir I/O error
+    # (3b) streaming restore transfer (checkpoint.transfer)
+    "transfer.chunk.torn",       # flip a byte in one received chunk
+    "transfer.chunk.slow",       # stall the source arg s before a send
     # (4) kube actuation (chaos.kubeapi)
     "kube.conflict",             # next N update_workload: ConflictError
     "kube.hold",                 # job's pods stick Pending (arg: job)

@@ -1,3 +1,16 @@
 from edl_tpu.checkpoint.hostdram import HostDRAMStore, HostCheckpoint
+from edl_tpu.checkpoint.transfer import (
+    TornTransferError,
+    TransferError,
+    TransferStats,
+    stream_restore,
+)
 
-__all__ = ["HostDRAMStore", "HostCheckpoint"]
+__all__ = [
+    "HostDRAMStore",
+    "HostCheckpoint",
+    "TornTransferError",
+    "TransferError",
+    "TransferStats",
+    "stream_restore",
+]

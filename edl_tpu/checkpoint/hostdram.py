@@ -37,6 +37,53 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _legacy_chained_crc(leaves: List[np.ndarray]) -> int:
+    """The pre-digest_v2 checkpoint fingerprint (crc32 chained over
+    raw leaf bytes) — kept ONLY to verify durable spills written by
+    older revisions at cold start."""
+    import zlib
+
+    crc = 0
+    for leaf in leaves:
+        arr = np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
+        crc = zlib.crc32(arr, crc)
+    return crc
+
+
+def _pack_leaf_digests(leaf_digests: List[int]) -> int:
+    """Whole-checkpoint fingerprint from per-leaf crc32s: crc32 over
+    the packed digest vector.  Deriving the checkpoint digest from the
+    leaf digests (instead of chaining a second pass over the raw bytes)
+    means one memory pass yields BOTH granularities — the per-leaf
+    vector the delta-aware restore agreement trades, and the single
+    int the whole-checkpoint agreement and spill manifests record."""
+    import zlib
+
+    return zlib.crc32(np.asarray(leaf_digests, np.uint32).tobytes())
+
+
+def leaf_placer(mesh: Mesh):
+    """Per-leaf device placement onto ``mesh``: plain device_put on a
+    fully-addressable mesh; shard-sliced ``make_array_from_callback``
+    when the mesh spans processes this one cannot address.  Shared by
+    ``HostDRAMStore.restore`` and the streaming restore transfer
+    (``checkpoint/transfer.py``), which places leaves one at a time so
+    placement overlaps the remaining network transfer."""
+    multiproc = any(
+        d.process_index != jax.process_index() for d in mesh.devices.flat
+    )
+
+    def place(x, s):
+        if not multiproc:
+            return jax.device_put(x, s)
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, s, lambda idx: arr[idx]
+        )
+
+    return place
+
+
 def _cover_regions(l) -> Optional[List[Any]]:
     """Unique addressable-shard regions of ``l`` when they cover the
     FULL array; None when local shards leave gaps (truly cross-process
@@ -104,25 +151,43 @@ class HostCheckpoint:
     def nbytes(self) -> int:
         return sum(x.nbytes for x in self.leaves)
 
-    def _crc(self) -> int:
-        """Fresh crc32 pass over all leaves (no cache)."""
+    def _leaf_crcs(self) -> List[int]:
+        """Fresh per-leaf crc32 pass (no cache)."""
         import zlib
 
-        crc = 0
-        for leaf in self.leaves:
-            arr = np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
-            crc = zlib.crc32(arr, crc)
-        return crc
+        return [
+            zlib.crc32(
+                np.ascontiguousarray(leaf).reshape(-1).view(np.uint8)
+            )
+            for leaf in self.leaves
+        ]
+
+    def _crc(self) -> int:
+        """Fresh whole-checkpoint fingerprint (no cache)."""
+        return _pack_leaf_digests(self._leaf_crcs())
+
+    def leaf_digests(self) -> List[int]:
+        """Per-leaf crc32 fingerprints, cached.
+
+        The currency of the delta-aware restore agreement
+        (``checkpoint/transfer.py``): members all-gather these so a
+        joiner receives ONLY the leaves whose bytes it lacks, and a
+        receiver can verify each transferred leaf against the source's
+        advertised digest.  One host memory pass on first call."""
+        if self._leaf_digests is None:
+            self._leaf_digests = self._leaf_crcs()
+        return self._leaf_digests
 
     def digest(self) -> int:
-        """Content fingerprint (crc32 chained over all leaves), cached.
+        """Content fingerprint (crc32 over the per-leaf crc vector),
+        cached.
 
         Lets multi-pod members agree that they hold the *identical*
         checkpoint — same step AND same bytes — so a graceful resize can
-        skip the full-state broadcast (joiner-only restore).  One host
-        memory pass on first call; O(1) after."""
+        skip moving any state (joiner-only restore).  One host memory
+        pass on first call (shared with ``leaf_digests``); O(1) after."""
         if self._digest is None:
-            self._digest = self._crc()
+            self._digest = _pack_leaf_digests(self.leaf_digests())
         return self._digest
 
     def verify(self) -> bool:
@@ -135,9 +200,25 @@ class HostCheckpoint:
         if self._digest is None:
             self.digest()
             return True
-        return self._crc() == self._digest
+        fresh = self._leaf_crcs()
+        if _pack_leaf_digests(fresh) != self._digest:
+            return False
+        self._leaf_digests = fresh
+        return True
+
+    def adopt_digests(self, leaf_digests: List[int]) -> None:
+        """Install externally verified per-leaf digests (the streaming
+        restore transfer chunk-CRC-verified every received leaf and
+        digest-matched every skipped one against the source's
+        advertisement, so no re-hash pass is needed — the zero-copy
+        adoption half of the transfer engine)."""
+        self._leaf_digests = [int(d) for d in leaf_digests]
+        self._digest = _pack_leaf_digests(self._leaf_digests)
 
     _digest: Optional[int] = field(default=None, repr=False, compare=False)
+    _leaf_digests: Optional[List[int]] = field(
+        default=None, repr=False, compare=False
+    )
 
 
 class HostDRAMStore:
@@ -169,7 +250,14 @@ class HostDRAMStore:
         self._checkpoints: Dict[int, HostCheckpoint] = {}  # step -> ckpt
         self._pending: List[threading.Thread] = []
         self._inflight_steps: set = set()
-        self._save_errors: List[BaseException] = []
+        #: (save_id, error): tagging errors with the save that raised
+        #: them lets wait() discard errors from ABANDONED saves — a
+        #: leaked dead-world save thread failing long after the world
+        #: was buried must not spuriously degrade the NEXT graceful
+        #: resize to the replay path (ADVICE r5).
+        self._save_errors: List[tuple] = []
+        self._save_seq = 0
+        self._abandoned_saves: set = set()
         self._tmp_counter = 0
 
     # -- save ---------------------------------------------------------------
@@ -190,6 +278,8 @@ class HostDRAMStore:
                 th.start()
                 return th
             self._inflight_steps.add(step_val)
+            self._save_seq += 1
+            save_id = self._save_seq
 
         # Device-side snapshot first: the step loop donates its state
         # buffers into the next step (``Trainer`` uses donate_argnums to
@@ -291,12 +381,13 @@ class HostDRAMStore:
                     self._spill(ckpt)
             except BaseException as e:  # pragma: no cover - defensive
                 with self._lock:
-                    self._save_errors.append(e)
+                    self._save_errors.append((save_id, e))
             finally:
                 with self._lock:
                     self._inflight_steps.discard(step_val)
 
         th = threading.Thread(target=work, daemon=True, name=f"ckpt-save-{step_val}")
+        th.edl_save_id = save_id
         with self._lock:
             # Prune finished workers so a long run between wait() calls
             # doesn't retain one Thread object per interval save.  A
@@ -314,11 +405,15 @@ class HostDRAMStore:
 
         ``timeout``: optional TOTAL seconds to wait across all pending
         saves.  On expiry the still-running threads are re-tracked (a
-        later wait can finish the join) and the method returns after
-        the usual error drain — the broken-world path uses this so a
-        save blocked on a dead peer's collective cannot hang recovery
-        (it proceeds and leaks the thread, matching the leak-not-wait
-        philosophy of the rest of that path)."""
+        later wait can finish the join) and MARKED ABANDONED: the
+        broken-world path uses the timeout so a save blocked on a dead
+        peer's collective cannot hang recovery — it proceeds and leaks
+        the thread — and whenever that leaked thread finally dies, its
+        error is tagged with a save id already in the abandoned set and
+        silently discarded here.  Without the tag, the stale error
+        would linger until the NEXT healthy flush's wait() re-raised it
+        and spuriously degraded an unrelated graceful resize to the
+        replay path (ADVICE r5)."""
         with self._lock:
             pending = list(self._pending)
             self._pending.clear()
@@ -331,14 +426,21 @@ class HostDRAMStore:
                 th.join(max(0.0, deadline - time.monotonic()))
                 if th.is_alive():
                     still_alive.append(th)
-        if still_alive:
-            with self._lock:
-                self._pending.extend(still_alive)
         with self._lock:
-            if self._save_errors:
-                err = self._save_errors[0]
-                self._save_errors.clear()
-                raise RuntimeError("async checkpoint save failed") from err
+            if still_alive:
+                self._pending.extend(still_alive)
+                for th in still_alive:
+                    sid = getattr(th, "edl_save_id", None)
+                    if sid is not None:
+                        self._abandoned_saves.add(sid)
+            live = [
+                (sid, e)
+                for sid, e in self._save_errors
+                if sid not in self._abandoned_saves
+            ]
+            self._save_errors.clear()
+            if live:
+                raise RuntimeError("async checkpoint save failed") from live[0][1]
 
     def put(self, ckpt: HostCheckpoint) -> None:
         """Adopt an externally produced checkpoint (e.g. one received by
@@ -417,17 +519,7 @@ class HostDRAMStore:
         # cannot address; device_put can't target those, so build each
         # global array from the local shards only (every process holds
         # the full host value — make_array_from_callback slices it).
-        multiproc = any(
-            d.process_index != jax.process_index() for d in mesh.devices.flat
-        )
-
-        def place(x, s):
-            if not multiproc:
-                return jax.device_put(x, s)
-            arr = np.asarray(x)
-            return jax.make_array_from_callback(
-                arr.shape, s, lambda idx: arr[idx]
-            )
+        place = leaf_placer(mesh)
 
         if isinstance(sharding_tree, (NamedSharding,)):
             single = sharding_tree
@@ -455,10 +547,18 @@ class HostDRAMStore:
             "generation": ckpt.generation,
             "created_at": ckpt.created_at,
             "n_leaves": len(ckpt.leaves),
-            # Content fingerprint (already cached by the save worker):
-            # load_from_disk re-hashes the loaded bytes against it so a
-            # torn/bit-rotted spill is detected, not restored.
+            # Content fingerprints (already cached by the save worker):
+            # load_from_disk re-hashes the loaded bytes against the
+            # digest so a torn/bit-rotted spill is detected, not
+            # restored; the per-leaf vector re-seeds the delta-restore
+            # agreement cache so a cold start pays no extra hash pass.
+            # digest_v 2 = crc32 over the leaf-digest vector; absent =
+            # the pre-delta chained-crc algorithm (load_from_disk
+            # verifies those with the legacy formula rather than
+            # classifying every old spill as corrupt).
             "digest": ckpt.digest(),
+            "digest_v": 2,
+            "leaf_digests": ckpt.leaf_digests(),
         }
         tmp_json = f"{path}.{tag}.tmp.json"
         with open(tmp_json, "w") as f:
@@ -560,8 +660,25 @@ class HostDRAMStore:
             )
             # Older manifests carry no digest: nothing to verify
             # against (verify() then records a fresh one and passes).
-            ckpt._digest = manifest.get("digest")
-            if ckpt.verify():
+            # Manifests from before digest_v 2 recorded a CHAINED
+            # crc32 over the raw leaf bytes — verify those with the
+            # legacy formula (then cache fresh v2 digests), instead of
+            # letting the algorithm change classify every pre-existing
+            # durable checkpoint as corrupt on a healthy volume.
+            if manifest.get("digest_v") == 2:
+                ckpt._digest = manifest.get("digest")
+                if manifest.get("leaf_digests") is not None:
+                    ckpt._leaf_digests = [
+                        int(d) for d in manifest["leaf_digests"]
+                    ]
+                ok = ckpt.verify()
+            elif manifest.get("digest") is not None:
+                ok = _legacy_chained_crc(leaves) == manifest["digest"]
+                if ok:
+                    ckpt.digest()  # cache fresh v2 fingerprints
+            else:
+                ok = ckpt.verify()  # records a fresh digest, passes
+            if ok:
                 break
             if step is not None:
                 raise RuntimeError(

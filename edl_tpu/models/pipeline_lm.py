@@ -49,7 +49,15 @@ def pipeline_lm(
     ``schedule``: "gpipe" (scan-under-AD; activation memory O(M)
     microbatches) or "1f1b" (one-forward-one-backward with in-schedule
     gradients; activation memory O(S), forward recompute in the
-    backward sub-tick — see ``parallel/pipeline.pipeline_1f1b_loss``)."""
+    backward sub-tick — see ``parallel/pipeline.pipeline_1f1b_loss``).
+
+    CAVEAT (1f1b): ``pipeline_1f1b_loss`` has NO grad-free evaluation
+    path — the backward sub-ticks are woven into the schedule itself,
+    so calling ``loss_fn`` outside ``jax.grad`` (an eval loop, a
+    validation pass) still pays the FULL backward schedule: every
+    stage vjp, every grad accumulator, ~3x the forward-only FLOPs.
+    Evaluation-heavy workloads should score with a "gpipe"-schedule
+    (or non-pp) instance of the same params instead (ADVICE r5)."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     if tiny:

@@ -14,7 +14,9 @@ the ring's normalized-partial merge.
 
 Backward — TWO implementations behind one dispatch (``_bwd_common``):
 
-- **merged** (T <= 16384): a single blockwise kernel with saved
+- **merged** (estimated VMEM residency — which scales with T*d —
+  within the 100MB cap, up to T=16384; ``_merged_bwd_fits``): a
+  single blockwise kernel with saved
   residuals — the forward emits per-row logsumexp (O(T) stats,
   broadcast over STAT_LANES trailing values so tiles stay legal
   (sublane, lane) shapes), and ONE backward pass recomputes each
@@ -26,7 +28,9 @@ Backward — TWO implementations behind one dispatch (``_bwd_common``):
   above the 16MB default (``_vmem_limit`` — v5e has the physical
   headroom), which measures 0.428 MFU at T=4096, 0.408 at 8192 and
   0.388 at 16384 single-chip.
-- **streaming-K** (T > 16384): K blocks become the outer grid dim, so
+- **streaming-K** (everything larger — long T, or wide heads like
+  d=128 near T=16384 whose capped grant the merged residency would
+  overflow): K blocks become the outer grid dim, so
   only one (block_k, d) K/V block + scratch is resident — VMEM use
   depends on block_k, not T (block_k grows with T for fewer Q
   re-streams, capped at 16384 to stay inside the VMEM grant; the dQ
@@ -102,20 +106,53 @@ def _pick_block(t: int, want: int) -> int:
 #: remains the fallback beyond.
 _MERGED_BWD_MAX_T = 16384
 
+#: Scoped-VMEM ceiling any kernel may be granted (v5e physical VMEM
+#: minus headroom); the DISPATCH predicate, not just the grant, must
+#: respect it (see ``_merged_bwd_fits``).
+_VMEM_CAP_BYTES = 100 * 1024 * 1024
+
 #: Test hook: force a backward implementation ("merged" | "streamk");
-#: None = pick by _MERGED_BWD_MAX_T.
+#: None = pick by _merged_bwd_fits.
 _BWD_IMPL_OVERRIDE = None
+
+
+def _merged_bwd_residency(tk: int, d: int) -> int:
+    """Estimated scoped-VMEM residency of the merged backward: the
+    16MB baseline plus ~12 bytes/key-position/lane (K, V bf16 + dK/dV
+    f32 scratch) granted at 4x for double-buffering slack.  Scales
+    with T*d — the HEAD DIM matters as much as the context length."""
+    return 16 * 1024 * 1024 + 4 * tk * d * 12
+
+
+def _merged_bwd_fits(tk: int, d: int) -> bool:
+    """Whether the merged single-pass backward fits its VMEM grant.
+
+    Dispatching on T alone (the r5 rule: merged iff T <= 16384) hid a
+    d-shaped hole: residency scales with T*d, and ``_vmem_limit`` CAPS
+    the grant at 100MB — so at d=128 near T=16384 the capped grant is
+    smaller than the estimated residency and the merged kernel risks a
+    scoped-VMEM overflow (ADVICE r5).  Folding d into the predicate
+    switches exactly those shapes to the streaming-K fallback, whose
+    residency depends on block_k, not T*d."""
+    return tk <= _MERGED_BWD_MAX_T and _merged_bwd_residency(tk, d) <= _VMEM_CAP_BYTES
 
 
 def _vmem_limit(tk: int, d: int):
     """Scoped-VMEM limit for long-context kernels: None keeps the 16MB
-    default (T <= 2048 fits it); beyond, the merged backward's
-    residency is ~12 bytes/key-position/lane (K, V bf16 + dK/dV f32
-    scratch), so grant 4x that over the baseline, capped at 100MB
-    (64MB measured sufficient at T=16384 on v5e)."""
-    if tk <= 2048:
+    default where the merged backward measurably fits it (T*d up to
+    the 2048 x 64 reference shape — keyed on T*d, not T alone, so a
+    wide-head short-context shape like T=2048/d=256 gets a raised
+    grant instead of silently overflowing the default); beyond, grant
+    the merged backward's estimated residency
+    (``_merged_bwd_residency``), capped at the physical ceiling (64MB
+    measured sufficient at T=16384, d=64 on v5e).  Shapes whose
+    estimate EXCEEDS the cap never run the merged kernel
+    (``_merged_bwd_fits``), so the grant covers the estimate whenever
+    merged is dispatched; past the cap this limit sizes the
+    streaming-K kernel, whose residency is block_k-bound."""
+    if tk * d <= 2048 * 64:
         return None
-    return min(16 * 1024 * 1024 + 4 * tk * d * 12, 100 * 1024 * 1024)
+    return min(_merged_bwd_residency(tk, d), _VMEM_CAP_BYTES)
 
 
 def _compiler_params(tk: int, d: int):
@@ -693,7 +730,7 @@ def _bwd_common(res, g_o, glse3, causal, scale, bwd_block_q, bwd_block_k,
     b, t, h, d = q.shape
     tk = k.shape[1]
     impl = _BWD_IMPL_OVERRIDE or (
-        "merged" if tk <= _MERGED_BWD_MAX_T else "streamk"
+        "merged" if _merged_bwd_fits(tk, d) else "streamk"
     )
     bwd_3d = _flash_bwd_3d if impl == "merged" else _flash_bwd_streamk_3d
     dq3, dk3, dv3 = bwd_3d(
@@ -779,7 +816,7 @@ def _prep(q, k, causal, scale, kv_mask, block_q, block_k, bwd_block_q,
         raise ValueError(f"causal requires square attention, got {tq=} {tk=}")
     block_q = _pick_block(tq, block_q or DEFAULT_BLOCK_Q)
     block_k = _pick_block(tk, block_k or DEFAULT_BLOCK_K)
-    if tk <= _MERGED_BWD_MAX_T:
+    if _merged_bwd_fits(tk, q.shape[-1]):
         # Merged backward: forward-size tiles (fastest measured).
         dq_want, dk_want = block_q, block_k
     else:

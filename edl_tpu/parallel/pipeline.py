@@ -220,6 +220,10 @@ def pipeline_1f1b_loss(
     Returns the scalar mean loss.  Gradients flow to stacked_params,
     head_params and x (the embedding upstream); the backward pass costs
     nothing beyond scaling — the schedule already computed the grads.
+    The flip side: there is NO grad-free path.  The backward sub-ticks
+    run inside the schedule unconditionally, so a forward-only caller
+    (evaluation) pays the full backward schedule anyway — see the
+    caveat at ``models/pipeline_lm``'s ``schedule`` flag (ADVICE r5).
 
     Memory: the schedule is ONE un-differentiated scan whose carry
     holds a (2S-1)-microbatch input ring buffer + param-sized grad

@@ -68,9 +68,19 @@ class ResizeEvent:
     replayed_steps: int
     graceful: bool
     #: how this process got its state: "init" (fresh), "local" (own
-    #: store, no cross-pod traffic), "broadcast" (full-state broadcast
-    #: because some member lacked the agreed checkpoint)
+    #: store, no cross-pod traffic), "broadcast" (this member moved
+    #: state over the restore-transfer wire — as source or receiver —
+    #: because some member lacked the agreed bytes)
     restore_source: str = ""
+    #: per-phase breakdown of ``seconds`` (flush / world_formation /
+    #: remesh / restore) so a resize-latency regression is
+    #: attributable to ONE phase instead of a single opaque number
+    #: (the r4->r5 resize_max 0.33->0.80s jump was unattributable)
+    phase_seconds: Dict[str, float] = None
+    #: streaming restore-transfer accounting (multi-process resizes):
+    #: bytes this member sent/received and the leaves it skipped
+    #: because its local bytes already matched the source
+    transfer: Optional[Dict[str, Any]] = None
 
 
 @dataclass
@@ -173,6 +183,12 @@ class ElasticTrainer:
         #: how long run() waits for a formable world before giving up
         self.barrier_timeout: float = 300.0
         self.barrier_poll_interval: float = 0.05
+        #: streaming restore-transfer tuning (checkpoint/transfer.py):
+        #: chunk granularity for the pipelined TCP transfer and how
+        #: long either side waits on a silent peer before abandoning
+        #: the transfer to the normal broken-world machinery
+        self.transfer_chunk_bytes: int = 64 << 20
+        self.transfer_timeout: float = 120.0
         #: member ids this process keeps alive at the coordinator (the
         #: launcher sets its own pod id; local mode sets all simulated
         #: members).  Heartbeats are what make eviction-based failure
@@ -384,6 +400,13 @@ class ElasticTrainer:
         from edl_tpu.utils.profiling import annotate
 
         t0 = time.perf_counter()
+        phases: Dict[str, float] = {}
+
+        def _mark(name: str, since: float) -> float:
+            now = time.perf_counter()
+            phases[name] = round(now - since, 6)
+            return now
+
         graceful = self.state is not None and self._can_flush(plan)
 
         if graceful:
@@ -401,12 +424,14 @@ class ElasticTrainer:
 
                     traceback.print_exc()
                     graceful = False
+        t_phase = _mark("flush", t0)
 
         if self.world_builder is not None:
             self.state = None
             with annotate("resize/world_formation"):
                 if not self._rebuild_world(plan):
                     return False
+            t_phase = _mark("world_formation", t_phase)
 
         with annotate("resize/remesh"):
             trainer = self._trainer_for(plan.world_size)
@@ -426,11 +451,35 @@ class ElasticTrainer:
                     "(TrainingJob.legal_world_sizes)"
                 ) from None
 
+        t_phase = _mark("remesh", t_phase)
+
+        transfer_stats = None
         with annotate("resize/restore"):
             if jax.process_count() > 1:
-                self.state, restored_step, restore_source = (
-                    self._restore_multiprocess(trainer)
-                )
+                from edl_tpu.checkpoint.transfer import TransferError
+
+                try:
+                    self.state, restored_step, restore_source, transfer_stats = (
+                        self._restore_multiprocess(trainer)
+                    )
+                except TransferError:
+                    # Torn transfer: world-consistent verdict (every
+                    # member raises together via the confirmation
+                    # gather) — fail THIS resize attempt, hold, retry;
+                    # the fresh agreement re-verifies the source's
+                    # bytes, so a wire flip re-transfers and real
+                    # source corruption moves the whole world to the
+                    # next-oldest verified snapshot together.
+                    # Transport faults (source died/stalled before or
+                    # during the pull): same hold — the coordinator
+                    # evicts the dead peer, bumps the generation, and
+                    # the retried agreement elects a live source.
+                    # Dying here instead would turn routine peer churn
+                    # into receiver-process deaths.
+                    import traceback
+
+                    traceback.print_exc()
+                    return False
             else:
                 ckpt = self._latest_or_disk(trainer)
                 if ckpt is None:
@@ -452,6 +501,7 @@ class ElasticTrainer:
                     )
                     restored_step = int(ckpt.step)
                     restore_source = "local"
+        _mark("restore", t_phase)
         replayed = max(0, self._last_completed_step - restored_step)
 
         self.generation = plan.generation
@@ -466,6 +516,8 @@ class ElasticTrainer:
             replayed_steps=replayed,
             graceful=graceful,
             restore_source=restore_source,
+            phase_seconds=phases,
+            transfer=transfer_stats,
         )
         self.resize_events.append(event)
         if self.on_resize is not None:
@@ -518,92 +570,148 @@ class ElasticTrainer:
         self._last_completed_step = max(self._last_completed_step, ckpt.step)
         return ckpt
 
+    def _transfer_fabric(self):
+        """Agreement fabric for the streaming restore transfer.  The
+        advertised host is this pod's registered address (the same one
+        world formation dials); local/test runs without one are
+        single-machine, where loopback is correct."""
+        from edl_tpu.checkpoint import transfer
+
+        host = (
+            self.register_address.rsplit(":", 1)[0]
+            if self.register_address
+            else "127.0.0.1"
+        )
+        return transfer.JaxProcessFabric(advertise_host=host)
+
     def _restore_multiprocess(self, trainer: Trainer):
-        """Agree on one state across the (re-formed) process group.
+        """Agree on one state across the (re-formed) process group and
+        move ONLY the bytes some member lacks.
 
-        Members first agree on what they hold via a tiny all-gather of
-        (have, step, digest).  When every member already holds the
-        identical checkpoint — the common case for a graceful resize,
-        where each survivor flushed the same replicated state — everyone
-        restores from its *local* store and no cross-pod state moves
-        (joiner-only restore: a full-model DCN broadcast per resize
-        would dominate the <60s budget at scale, VERDICT r3 weak-1).
-        Only when some member lacks the agreed bytes (a joiner, a
-        diverged store) does the newest-checkpoint holder broadcast —
-        the TPU-native replacement for the reference joiners' pserver
-        parameter pull.  Runs collectives: every member process must
-        call this inside the same generation's resize.
+        Members all-gather (have, step, digest) plus PER-LEAF digests
+        (``checkpoint/transfer.py``).  Identical bytes everywhere — the
+        common graceful-resize case — restores locally with zero
+        cross-pod traffic (VERDICT r3 weak-1).  Otherwise the
+        newest-checkpoint holder streams each receiver's missing
+        leaves over chunked TCP: a single fresh joiner pulls only what
+        it lacks while every survivor restores locally, received
+        leaves go to the device while later chunks are still on the
+        wire, and chunk CRCs feed the corruption-fallback machinery —
+        a torn transfer degrades to the next-oldest verified snapshot
+        (or fails the resize for a stateless joiner) instead of
+        poisoning the run.  This retired the monolithic
+        ``broadcast_one_to_all`` path (25.5s for 728MB at 2 processes,
+        BENCH_r05; ``bench.py`` keeps it measured side by side).
+        Runs the agreement all-gather: every member process must call
+        this inside the same generation's resize.
 
-        Returns (state, restored_step, restore_source)."""
-        from jax.experimental import multihost_utils
+        Returns (state, restored_step, restore_source, transfer_stats).
+        """
+        from edl_tpu.checkpoint import transfer
+        from edl_tpu.checkpoint.hostdram import leaf_placer
 
         # Disk fallback first: after a whole-world restart every member's
         # DRAM is empty but the durable dir is warm — the loaded
         # checkpoint then acts as this member's contribution to the
         # agreement (identical spilled bytes everywhere -> local
-        # restore; a lone survivor's disk copy -> broadcast source).
+        # restore; a lone survivor's disk copy -> transfer source).
         ckpt = self._latest_or_disk(trainer)
-        summary = np.asarray(
-            [
-                1 if ckpt is not None else 0,
-                ckpt.step if ckpt is not None else -1,
-                ckpt.digest() if ckpt is not None else 0,
-            ],
-            np.int64,
-        )
-        world = multihost_utils.process_allgather(summary)
-        haves, steps, digests = world[:, 0], world[:, 1], world[:, 2]
         shardings = (
             trainer.state_shardings()
             if self.model.param_partition is not None
             else None
         )
+        # The model's abstract state is the shared leaf schema: shapes,
+        # dtypes, and treedef come from the model, not from any local
+        # checkpoint (which may be stale or absent).
+        abstract = jax.eval_shape(
+            trainer._init_fn, jax.random.key(trainer.seed)
+        )
+        leaves_abs, treedef = jax.tree_util.tree_flatten(abstract)
+        if shardings is None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-        if not haves.any():
+            leaf_shardings = [
+                NamedSharding(trainer.mesh, PartitionSpec())
+            ] * len(leaves_abs)
+        else:
+            leaf_shardings = jax.tree_util.tree_flatten(shardings)[0]
+        place = leaf_placer(trainer.mesh)
+        placed: List[Any] = [None] * len(leaves_abs)
+
+        def on_leaf(i: int, arr: np.ndarray) -> None:
+            # Per-leaf placement the moment bytes are final: device
+            # transfer of leaf i overlaps the network pull of leaf i+1.
+            placed[i] = place(
+                np.asarray(arr).reshape(leaves_abs[i].shape), leaf_shardings[i]
+            )
+
+        # TornTransferError propagates to _resize, which fails this
+        # resize attempt on EVERY member (the engine's confirmation
+        # all-gather made the verdict world-consistent) and
+        # holds-and-retries: the fresh agreement re-runs
+        # latest_verified on the source, so persistent source
+        # corruption degrades the whole world to the next-oldest
+        # snapshot TOGETHER — one member quietly restoring an older
+        # step would diverge the step counter across a live world.
+        result = transfer.stream_restore(
+            self._transfer_fabric(),
+            leaves_abs,
+            ckpt,
+            chunk_bytes=self.transfer_chunk_bytes,
+            timeout=self.transfer_timeout,
+            chaos=self.store.chaos,
+            on_leaf=on_leaf,
+        )
+
+        stats = result.stats
+        stats_dict = {
+            "mode": stats.mode,
+            "source_rank": stats.source_rank,
+            "bytes_scheduled": stats.bytes_scheduled,
+            "bytes_sent": stats.bytes_sent,
+            "bytes_received": stats.bytes_received,
+            "leaves_received": stats.leaves_received,
+            "leaves_skipped": stats.leaves_skipped,
+            "chunks_received": stats.chunks_received,
+            "seconds": round(stats.seconds, 4),
+        }
+        if stats.mode == "init":
             # Nobody has state (fresh job): deterministic same-seed
             # init everywhere — nothing to move.
-            return trainer.init_state(), 0, "init"
+            return trainer.init_state(), 0, "init", stats_dict
 
-        if haves.all() and len({(int(s), int(d)) for s, d in zip(steps, digests)}) == 1:
-            # Identical bytes everywhere: restore locally, skip the
-            # broadcast entirely.
+        if stats.mode == "local":
+            # Identical bytes everywhere: restore locally, no wire.
             state = self.store.restore(ckpt, trainer.mesh, shardings)
-            return state, int(ckpt.step), "local"
+            return state, int(ckpt.step), "local", stats_dict
 
-        # The source is the newest-checkpoint holder (ties: lowest
-        # rank) — computed identically on every member from the shared
-        # gather, so no extra agreement round-trip is needed.
-        src = max(
-            range(len(haves)), key=lambda r: (int(haves[r]), int(steps[r]), -r)
-        )
-        source = jax.process_index() == src
-        if source:
-            leaves = list(ckpt.leaves)
-            treedef = ckpt.treedef
-        else:
-            # Receiver: build a shape/dtype-congruent template
-            # (structure comes from the model, not from any local
-            # checkpoint, which may be stale or absent).
-            abstract = jax.eval_shape(
-                trainer._init_fn, jax.random.key(trainer.seed)
+        # Delta mode: every leaf was placed (local digest-matched ones
+        # first, received ones as their last chunk landed) — assemble
+        # the state straight from the placed device arrays, no second
+        # host materialization.
+        state = jax.tree_util.tree_unflatten(treedef, placed)
+        if stats.bytes_received:
+            # Adopt the assembled checkpoint so this process can be a
+            # local-restore (or source) member after a future resize.
+            # Zero-copy: the store keeps the very buffers the wire
+            # filled, and the digests come from the source's verified
+            # advertisement instead of a fresh hash pass.
+            merged = HostCheckpoint(
+                step=stats.step,
+                generation=self.generation,
+                leaves=result.leaves,
+                treedef=treedef,
             )
-            leaves_abs, treedef = jax.tree_util.tree_flatten(abstract)
-            leaves = [np.zeros(a.shape, a.dtype) for a in leaves_abs]
-
-        out = multihost_utils.broadcast_one_to_all(leaves, is_source=source)
-        host_leaves = [np.asarray(x) for x in out]
-        merged = HostCheckpoint(
-            step=0,
-            generation=self.generation,
-            leaves=host_leaves,
-            treedef=treedef,
+            merged.adopt_digests(result.leaf_digests)
+            self.store.put(merged)
+        moved = stats.bytes_received or stats.bytes_sent
+        return (
+            state,
+            stats.step,
+            "broadcast" if moved else "local",
+            stats_dict,
         )
-        merged.step = int(np.asarray(merged.unflatten().step))
-        # Adopt the broadcast checkpoint locally so this process can be
-        # a local-restore (or source) member after a future resize.
-        self.store.put(merged)
-        state = self.store.restore(merged, trainer.mesh, shardings)
-        return state, merged.step, "broadcast"
 
     def _beat_once(self):
         if self._leaving:

@@ -1,0 +1,475 @@
+"""Streaming delta-aware restore transfer (checkpoint/transfer.py).
+
+The engine runs here EXACTLY as in production — real TCP on loopback,
+real chunk CRCs, real per-leaf digest agreement — with only the tiny
+allgather swapped for a barrier fabric (``LoopbackWorld``), so the
+wire accounting these tests assert is the production transport's.
+
+The headline regression: a single-joiner resize moves ONLY the bytes
+the joiner lacks (the delta path), never the full state — the property
+that retired the 25.5s monolithic broadcast (BENCH_r05, ISSUE 2).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from edl_tpu.chaos import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint.hostdram import HostCheckpoint, HostDRAMStore
+from edl_tpu.checkpoint import transfer as tx
+
+
+# ---- harness ---------------------------------------------------------------
+
+
+def make_ckpt(leaves, step=10):
+    _, treedef = jax.tree_util.tree_flatten(list(leaves))
+    return HostCheckpoint(
+        step=step, generation=1, leaves=list(leaves), treedef=treedef
+    )
+
+
+def template_of(leaves):
+    return [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+
+
+def run_world(member_fns):
+    """Run one ``stream_restore`` agreement across N in-process
+    members (each on its own thread, as in N real pods).  Returns the
+    per-member results; re-raises the first member error."""
+    world = tx.LoopbackWorld(len(member_fns))
+    results = [None] * len(member_fns)
+    errors = [None] * len(member_fns)
+
+    def runner(rank, fn):
+        try:
+            results[rank] = fn(world.fabric(rank))
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=runner, args=(r, fn), daemon=True)
+        for r, fn in enumerate(member_fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "member thread hung"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+def source_leaves(seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.randn(64, 32).astype(np.float32),
+        rng.randn(257, 16).astype(np.float32),  # odd row count
+        np.asarray(rng.randint(0, 100), np.int32).reshape(()),  # 0-d step
+        rng.randn(1000).astype(np.float64),
+    ]
+
+
+# ---- the delta agreement ---------------------------------------------------
+
+
+def test_single_joiner_moves_only_missing_leaves():
+    """THE acceptance property (ISSUE 2): a joiner that already holds
+    matching bytes for some leaves receives ONLY the diverged ones —
+    zero bytes on the wire for leaves it already holds."""
+    leaves = source_leaves()
+    src_ckpt = make_ckpt(leaves, step=20)
+    # The joiner holds an older checkpoint in which leaves 0 and 3
+    # are byte-identical to the source's, leaf 1 diverged, and the
+    # 0-d step leaf (2) differs (older step).
+    stale = [
+        leaves[0],
+        leaves[1] + 1.0,
+        np.asarray(int(leaves[2]) - 5, np.int32).reshape(()),
+        leaves[3],
+    ]
+    stale_ckpt = make_ckpt(stale, step=15)
+    template = template_of(leaves)
+    missing_bytes = leaves[1].nbytes + leaves[2].nbytes
+
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, src_ckpt),
+            lambda f: tx.stream_restore(f, template, stale_ckpt),
+        ]
+    )
+    assert r0.stats.mode == r1.stats.mode == "delta"
+    assert r0.stats.source_rank == 0 and r1.stats.source_rank == 0
+    assert r0.stats.step == r1.stats.step == 20
+    # Wire accounting: exactly the joiner's missing leaves, nothing
+    # for the leaves it already held.
+    assert r1.stats.bytes_received == missing_bytes
+    assert r0.stats.bytes_sent == missing_bytes
+    assert r0.stats.bytes_scheduled == missing_bytes
+    assert r1.stats.leaves_received == 2
+    assert r1.stats.leaves_skipped == 2
+    # The assembled state is the source's, bit for bit.
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(np.asarray(got).reshape(want.shape), want)
+    # Zero-copy: held leaves are adopted by reference, not copied.
+    assert r1.leaves[0] is stale[0]
+    assert r1.leaves[3] is stale[3]
+
+
+def test_fresh_joiner_receives_everything_with_overlap_callback():
+    leaves = source_leaves(1)
+    src_ckpt = make_ckpt(leaves, step=7)
+    template = template_of(leaves)
+    total = sum(l.nbytes for l in leaves)
+    placed = []
+
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, src_ckpt),
+            lambda f: tx.stream_restore(
+                f,
+                template,
+                None,
+                on_leaf=lambda i, a: placed.append(i),
+            ),
+        ]
+    )
+    assert r1.stats.mode == "delta"
+    assert r1.stats.bytes_received == total
+    assert r1.stats.leaves_received == len(leaves)
+    assert r1.stats.leaves_skipped == 0
+    # Every leaf reached the placement callback exactly once.
+    assert sorted(placed) == list(range(len(leaves)))
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(np.asarray(got).reshape(want.shape), want)
+    # Adoption digests match a fresh hash of the assembled leaves.
+    merged = make_ckpt(r1.leaves, step=7)
+    merged.adopt_digests(r1.leaf_digests)
+    assert merged.verify()
+
+
+def test_identical_stores_move_nothing():
+    leaves = source_leaves(2)
+    a = make_ckpt([np.array(l) for l in leaves], step=5)
+    b = make_ckpt([np.array(l) for l in leaves], step=5)
+    template = template_of(leaves)
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, a),
+            lambda f: tx.stream_restore(f, template, b),
+        ]
+    )
+    for r in (r0, r1):
+        assert r.stats.mode == "local"
+        assert r.stats.bytes_received == r.stats.bytes_sent == 0
+        assert r.stats.bytes_scheduled == 0
+
+
+def test_nobody_has_state_is_init():
+    template = template_of(source_leaves())
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, None),
+            lambda f: tx.stream_restore(f, template, None),
+        ]
+    )
+    assert r0.stats.mode == r1.stats.mode == "init"
+    assert r0.leaves is None
+
+
+def test_three_members_mixed_roles():
+    """Source + identical holder + fresh joiner in one agreement: the
+    holder touches no wire, the schedule totals only the joiner's
+    bytes."""
+    leaves = source_leaves(3)
+    total = sum(l.nbytes for l in leaves)
+    src = make_ckpt(leaves, step=9)
+    twin = make_ckpt([np.array(l) for l in leaves], step=9)
+    template = template_of(leaves)
+    r0, r1, r2 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, src),
+            lambda f: tx.stream_restore(f, template, twin),
+            lambda f: tx.stream_restore(f, template, None),
+        ]
+    )
+    assert r0.stats.mode == "delta"
+    assert r0.stats.bytes_scheduled == total
+    assert r0.stats.bytes_sent == total
+    assert r1.stats.bytes_received == 0 and r1.stats.bytes_sent == 0
+    assert r1.stats.leaves_skipped == len(leaves)
+    assert r2.stats.bytes_received == total
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(np.asarray(got).reshape(want.shape), want)
+
+
+def test_chunked_transfer_splits_large_leaves():
+    """A chunk size far below the leaf sizes must yield a multi-chunk
+    stream that still reassembles bit-exactly."""
+    leaves = source_leaves(4)
+    src_ckpt = make_ckpt(leaves, step=3)
+    template = template_of(leaves)
+    total = sum(l.nbytes for l in leaves)
+    min_chunks = sum(
+        max(1, -(-l.nbytes // 1024)) for l in leaves
+    )
+
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, src_ckpt, chunk_bytes=1024),
+            lambda f: tx.stream_restore(f, template, None, chunk_bytes=1024),
+        ]
+    )
+    assert r1.stats.bytes_received == total
+    assert r1.stats.chunks_received == min_chunks
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(np.asarray(got).reshape(want.shape), want)
+
+
+# ---- chaos: torn and slow chunks (reusing FaultSchedule) -------------------
+
+
+def test_torn_chunk_fails_resize_on_every_member():
+    """chaos[transfer.chunk.torn]: a flipped byte on the wire must
+    surface as TornTransferError on EVERY member (the post-transfer
+    confirmation all-gather makes the verdict world-consistent — one
+    member quietly restoring an older step would diverge the world),
+    and the poisoned leaf must NOT reach the placement callback."""
+    leaves = source_leaves(5)
+    src_ckpt = make_ckpt(leaves, step=4)
+    template = template_of(leaves)
+    chaos = FaultSchedule(
+        seed=7, events=[FaultEvent(step=0, point="transfer.chunk.torn")]
+    )
+    chaos.advance(0)
+    placed = []
+
+    world = tx.LoopbackWorld(2)
+    errs = [None, None]
+
+    def run_src():
+        try:
+            tx.stream_restore(world.fabric(0), template, src_ckpt)
+        except BaseException as e:  # noqa: BLE001 - asserted below
+            errs[0] = e
+
+    def run_joiner():
+        try:
+            tx.stream_restore(
+                world.fabric(1),
+                template,
+                None,
+                chaos=chaos,
+                on_leaf=lambda i, a: placed.append(i),
+            )
+        except BaseException as e:  # noqa: BLE001 - asserted below
+            errs[1] = e
+
+    ts = [
+        threading.Thread(target=run_src, daemon=True),
+        threading.Thread(target=run_joiner, daemon=True),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    # BOTH members see the torn verdict: the resize attempt fails as
+    # one unit and the caller retries with a fresh agreement.
+    assert isinstance(errs[0], tx.TornTransferError), errs[0]
+    assert isinstance(errs[1], tx.TornTransferError), errs[1]
+    assert "member(s) [1]" in str(errs[0])
+    # The torn event fired once and poisoned exactly one leaf: that
+    # leaf never reached placement, the others did.
+    assert len(placed) == len(leaves) - 1
+    assert not chaos.pending()
+
+
+def test_source_rot_after_hash_is_caught_by_advertised_digest():
+    """Chunk CRCs are computed by the source at SEND time, so bytes
+    that rotted between the agreement's hash pass and the send carry
+    self-consistent chunk CRCs — the receiver must still catch them
+    by checking each reassembled leaf against the source's ADVERTISED
+    digest, before adoption (not at the next resize's re-hash)."""
+    leaves = source_leaves(7)
+    src_ckpt = make_ckpt(leaves, step=6)
+    src_ckpt.leaf_digests()  # the agreement will advertise these...
+    rotted = np.array(leaves[1], copy=True)
+    rotted.reshape(-1).view(np.uint8)[7] ^= 0xFF
+    src_ckpt.leaves[1] = rotted  # ...but the wire will carry these
+    template = template_of(leaves)
+    placed = []
+
+    world = tx.LoopbackWorld(2)
+    errs = [None, None]
+
+    def member(rank, ckpt, on_leaf=None):
+        def run():
+            try:
+                tx.stream_restore(
+                    world.fabric(rank), template, ckpt, on_leaf=on_leaf
+                )
+            except BaseException as e:  # noqa: BLE001 - asserted below
+                errs[rank] = e
+
+        return run
+
+    ts = [
+        threading.Thread(target=member(0, src_ckpt), daemon=True),
+        threading.Thread(
+            target=member(1, None, lambda i, a: placed.append(i)),
+            daemon=True,
+        ),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+        assert not t.is_alive()
+    assert isinstance(errs[0], tx.TornTransferError), errs[0]
+    assert isinstance(errs[1], tx.TornTransferError), errs[1]
+    # The rotted leaf never reached placement.
+    assert 1 not in placed and len(placed) == len(leaves) - 1
+
+
+def test_slow_chunk_stalls_but_completes():
+    """chaos[transfer.chunk.slow]: a stalled source link delays the
+    stream without corrupting it."""
+    leaves = source_leaves(6)
+    src_ckpt = make_ckpt(leaves, step=2)
+    template = template_of(leaves)
+    chaos = FaultSchedule(
+        seed=1,
+        events=[FaultEvent(step=0, point="transfer.chunk.slow", arg=0.3)],
+    )
+    chaos.advance(0)
+
+    t0 = time.perf_counter()
+    r0, r1 = run_world(
+        [
+            lambda f: tx.stream_restore(f, template, src_ckpt, chaos=chaos),
+            lambda f: tx.stream_restore(f, template, None),
+        ]
+    )
+    elapsed = time.perf_counter() - t0
+    assert elapsed >= 0.25, elapsed
+    assert r1.stats.bytes_received == sum(l.nbytes for l in leaves)
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(np.asarray(got).reshape(want.shape), want)
+    assert not chaos.pending()
+
+
+# ---- per-leaf digests & adoption ------------------------------------------
+
+
+def test_leaf_digests_localize_divergence():
+    leaves = source_leaves(8)
+    a = make_ckpt([np.array(l) for l in leaves])
+    b_leaves = [np.array(l) for l in leaves]
+    b_leaves[1][3, 4] += 1.0
+    b = make_ckpt(b_leaves)
+    da, db = a.leaf_digests(), b.leaf_digests()
+    assert [i for i in range(len(da)) if da[i] != db[i]] == [1]
+    assert a.digest() != b.digest()
+
+
+def test_digest_derives_from_leaf_digests_and_verify_detects_flips():
+    from edl_tpu.chaos.storage import corrupt_checkpoint
+    from edl_tpu.checkpoint.hostdram import _pack_leaf_digests
+
+    ck = make_ckpt(source_leaves(9))
+    assert ck.digest() == _pack_leaf_digests(ck.leaf_digests())
+    assert ck.verify()
+    corrupt_checkpoint(ck)
+    assert not ck.verify()
+
+
+def test_legacy_manifest_cold_load_survives_digest_algorithm_change():
+    """Durable spills written by the pre-delta revision carry a
+    CHAINED-crc digest and no digest_v: the cold-start load must
+    verify them with the legacy formula, not classify a healthy
+    volume as corrupt (the digest algorithm changed to per-leaf crc
+    vectors in this revision)."""
+    import json
+    import glob
+    import tempfile
+
+    from edl_tpu.checkpoint.hostdram import _legacy_chained_crc
+
+    with tempfile.TemporaryDirectory() as spill:
+        store = HostDRAMStore(spill_dir=spill)
+        state = {"w": np.arange(100, dtype=np.float32), "step": 3}
+        store.save_async(state)
+        store.wait()
+        # Rewrite the manifest as the OLD revision would have.
+        (mpath,) = glob.glob(f"{spill}/ckpt-*.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest.pop("digest_v")
+        manifest.pop("leaf_digests")
+        manifest["digest"] = _legacy_chained_crc(
+            store.latest().leaves
+        )
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+
+        cold = HostDRAMStore(spill_dir=spill)
+        ckpt = cold.load_from_disk(state)
+        np.testing.assert_array_equal(ckpt.leaves[1], state["w"])
+        # Fresh v2 digests were cached on the way in.
+        assert ckpt.verify()
+
+        # A legacy manifest whose bytes DON'T match its chained crc is
+        # still corruption, not a free pass.
+        manifest["digest"] ^= 0x1
+        with open(mpath, "w") as f:
+            json.dump(manifest, f)
+        colder = HostDRAMStore(spill_dir=spill)
+        with pytest.raises(RuntimeError, match="failed CRC"):
+            colder.load_from_disk(state)
+
+
+# ---- stale save-error race (ADVICE r5, hostdram.wait) ----------------------
+
+
+class _LeafThatDies:
+    """A pytree leaf whose host materialization blocks, then fails —
+    the shape of a save thread stuck in a dead world's collective."""
+
+    def __init__(self, delay=0.3):
+        self.delay = delay
+
+    def __array__(self, dtype=None, copy=None):
+        time.sleep(self.delay)
+        raise RuntimeError("dead world's collective failed")
+
+
+def test_abandoned_save_error_does_not_poison_next_wait():
+    """The broken-world path waits a bounded time and leaks the stuck
+    save thread; when that thread later dies, its error must NOT
+    surface from the NEXT wait() and spuriously degrade an unrelated
+    graceful resize to the replay path (ADVICE r5)."""
+    store = HostDRAMStore()
+    th = store.save_async({"w": _LeafThatDies(0.3), "step": 1})
+    # Broken-world recovery: bounded wait expires -> thread abandoned.
+    store.wait(timeout=0.05)
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert store._save_errors  # the stale error DID land...
+    store.wait()  # ...and the next healthy wait() discards it
+    assert not store._save_errors
+
+
+def test_unabandoned_save_error_still_raises():
+    """The tagging must not swallow REAL errors: a save that fails
+    while still tracked surfaces at the next wait()."""
+    store = HostDRAMStore()
+    store.save_async({"w": _LeafThatDies(0.0), "step": 2})
+    with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        store.wait()

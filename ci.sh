@@ -8,6 +8,15 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# Persistent XLA compilation cache: repeated CI runs stop re-paying the
+# identical CPU-mesh compiles (the same mechanism trainer pods use via
+# EDL_COMPILE_CACHE_DIR).  Threshold drops to cache-everything — CPU
+# test compiles are mostly under jax's 1s default and would never land.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-${TMPDIR:-/tmp}/edl-xla-cache}"
+export JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="${JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS:-0}"
+export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="${JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES:--1}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
 python tools/lint.py
 # Tier-1: the full quick suite INCLUDING the seeded single-cycle chaos
 # soak (tests/test_chaos.py).  The multi-cycle soak is marked `slow`

@@ -207,6 +207,13 @@ class Autoscaler:
             job = self.jobs.get(name)
             if job is None:
                 continue
+            # Prewarm announcement FIRST — before any retarget or PUT:
+            # trainers AOT-compile the incoming world size's step while
+            # still stepping at the current one, so the resize window
+            # this actuation triggers contains zero cold compiles
+            # (zero-stall resize).  Purely advisory and best-effort: a
+            # lost hint only costs the overlapped cold compile.
+            self._announce_prewarm(job, parallelism)
             scale_down = diff.get(name, 0) < 0
             if scale_down:
                 client = self._retarget(job, parallelism)
@@ -226,6 +233,20 @@ class Autoscaler:
                 continue
             if not scale_down:
                 self._retarget(job, parallelism)
+
+    def _announce_prewarm(self, job: TrainingJob, world: int) -> None:
+        """POST the planned next parallelism to the job's coordinator
+        (``/prewarm``) so trainers warm exactly the incoming world
+        size.  Tolerates clients without the endpoint (injected test
+        doubles, older coordinators) — the hint is an optimization, a
+        failure to deliver it must never block the actuation."""
+        try:
+            client = self._coord_client(job)
+            hint = getattr(client, "set_prewarm", None)
+            if hint is not None:
+                hint(world)
+        except Exception:
+            pass  # the resize still works, with an overlapped cold compile
 
     def _retarget(self, job: TrainingJob, world: int):
         """POST the new target world to the job's coordinator.  Returns

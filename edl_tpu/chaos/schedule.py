@@ -50,6 +50,7 @@ KNOWN_POINTS = (
     "checkpoint.save_thread",    # async save worker dies
     "checkpoint.corrupt",        # flip bytes in the newest snapshot
     "checkpoint.spill",          # spill-dir I/O error
+    "flush.spill.slow",          # resize flush's bg hash/spill stalls arg s
     # (3b) streaming restore transfer (checkpoint.transfer)
     "transfer.chunk.torn",       # flip a byte in one received chunk
     "transfer.chunk.slow",       # stall the source arg s before a send
@@ -57,6 +58,8 @@ KNOWN_POINTS = (
     "kube.conflict",             # next N update_workload: ConflictError
     "kube.hold",                 # job's pods stick Pending (arg: job)
     "kube.release",              # release a held job (arg: job)
+    # (5) AOT prewarm (runtime.elastic._maybe_prewarm)
+    "prewarm.hint.dropped",      # autoscaler prewarm hint lost en route
 )
 
 

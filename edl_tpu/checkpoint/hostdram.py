@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -72,16 +73,92 @@ def leaf_placer(mesh: Mesh):
     multiproc = any(
         d.process_index != jax.process_index() for d in mesh.devices.flat
     )
+    cpu = all(d.platform == "cpu" for d in mesh.devices.flat)
 
     def place(x, s):
         if not multiproc:
+            if cpu and isinstance(x, np.ndarray):
+                # CPU backend: device_put ZERO-COPIES aligned numpy — a
+                # replicated target then backs every per-device
+                # "buffer" with the checkpoint's own bytes.  The train
+                # step donates its state input, and a persistent-cache
+                # DESERIALIZED executable performs that donation as a
+                # true in-place write (the freshly-compiled path copies
+                # external zero-copy buffers): each replica increments
+                # the ONE shared buffer, so a restored step counter
+                # advances by world_size per step and the checkpoint's
+                # host bytes silently follow the live state.  Staging
+                # an owned device array first makes device_put produce
+                # per-device owned buffers (host memory either way on
+                # CPU; real accelerators always DMA a copy).  The
+                # staging lowers through pjit — one tiny XLA compile
+                # per distinct leaf shape/dtype, paid OUTSIDE the
+                # resize window by ``warm_leaf_conversions`` (a fresh
+                # per-shard numpy copy via make_array_from_callback
+                # would avoid the compile but is zero-copied by this
+                # jaxlib without keeping the temp alive — dangling
+                # buffers).
+                x = jnp.array(x)
             return jax.device_put(x, s)
         arr = np.asarray(x)
+        if cpu:
+            # Same zero-copy hazard as above, per local device: two
+            # local replicas handed the same host slice would share one
+            # buffer.  Staging each shard through jnp.array hands the
+            # callback machinery a jax-OWNED buffer, so every device
+            # gets a distinct owned copy.  (A fresh numpy temp per
+            # callback would also be distinct but this jaxlib
+            # zero-copies it without keeping the temp alive — the
+            # buffers dangle once the temp is collected, and workers
+            # die with SIGSEGV/SIGABRT under memory pressure.)
+            return jax.make_array_from_callback(
+                arr.shape, s, lambda idx: jnp.array(arr[idx])
+            )
         return jax.make_array_from_callback(
             arr.shape, s, lambda idx: arr[idx]
         )
 
     return place
+
+
+#: (shape, dtype) pairs whose CPU staging conversion is already
+#: compiled in this process (the jnp.array jit cache is keyed the same
+#: way and shared across meshes/world sizes).
+_warmed_leaf_conversions: set = set()
+
+
+def warm_leaf_conversions(abstract_leaves) -> int:
+    """Pre-compile the tiny ``jnp.array`` staging programs the CPU
+    branch of ``leaf_placer`` dispatches — one per distinct leaf
+    shape/dtype — so a trainer's FIRST restore doesn't pay them inside
+    the resize window (they are mesh-independent, so one pass covers
+    every world size).  No-op off the CPU backend, where ``device_put``
+    stages via DMA and never compiles.  Returns how many conversions
+    were warmed (transient host allocation of one leaf at a time; the
+    staged device arrays are dropped immediately)."""
+    if jax.default_backend() != "cpu":
+        return 0
+    warmed = 0
+    for l in abstract_leaves:
+        key = (tuple(l.shape), np.dtype(l.dtype).str)
+        if key in _warmed_leaf_conversions:
+            continue
+        jnp.array(np.zeros(l.shape, l.dtype))
+        # Memoized only on success — and invalidated wholesale when
+        # the launcher clears backends (multi-pod world teardown
+        # drops the compiled executables this set claims exist).
+        _warmed_leaf_conversions.add(key)
+        warmed += 1
+    return warmed
+
+
+def reset_leaf_conversion_warmth() -> None:
+    """Forget which staging conversions are compiled.  Must accompany
+    ``jax.extend.backend.clear_backends()`` (the launcher's world
+    teardown): the executables die with the backend, and a stale memo
+    would silently push those compiles back inside the next resize
+    window's restore phase."""
+    _warmed_leaf_conversions.clear()
 
 
 def _cover_regions(l) -> Optional[List[Any]]:
@@ -173,10 +250,17 @@ class HostCheckpoint:
         (``checkpoint/transfer.py``): members all-gather these so a
         joiner receives ONLY the leaves whose bytes it lacks, and a
         receiver can verify each transferred leaf against the source's
-        advertised digest.  One host memory pass on first call."""
-        if self._leaf_digests is None:
-            self._leaf_digests = self._leaf_crcs()
-        return self._leaf_digests
+        advertised digest.  One host memory pass on first call.
+
+        Thread-safe: the resize window now fingerprints checkpoints
+        concurrently (the flush's background hash/spill thread vs the
+        restore agreement on the resize thread) — the lock makes one
+        pass compute and the other reuse, instead of both paying the
+        full memory pass."""
+        with self._hash_lock:
+            if self._leaf_digests is None:
+                self._leaf_digests = self._leaf_crcs()
+            return self._leaf_digests
 
     def digest(self) -> int:
         """Content fingerprint (crc32 over the per-leaf crc vector),
@@ -186,9 +270,10 @@ class HostCheckpoint:
         checkpoint — same step AND same bytes — so a graceful resize can
         skip moving any state (joiner-only restore).  One host memory
         pass on first call (shared with ``leaf_digests``); O(1) after."""
-        if self._digest is None:
-            self._digest = _pack_leaf_digests(self.leaf_digests())
-        return self._digest
+        with self._hash_lock:
+            if self._digest is None:
+                self._digest = _pack_leaf_digests(self.leaf_digests())
+            return self._digest
 
     def verify(self) -> bool:
         """Whether the leaves still hash to the digest recorded when it
@@ -197,14 +282,15 @@ class HostCheckpoint:
         fault.  Full memory pass; runs only on the (rare) restore path.
         With no recorded digest there is nothing to check against:
         record one now and report clean."""
-        if self._digest is None:
-            self.digest()
+        with self._hash_lock:
+            if self._digest is None:
+                self.digest()
+                return True
+            fresh = self._leaf_crcs()
+            if _pack_leaf_digests(fresh) != self._digest:
+                return False
+            self._leaf_digests = fresh
             return True
-        fresh = self._leaf_crcs()
-        if _pack_leaf_digests(fresh) != self._digest:
-            return False
-        self._leaf_digests = fresh
-        return True
 
     def adopt_digests(self, leaf_digests: List[int]) -> None:
         """Install externally verified per-leaf digests (the streaming
@@ -212,12 +298,18 @@ class HostCheckpoint:
         digest-matched every skipped one against the source's
         advertisement, so no re-hash pass is needed — the zero-copy
         adoption half of the transfer engine)."""
-        self._leaf_digests = [int(d) for d in leaf_digests]
-        self._digest = _pack_leaf_digests(self._leaf_digests)
+        with self._hash_lock:
+            self._leaf_digests = [int(d) for d in leaf_digests]
+            self._digest = _pack_leaf_digests(self._leaf_digests)
 
     _digest: Optional[int] = field(default=None, repr=False, compare=False)
     _leaf_digests: Optional[List[int]] = field(
         default=None, repr=False, compare=False
+    )
+    #: serializes fingerprint computation across threads (reentrant:
+    #: digest() computes via leaf_digests() under the same lock)
+    _hash_lock: Any = field(
+        default_factory=threading.RLock, repr=False, compare=False
     )
 
 
@@ -261,40 +353,22 @@ class HostDRAMStore:
         self._tmp_counter = 0
 
     # -- save ---------------------------------------------------------------
-    def save_async(self, state, generation: int = 0) -> threading.Thread:
-        """Snapshot ``state`` (a pytree of jax Arrays) into host DRAM.
+    def _snapshot_leaves(self, leaves: List[Any]) -> List[Any]:
+        """Device-side snapshot of ``leaves`` with the d2h DMA issued.
 
-        Returns the worker thread (join it, or call ``wait()``, to
-        ensure completion).  The device buffers are captured by
-        reference and DMA'd; the step loop may immediately donate/mutate
-        its own handle because XLA arrays are immutable."""
-        t0 = time.perf_counter()
-        leaves, treedef = jax.tree_util.tree_flatten(state)
-        step_val = _extract_step(state)
+        The step loop donates its state buffers into the next step
+        (``Trainer`` uses donate_argnums to keep HBM footprint flat), so
+        the original leaves may be invalidated while the host copy is
+        still in flight.  jnp.copy dispatches asynchronously; the
+        snapshot buffers are owned here and immune to donation.
 
-        with self._lock:
-            if step_val in self._checkpoints or step_val in self._inflight_steps:
-                th = threading.Thread(target=lambda: None, daemon=True)
-                th.start()
-                return th
-            self._inflight_steps.add(step_val)
-            self._save_seq += 1
-            save_id = self._save_seq
-
-        # Device-side snapshot first: the step loop donates its state
-        # buffers into the next step (``Trainer`` uses donate_argnums to
-        # keep HBM footprint flat), so the original leaves may be
-        # invalidated while the host copy is still in flight.  jnp.copy
-        # dispatches asynchronously; the snapshot buffers are owned here
-        # and immune to donation.
-        #
-        # Leaves spanning processes (multi-pod world) can't be fetched
-        # by device_get unless fully replicated; replicate them with an
-        # XLA allgather first.  That is a collective: every member of
-        # the world must dispatch the same saves in the same order —
-        # which holds, because interval saves fire at identical steps
-        # on every member and resize flushes run once per generation on
-        # every old-world member.
+        Leaves spanning processes (multi-pod world) can't be fetched
+        by device_get unless fully replicated; replicate them with an
+        XLA allgather first.  That is a collective: every member of
+        the world must dispatch the same saves in the same order —
+        which holds, because interval saves fire at identical steps
+        on every member and resize flushes run once per generation on
+        every old-world member."""
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -344,6 +418,106 @@ class HostDRAMStore:
                     leaf.copy_to_host_async()
                 except Exception:  # non-addressable or already host
                     pass
+        return leaves
+
+    @staticmethod
+    def _materialize(leaves: List[Any]) -> List[np.ndarray]:
+        """Complete the device->host copies into owned numpy arrays."""
+        return [
+            l.assemble()
+            if isinstance(l, _ShardAssembly)
+            else np.asarray(jax.device_get(l))
+            for l in leaves
+        ]
+
+    @staticmethod
+    def _materialize_inline(leaves: List[Any]) -> List[np.ndarray]:
+        """Flush-path materialization: d2h straight from the LIVE
+        buffers, no owned device copies.
+
+        The interval-save path snapshots with ``jnp.copy`` because the
+        step loop keeps running and donates the state buffers into the
+        next step while the background device_get is in flight.  Inside
+        the resize barrier no further step can dispatch until restore
+        replaces the state, so the live buffers cannot be donated out
+        from under a synchronous read — which also means the flush
+        never compiles the per-(shape, sharding) snapshot-copy jits
+        inside the window (a cold world size's first flush used to pay
+        one XLA compile per leaf right in the ordered phase)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        # Issue every d2h DMA first, then collect: transfers overlap.
+        staged: List[Any] = []
+        for l in leaves:
+            if isinstance(l, jax.Array) and not (
+                l.is_fully_addressable or l.is_fully_replicated
+            ):
+                regions = _cover_regions(l)
+                if regions is not None:
+                    staged.append(
+                        _ShardAssembly(
+                            l.shape,
+                            l.dtype,
+                            [(key, sh.data) for key, sh in regions],
+                        )
+                    )
+                    continue
+                # Truly cross-process sharded: replicate via an XLA
+                # allgather (a collective — same ordering contract as
+                # the save path's, see _snapshot_leaves).
+                staged.append(
+                    jax.jit(
+                        lambda a: a,
+                        out_shardings=NamedSharding(
+                            l.sharding.mesh, PartitionSpec()
+                        ),
+                    )(l)
+                )
+                continue
+            staged.append(l)
+        for l in staged:
+            if isinstance(l, _ShardAssembly):
+                for _, data in l.parts:
+                    try:
+                        data.copy_to_host_async()
+                    except Exception:
+                        pass
+            elif isinstance(l, jax.Array):
+                try:
+                    l.copy_to_host_async()
+                except Exception:
+                    pass
+        return HostDRAMStore._materialize(staged)
+
+    def _publish(self, ckpt: HostCheckpoint) -> None:
+        """Install a materialized checkpoint and prune to ``keep``."""
+        with self._lock:
+            self._checkpoints[ckpt.step] = ckpt
+            extra = sorted(self._checkpoints)[: -self.keep]
+            for s in extra:
+                del self._checkpoints[s]
+
+    def save_async(self, state, generation: int = 0) -> threading.Thread:
+        """Snapshot ``state`` (a pytree of jax Arrays) into host DRAM.
+
+        Returns the worker thread (join it, or call ``wait()``, to
+        ensure completion).  The device buffers are captured by
+        reference and DMA'd; the step loop may immediately donate/mutate
+        its own handle because XLA arrays are immutable."""
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        step_val = _extract_step(state)
+
+        with self._lock:
+            if step_val in self._checkpoints or step_val in self._inflight_steps:
+                th = threading.Thread(target=lambda: None, daemon=True)
+                th.start()
+                return th
+            self._inflight_steps.add(step_val)
+            self._save_seq += 1
+            save_id = self._save_seq
+
+        leaves = self._snapshot_leaves(leaves)
 
         def work():
             try:
@@ -353,16 +527,10 @@ class HostDRAMStore:
                     # _save_errors; the next wait() must surface it and
                     # the resize path must degrade to replay.
                     self.chaos.maybe_raise("checkpoint.save_thread")
-                host_leaves = [
-                    l.assemble()
-                    if isinstance(l, _ShardAssembly)
-                    else np.asarray(jax.device_get(l))
-                    for l in leaves
-                ]
                 ckpt = HostCheckpoint(
                     step=step_val,
                     generation=generation,
-                    leaves=host_leaves,
+                    leaves=self._materialize(leaves),
                     treedef=treedef,
                     created_at=time.time(),
                     save_seconds=time.perf_counter() - t0,
@@ -372,11 +540,7 @@ class HostDRAMStore:
                 # all-gather, and a full-DRAM crc pass there would sit
                 # on the <60s critical path the digest exists to cut.
                 ckpt.digest()
-                with self._lock:
-                    self._checkpoints[step_val] = ckpt
-                    extra = sorted(self._checkpoints)[: -self.keep]
-                    for s in extra:
-                        del self._checkpoints[s]
+                self._publish(ckpt)
                 if self.spill_dir:
                     self._spill(ckpt)
             except BaseException as e:  # pragma: no cover - defensive
@@ -388,6 +552,11 @@ class HostDRAMStore:
 
         th = threading.Thread(target=work, daemon=True, name=f"ckpt-save-{step_val}")
         th.edl_save_id = save_id
+        self._track(th)
+        th.start()
+        return th
+
+    def _track(self, th: threading.Thread) -> None:
         with self._lock:
             # Prune finished workers so a long run between wait() calls
             # doesn't retain one Thread object per interval save.  A
@@ -397,8 +566,104 @@ class HostDRAMStore:
                 p for p in self._pending if p.ident is None or p.is_alive()
             ]
             self._pending.append(th)
+
+    def flush_sync(self, state, generation: int = 0):
+        """The resize-window flush: device->host ORDERED, fingerprint +
+        spill OVERLAPPED.
+
+        Returns ``(ckpt, background_thread_or_None)``.  Only the
+        device-to-host materialization runs on the caller thread —
+        that part alone must precede world teardown (the device buffers
+        die with the old process group).  The crc fingerprint and the
+        durable-dir spill move to a background thread that overlaps
+        world formation / compile / restore; the caller joins it before
+        the resize returns (``elastic._resize``), so the graceful
+        guarantee — flushed state durable and fingerprinted before the
+        next step runs — is unchanged, it just stops serializing the
+        resize window.  A background failure is recorded on the
+        returned thread (``edl_error``), NOT in ``_save_errors``: the
+        caller joins and handles it, and a handled error lingering in
+        the store would spuriously degrade a LATER unrelated resize to
+        the replay path (the ADVICE r5 class of bug).
+
+        Dedup mirrors ``save_async``: a step already stored returns its
+        checkpoint with no work; a save of the same step in flight is
+        waited out (its d2h must land before teardown either way)."""
+        t0 = time.perf_counter()
+        step_val = _extract_step(state)
+        for _ in range(2):
+            with self._lock:
+                ckpt = self._checkpoints.get(step_val)
+                inflight = step_val in self._inflight_steps
+            if ckpt is not None:
+                return ckpt, None
+            if not inflight:
+                break
+            # An interval save of this very step is mid-materialization:
+            # join it (wait() re-raises its errors exactly like the old
+            # monolithic flush did) and re-check; if it errored, fall
+            # through to a fresh flush attempt.
+            self.wait()
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        with self._lock:
+            self._inflight_steps.add(step_val)
+            self._save_seq += 1
+            save_id = self._save_seq
+        try:
+            if self.chaos is not None:
+                # chaos[checkpoint.save_thread]: the thread doing the
+                # host materialization dies mid-flush (same fault the
+                # async worker path injects) — raises synchronously
+                # here, and the resize degrades to interval-checkpoint
+                # + replay.
+                self.chaos.maybe_raise("checkpoint.save_thread")
+            # Stage A (ordered before teardown): host copy straight off
+            # the live buffers — the resize barrier guarantees no step
+            # can donate them mid-read (see _materialize_inline).
+            host_leaves = self._materialize_inline(leaves)
+        except BaseException:
+            with self._lock:
+                self._inflight_steps.discard(step_val)
+            raise
+        ckpt = HostCheckpoint(
+            step=step_val,
+            generation=generation,
+            leaves=host_leaves,
+            treedef=treedef,
+            created_at=time.time(),
+            save_seconds=time.perf_counter() - t0,
+        )
+        self._publish(ckpt)
+
+        def finish():
+            t1 = time.perf_counter()
+            try:
+                if self.chaos is not None:
+                    # chaos[flush.spill.slow]: the background hash/spill
+                    # thread stalls (cold page cache, contended durable
+                    # volume) — the resize must overlap it, and its join
+                    # at the end of the window must stay bounded.
+                    for ev in self.chaos.due("flush.spill.slow"):
+                        time.sleep(float(ev.arg or 0.05))
+                ckpt.digest()
+                if self.spill_dir:
+                    self._spill(ckpt)
+            except BaseException as e:
+                th.edl_error = e
+            finally:
+                th.edl_seconds = time.perf_counter() - t1
+                with self._lock:
+                    self._inflight_steps.discard(step_val)
+
+        th = threading.Thread(
+            target=finish, daemon=True, name=f"ckpt-flush-{step_val}"
+        )
+        th.edl_save_id = save_id
+        th.edl_error = None
+        th.edl_seconds = 0.0
+        self._track(th)
         th.start()
-        return th
+        return ckpt, th
 
     def wait(self, timeout: Optional[float] = None):
         """Block until all in-flight saves have landed; re-raise errors.

@@ -136,6 +136,13 @@ def cmd_local_run(args) -> int:
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     job = _load_job(args.spec)
+    if job.spec.compile_cache_dir:
+        # Same persistent-XLA-cache wiring the deployed pods get via
+        # EDL_COMPILE_CACHE_DIR: repeated local runs of one spec skip
+        # recompilation entirely.
+        from edl_tpu.launcher import configure_compile_cache
+
+        configure_compile_cache(job.spec.compile_cache_dir)
     layout = job.spec.trainer.parallelism.axes()
     model_factory = bind_model(
         job.spec.trainer.entrypoint or "mnist",

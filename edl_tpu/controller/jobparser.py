@@ -90,6 +90,11 @@ def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
         # = pure dp).  The launcher builds every generation's mesh as
         # dp x <these axes>, dp absorbing the elastic world size.
         {"name": "EDL_PARALLELISM", "value": t.parallelism.env_value()},
+        # Persistent XLA compilation cache (mounted volume): joiners and
+        # cold starts deserialize previously compiled step executables
+        # instead of recompiling inside the resize window (the launcher
+        # pins jax_compilation_cache_dir at it).
+        {"name": "EDL_COMPILE_CACHE_DIR", "value": job.spec.compile_cache_dir},
         # downward API (ref ``:302-312``)
         {
             "name": "EDL_NAMESPACE",

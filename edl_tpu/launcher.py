@@ -42,6 +42,8 @@ def env_config() -> dict:
         "data_dir": e.get("EDL_DATA_DIR", ""),
         # durable checkpoint volume; "" = host-DRAM only
         "checkpoint_dir": e.get("EDL_CHECKPOINT_DIR", ""),
+        # persistent XLA compilation cache volume; "" = no cache
+        "compile_cache_dir": e.get("EDL_COMPILE_CACHE_DIR", ""),
         # "fsdp=2,tp=2" (jobparser's EDL_PARALLELISM); "" = pure dp.
         "parallelism": e.get("EDL_PARALLELISM", ""),
         "pod_name": e.get("EDL_POD_NAME", ""),
@@ -75,6 +77,42 @@ def env_config() -> dict:
     }
 
 
+def configure_compile_cache(cache_dir: str) -> None:
+    """Wire the persistent XLA compilation cache at ``cache_dir``
+    (EDL_COMPILE_CACHE_DIR, from the TrainingJob's
+    ``spec.compile_cache_dir``).
+
+    With it, a compile whose HLO was ever compiled before — by THIS
+    pod in a previous generation, by a peer sharing the mounted volume,
+    or by a previous incarnation of a restarted pod — deserializes from
+    disk instead of recompiling, which removes the cold-compile cost
+    from joiner restores and whole-world cold starts entirely.  The
+    threshold knobs drop to "cache everything": elastic train steps are
+    exactly the repeated-compile workload the thresholds exist to
+    filter out of one-shot jobs.  Knob names are pinned per jax
+    version; a renamed knob degrades to that knob's default rather
+    than failing the pod at boot."""
+    if not cache_dir:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for knob, val in (
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # pragma: no cover - knob renamed upstream
+            import sys
+
+            print(
+                f"[edl] compile-cache knob {knob} unavailable on this "
+                "jax; persistent cache keeps that knob's default",
+                file=sys.stderr,
+            )
+
+
 def force_platform(platform: str) -> None:
     """Pin the JAX platform (tests / CPU smoke runs).  Must run before
     the first device query; config.update beats any platform selection
@@ -82,10 +120,27 @@ def force_platform(platform: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", platform)
-    if platform == "cpu":
-        # Multi-process CPU worlds need a collectives implementation
-        # (TPU worlds get theirs from ICI/DCN natively).
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # NOTE: multi-process CPU worlds need gloo collectives (TPU worlds
+    # get theirs from ICI/DCN natively), but gloo is NOT configured
+    # here: jaxlib's make_gloo_tcp_collectives requires a LIVE
+    # distributed client, and any jax op dispatched before
+    # jax.distributed.initialize — model binding's layout validation,
+    # an abstract prewarm — would try to build the CPU backend with
+    # gloo configured and no client, killing the pod at boot.  The
+    # world builder flips gloo on right after each successful
+    # initialize (backends are cleared every generation) and back off
+    # at teardown, so backends built while unformed stay plain.
+
+
+def _set_cpu_collectives(impl: str) -> None:
+    """Switch the CPU collectives implementation (no-op off the forced
+    CPU platform).  Only meaningful between backend builds — the world
+    builder calls it with backends cleared."""
+    import jax
+
+    if (jax.config.jax_platforms or "") != "cpu":
+        return
+    jax.config.update("jax_cpu_collectives_implementation", impl)
 
 
 def _install_nonfatal_heartbeat_callback() -> None:
@@ -114,10 +169,15 @@ def _install_nonfatal_heartbeat_callback() -> None:
 
     try:
         from jax._src import distributed as _dist
-
-        jaxlib = _dist._jax
-    except (ImportError, AttributeError) as e:
-        return warn(f"jax._src.distributed._jax unavailable: {e}")
+    except ImportError as e:
+        return warn(f"jax._src.distributed unavailable: {e}")
+    # The factory's host module moved across jax versions: newer jax
+    # calls ``_dist._jax.get_distributed_runtime_client``, 0.4.x calls
+    # ``_dist.xla_extension.get_distributed_runtime_client`` — patch
+    # whichever alias THIS jax's initialize() actually reads.
+    jaxlib = getattr(_dist, "_jax", None)
+    if jaxlib is None or not hasattr(jaxlib, "get_distributed_runtime_client"):
+        jaxlib = getattr(_dist, "xla_extension", None)
     if jaxlib is None or not hasattr(jaxlib, "get_distributed_runtime_client"):
         return warn("get_distributed_runtime_client attribute missing")
     if getattr(jaxlib, "_edl_nonfatal_heartbeats", False):
@@ -338,6 +398,19 @@ def make_world_builder(
             from jax.extend.backend import clear_backends
 
             clear_backends()
+            # The restore path's staging-conversion executables died
+            # with the backend: forget they were warm, or the next
+            # generation's first restore pays them back inside the
+            # resize window believing them compiled.
+            from edl_tpu.checkpoint.hostdram import (
+                reset_leaf_conversion_warmth,
+            )
+
+            reset_leaf_conversion_warmth()
+        # Unformed process: the next backend build (standby-hold jax
+        # ops, restart-path model binding) must not reach for gloo —
+        # there is no distributed client for it to ride on.
+        _set_cpu_collectives("none")
 
     def build(plan):
         t0 = _time.perf_counter()
@@ -354,6 +427,23 @@ def make_world_builder(
         rank = plan.members.index(trainer_id)
         host, base = plan.addresses[0].rsplit(":", 1)
         t1 = _time.perf_counter()
+        # Teardown-barrier patience: long enough that a loaded peer's
+        # graceful leave (both parties alive, skewed tens of seconds
+        # under CI load) still completes the barrier — a timeout here
+        # risks the coordination service's error propagation — yet far
+        # under the 300s default so a standby pod doesn't stall its
+        # hold.  Dead-peer worlds never reach this barrier at all (see
+        # teardown()).  The knob is newer than some supported jax
+        # versions; passing it unconditionally would fail EVERY
+        # formation with a TypeError the hold-and-retry loop silently
+        # eats — the world then never forms at all.
+        import inspect
+
+        init_kwargs = {}
+        if "shutdown_timeout_seconds" in inspect.signature(
+            jax.distributed.initialize
+        ).parameters:
+            init_kwargs["shutdown_timeout_seconds"] = 30
         for attempt in range(_FORMATION_ATTEMPTS):
             port = int(base) + 1 + (
                 (plan.generation * _FORMATION_ATTEMPTS + attempt)
@@ -369,15 +459,7 @@ def make_world_builder(
                     num_processes=len(plan.members),
                     process_id=rank,
                     initialization_timeout=_FORMATION_TIMEOUT_S,
-                    # Teardown-barrier patience: long enough that a
-                    # loaded peer's graceful leave (both parties alive,
-                    # skewed tens of seconds under CI load) still
-                    # completes the barrier — a timeout here risks the
-                    # coordination service's error propagation — yet
-                    # far under the 300s default so a standby pod
-                    # doesn't stall its hold.  Dead-peer worlds never
-                    # reach this barrier at all (see teardown()).
-                    shutdown_timeout_seconds=30,
+                    **init_kwargs,
                 )
                 break
             except Exception:
@@ -394,6 +476,12 @@ def make_world_builder(
                 teardown()
                 if attempt == _FORMATION_ATTEMPTS - 1:
                     raise
+        # The distributed client is live and backends were cleared in
+        # teardown(): the jax.devices() below builds this generation's
+        # backend, and on CPU it must carry gloo collectives riding
+        # that client (configuring gloo any earlier kills the process —
+        # see force_platform).
+        _set_cpu_collectives("gloo")
         devices = jax.devices()
         if formation_log is not None:
             formation_log(
@@ -454,6 +542,8 @@ def run(
     data_dir: str = "",
     parallelism: str = "",
     checkpoint_dir: str = "",
+    compile_cache_dir: str = "",
+    lr: float = 1e-3,
 ) -> "ElasticTrainer":
     """Build and run the elastic training loop for a registered model.
 
@@ -469,6 +559,9 @@ def run(
     from edl_tpu.runtime.elastic import ElasticTrainer
 
     cfg = env_config()
+    # Before any compile: every generation's step executable lands in /
+    # loads from the shared cache (joiners and cold starts skip XLA).
+    configure_compile_cache(compile_cache_dir or cfg["compile_cache_dir"])
     par = ParallelismSpec.from_env(parallelism or cfg["parallelism"])
     layout = par.axes()
     # bind_model validates layout-vs-entrypoint up front (boot-time
@@ -588,7 +681,7 @@ def run(
 
     et = ElasticTrainer(
         model_factory if layout else model,
-        optax.adam(1e-3),
+        optax.adam(lr),
         data,
         coordinator,
         store=store,
@@ -779,6 +872,21 @@ def main(argv=None):  # pragma: no cover - process entrypoint
             "EDL_CHECKPOINT_DIR); cold starts restore from it"
         ),
     )
+    p.add_argument(
+        "--compile-cache-dir",
+        default="",
+        help=(
+            "persistent XLA compilation cache directory (normally from "
+            "EDL_COMPILE_CACHE_DIR); joiners/cold starts skip "
+            "recompilation"
+        ),
+    )
+    p.add_argument(
+        "--lr",
+        type=float,
+        default=1e-3,
+        help="adam learning rate for the training step",
+    )
     args = p.parse_args(argv)
 
     if args.platform:
@@ -798,6 +906,8 @@ def main(argv=None):  # pragma: no cover - process entrypoint
         history_file=args.history_file,
         parallelism=args.parallelism,
         checkpoint_dir=args.checkpoint_dir,
+        compile_cache_dir=args.compile_cache_dir,
+        lr=args.lr,
     )
     last = et.history[-1] if et.history else None
     print(

@@ -296,6 +296,15 @@ class TrainingJobSpec:
     #: its master+etcd sidecar (ref ``pkg/jobparser.go:174-191``;
     #: design doc pointer ``README.md:18-21``); "" = DRAM-only.
     checkpoint_dir: str = ""
+    #: persistent XLA compilation-cache directory (a mounted volume
+    #: shared by the trainer pods).  When set, every trainer pins
+    #: ``jax_compilation_cache_dir`` at it (launcher wiring via
+    #: ``EDL_COMPILE_CACHE_DIR``), so joiners, restarted pods, and
+    #: cold-started worlds DESERIALIZE previously compiled step
+    #: executables instead of recompiling them — the other half of the
+    #: zero-stall resize (the AOT prewarmer removes compiles from warm
+    #: resizes; this removes them from cold ones); "" = no cache.
+    compile_cache_dir: str = ""
 
     @staticmethod
     def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainingJobSpec":
@@ -304,6 +313,9 @@ class TrainingJobSpec:
             dataset_dir=str(d.get("dataset_dir", d.get("datasetDir", "")) or ""),
             checkpoint_dir=str(
                 d.get("checkpoint_dir", d.get("checkpointDir", "")) or ""
+            ),
+            compile_cache_dir=str(
+                d.get("compile_cache_dir", d.get("compileCacheDir", "")) or ""
             ),
             image=d.get("image", ""),
             port=int(d.get("port", 0)),

@@ -36,6 +36,7 @@ def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
         "restore_step": plan.restore_step,
         "addresses": list(plan.addresses),
         "alive": list(plan.alive),
+        "prewarm": plan.prewarm,
     }
 
 
@@ -49,6 +50,7 @@ def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
         restore_step=d.get("restore_step", -1),
         addresses=tuple(d.get("addresses", ())),
         alive=tuple(d.get("alive", ())),
+        prewarm=int(d.get("prewarm", 0)),
     )
 
 
@@ -109,6 +111,12 @@ class CoordinatorServer:
                         self._reply({"ok": True})
                     elif self.path == "/target":
                         coord.set_target_world(req["world"])
+                        self._reply({"ok": True})
+                    elif self.path == "/prewarm":
+                        # Advisory pre-actuation announcement: trainers
+                        # AOT-warm the hinted world size's step before
+                        # the retarget lands (zero-stall resize).
+                        coord.set_prewarm(req["world"])
                         self._reply({"ok": True})
                     elif self.path == "/checkpoint":
                         coord.report_checkpoint(req["step"])
@@ -277,6 +285,12 @@ class HTTPCoordinator:
 
     def set_target_world(self, n: int):
         self._post("/target", world=n)
+
+    def set_prewarm(self, n: int):
+        """Announce the autoscaler's planned next parallelism so
+        trainers warm that world size's compiled step ahead of the
+        actual retarget (see ``LocalCoordinator.set_prewarm``)."""
+        self._post("/prewarm", world=n)
 
     def get_target_world(self) -> int:
         return self._get("/target")["world"]

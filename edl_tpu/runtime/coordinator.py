@@ -57,6 +57,14 @@ class ElasticPlan:
     #: old-world member is still alive to dispatch the collective
     #: (an evicted/dead one never would — the flush would hang).
     alive: tuple = ()
+    #: ADVISORY world size the autoscaler plans to actuate next (0 =
+    #: none).  Announced via ``set_prewarm`` BEFORE the retarget/PUT so
+    #: trainers AOT-warm exactly the incoming size's step executable
+    #: while still stepping at the current one; it rides the plan the
+    #: trainers already poll, so the hint costs zero extra round-trips.
+    #: Never changes the generation — an updated hint must not push
+    #: trainers through a resize barrier.
+    prewarm: int = 0
 
 
 @dataclass
@@ -121,6 +129,7 @@ class LocalCoordinator:
         self._hosts_per_replica = hosts_per_replica
         self._clock = clock
         self._latest_checkpoint_step = -1
+        self._prewarm = 0
         self._plan: Optional[ElasticPlan] = None
         self._resize_log: List[dict] = []
         #: target training steps (passes x batches-per-pass); 0 = open-ended
@@ -199,6 +208,31 @@ class LocalCoordinator:
             self._target_world = n
             self._rebuild_plan("retarget")
 
+    def set_prewarm(self, n: int):
+        """Announce the world size the autoscaler intends to actuate
+        next (the prewarm half of the actuation handshake).  Purely
+        advisory: the current plan is re-issued with the hint attached
+        — SAME generation, so no trainer resizes — and trainers
+        background-compile that size's step executable so the upcoming
+        retarget's resize window contains zero cold compiles.  ``0``
+        clears the hint."""
+        if n < 0:
+            raise ValueError("prewarm world must be >= 0")
+        with self._lock:
+            n = min(n, self._max_world)
+            if n == self._prewarm:
+                return
+            self._prewarm = n
+            if self._plan is not None and self._plan.prewarm != n:
+                from dataclasses import replace
+
+                self._plan = replace(self._plan, prewarm=n)
+            self._lock.notify_all()
+
+    def prewarm_hint(self) -> int:
+        with self._lock:
+            return self._prewarm
+
     def evict_dead(self) -> List[str]:
         """Evict members that missed their heartbeat deadline.  Returns
         evicted ids.  Called periodically by whoever hosts the
@@ -266,6 +300,7 @@ class LocalCoordinator:
                     - (self._plan.world_size if self._plan else 0),
                 ),
                 "target_world": self._target_world,
+                "prewarm": self._prewarm,
                 "target_steps": self._target_steps,
                 "latest_checkpoint_step": self._latest_checkpoint_step,
                 "resizes": len(self._resize_log),
@@ -379,6 +414,7 @@ class LocalCoordinator:
             restore_step=self._latest_checkpoint_step,
             addresses=addresses,
             alive=tuple(self._members),
+            prewarm=self._prewarm,
         )
         self._resize_log.append(
             {

@@ -109,6 +109,29 @@ class ShardedDataIterator:
                 f"the mesh's {extent}-way batch extent (axes {batch_axes})"
             )
 
+    # -- abstract schema ----------------------------------------------------
+    def abstract_batch(self, mesh: Mesh, batch_axes=("dp",)) -> Dict[str, Any]:
+        """ShapeDtypeStructs (with shardings) matching exactly what
+        ``device_batch`` would place on ``mesh`` — the batch half of
+        allocation-free AOT step warming (``Trainer.warm_step``): N
+        world sizes can be pre-lowered without staging a single batch
+        on device."""
+        axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+        lead = axes if len(axes) > 1 else (axes[0] if axes else None)
+        self.validate_mesh(mesh, batch_axes)
+
+        def spec_for(ndim: int) -> P:
+            return P(lead, *([None] * (ndim - 1)))
+
+        return {
+            k: jax.ShapeDtypeStruct(
+                (self.global_batch_size,) + v.shape[1:],
+                v.dtype,
+                sharding=NamedSharding(mesh, spec_for(v.ndim)),
+            )
+            for k, v in self.dataset.items()
+        }
+
     # -- device placement ---------------------------------------------------
     def device_batch(self, step: int, mesh: Mesh, batch_axes=("dp",)) -> Dict[str, Any]:
         """Global batch placed on ``mesh``, batch dim sharded over

@@ -24,11 +24,22 @@ must tolerate membership churn" — is discharged here natively:
 Compiled-step reuse: Trainers are cached per world size, so returning
 to a previously seen size pays zero recompilation — and
 ``precompile()`` can warm every legal world size up front
-(SURVEY.md §7.4 "pre-compile per legal mesh size").
+(SURVEY.md §7.4 "pre-compile per legal mesh size").  Warming is
+ABSTRACT (``Trainer.warm_step`` lowers from ``jax.eval_shape`` values,
+zero device allocation) and holds the compiled executable — on current
+jax ``.lower().compile()`` does not warm the jit dispatch cache, so
+holding it is what actually removes the first-step JIT.  The resize
+window itself overlaps everything that can overlap: the flush's crc
+hash + durable spill run on a background thread (only the d2h copy is
+ordered before teardown), the new size's step compile runs parallel to
+restore/transfer, and the autoscaler's prewarm hint
+(``ElasticPlan.prewarm``) warms the incoming size BEFORE the retarget
+even lands — a fully warm resize performs zero XLA compiles.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -178,6 +189,20 @@ class ElasticTrainer:
         self.mesh = None
         self.state: Optional[TrainState] = None
         self._trainers: Dict[int, Trainer] = {}  # world_size -> compiled Trainer
+        #: guards the trainer cache against the background AOT prewarm
+        #: thread; the epoch counter invalidates in-flight warms when a
+        #: resize clears the cache (device identity changed)
+        self._trainer_lock = threading.Lock()
+        self._cache_epoch = 0
+        #: in-flight background warms, world_size -> thread
+        self._prewarm_threads: Dict[int, threading.Thread] = {}
+        #: sizes whose background warm failed this cache epoch — a
+        #: deterministically unwarmable hint (e.g. batch not divisible
+        #: at that size) must not respawn a compile thread + traceback
+        #: every steady-state step; cleared with the trainer cache
+        self._failed_prewarms: set = set()
+        #: autoscaler prewarm hints dropped by chaos (test accounting)
+        self._dropped_prewarm_hints = 0
         self._last_completed_step = 0
         self._holding = False
         #: how long run() waits for a formable world before giving up
@@ -251,31 +276,150 @@ class ElasticTrainer:
             )
         return MeshSpec.create(dp=total_devices // prod, **self.layout)
 
+    def _build_trainer(self, world_size: int) -> Trainer:
+        """Construct (but do not activate) a Trainer for ``world_size``.
+        Cheap: mesh construction + lazy jit wrappers, no compilation."""
+        total = world_size * self.devices_per_trainer
+        mesh = build_mesh(self._mesh_spec(total), self.devices)
+        model = (
+            self._model_factory(mesh)
+            if self._model_factory is not None
+            else self.model
+        )
+        return Trainer(model, self.optimizer, mesh, seed=self.seed)
+
     def _trainer_for(self, world_size: int) -> Trainer:
-        tr = self._trainers.get(world_size)
+        with self._trainer_lock:
+            tr = self._trainers.get(world_size)
         if tr is None:
-            total = world_size * self.devices_per_trainer
-            mesh = build_mesh(self._mesh_spec(total), self.devices)
-            model = (
-                self._model_factory(mesh)
-                if self._model_factory is not None
-                else self.model
-            )
-            tr = Trainer(model, self.optimizer, mesh, seed=self.seed)
-            self._trainers[world_size] = tr
+            built = self._build_trainer(world_size)
+            with self._trainer_lock:
+                # A background prewarm may have landed the same size
+                # while we built: its (possibly already-warm) trainer
+                # wins.
+                tr = self._trainers.setdefault(world_size, built)
         # Keep self.model pointing at the ACTIVE mesh's instance (the
         # restore paths read its param_partition / init behavior).
         self.model = tr.model
         return tr
 
+    def _clear_trainers(self) -> None:
+        """Invalidate the compiled-trainer cache.  Bumping the epoch
+        makes any in-flight background warm drop its result instead of
+        resurrecting a trainer built over dead device objects."""
+        with self._trainer_lock:
+            self._trainers.clear()
+            self._failed_prewarms.clear()
+            self._cache_epoch += 1
+
+    def _warm_trainer(self, tr: Trainer) -> bool:
+        """AOT-compile ``tr``'s train step from abstract shapes (see
+        ``Trainer.warm_step``): zero device allocation, so warming N
+        legal world sizes costs N compiles and nothing else.  Also
+        warms the restore path's per-leaf CPU staging conversions
+        (mesh-independent, deduped per process) so a first restore
+        performs zero compiles inside the resize window too."""
+        from edl_tpu.checkpoint.hostdram import warm_leaf_conversions
+
+        warmed = tr.warm_step(
+            self.data.abstract_batch(tr.mesh, batch_axes=BATCH_AXES)
+        )
+        warm_leaf_conversions(
+            jax.tree_util.tree_leaves(tr.abstract_state())
+        )
+        return warmed
+
     def precompile(self, world_sizes: Sequence[int]):
         """Warm the compiled-step cache for every legal world size
-        (avoids JIT cost inside the resize window)."""
+        (avoids JIT cost inside the resize window).  Lowers from
+        ABSTRACT shapes — the old path allocated a full real
+        ``init_state()`` on device per size just to lower, paying one
+        state's worth of HBM per legal world size for nothing."""
         for w in world_sizes:
-            tr = self._trainer_for(w)
-            state = tr.init_state()
-            batch = self.data.device_batch(0, tr.mesh, batch_axes=BATCH_AXES)
-            tr.lower_step(state, batch)
+            self._warm_trainer(self._trainer_for(w))
+
+    # -- background prewarm (the autoscaler hint's consumer) ----------------
+    def prewarm_async(self, world_size: int) -> Optional[threading.Thread]:
+        """Warm ``world_size``'s step executable on a background thread
+        during steady-state stepping, so the NEXT resize finds it
+        compiled.  Deduped (an in-flight or already-warm size is a
+        no-op); the result is dropped if a resize invalidates the
+        trainer cache mid-compile (epoch check).  Returns the warm
+        thread (or None if there was nothing to do) so callers/tests
+        can join it."""
+        with self._trainer_lock:
+            if world_size in self._failed_prewarms:
+                # Already failed this epoch: deterministic (an illegal
+                # size stays illegal until the world changes) — don't
+                # respawn a doomed compile thread every step.
+                return None
+            tr = self._trainers.get(world_size)
+            if tr is not None and tr.step_warm:
+                return None
+            th = self._prewarm_threads.get(world_size)
+            if th is not None and th.is_alive():
+                return th
+            epoch = self._cache_epoch
+
+        def work():
+            try:
+                target = tr if tr is not None else self._build_trainer(
+                    world_size
+                )
+                self._warm_trainer(target)
+                with self._trainer_lock:
+                    if self._cache_epoch == epoch:
+                        self._trainers.setdefault(world_size, target)
+            except Exception:
+                # Best-effort: an illegal/unwarmable size must not kill
+                # the trainer — the resize path compiles cold instead.
+                # Memoized so the steady-state hint consumer doesn't
+                # retry (and re-traceback) it once per step.
+                with self._trainer_lock:
+                    if self._cache_epoch == epoch:
+                        self._failed_prewarms.add(world_size)
+                import traceback
+
+                traceback.print_exc()
+
+        th = threading.Thread(
+            target=work, daemon=True, name=f"edl-prewarm-{world_size}"
+        )
+        with self._trainer_lock:
+            self._prewarm_threads[world_size] = th
+        th.start()
+        return th
+
+    def _join_prewarm(self, world_size: int) -> None:
+        """A resize racing an in-flight prewarm of the SAME size joins
+        it: the thread is compiling exactly what the resize needs, and
+        racing a duplicate compile would pay twice."""
+        with self._trainer_lock:
+            th = self._prewarm_threads.get(world_size)
+        if th is not None and th.is_alive():
+            th.join()
+
+    def _maybe_prewarm(self, plan: ElasticPlan) -> None:
+        """Steady-state consumer of the autoscaler's prewarm hint: warm
+        exactly the announced incoming world size before the retarget
+        lands.  Skipped under a world_builder (device objects change
+        identity every generation, so a pre-built executable could
+        never be reused — there, the persistent XLA compilation cache
+        carries the warming across generations instead)."""
+        hint = int(getattr(plan, "prewarm", 0) or 0)
+        if not hint or self.world_builder is not None:
+            return
+        if self.mesh is not None and hint == self._world_size():
+            return
+        chaos = getattr(self.store, "chaos", None)
+        if chaos is not None and chaos.due("prewarm.hint.dropped"):
+            # chaos[prewarm.hint.dropped]: the hint is lost en route —
+            # the resize must still work, just with a cold compile
+            # (overlapped with restore, so the window degrades
+            # gracefully rather than stalling).
+            self._dropped_prewarm_hints += 1
+            return
+        self.prewarm_async(hint)
 
     # -- fault injection (what the reference never had; SURVEY.md §5.3) -----
     def inject_failure(self):
@@ -285,12 +429,27 @@ class ElasticTrainer:
         self.state = None
 
     # -- resize barrier -----------------------------------------------------
+    def _flush_begin(self, generation: int):
+        """Start the split graceful flush: the device->host copy runs
+        HERE (it must precede world teardown — the device buffers die
+        with the old process group); crc fingerprint + disk spill run
+        on the returned background thread, overlapping world formation
+        / compile / restore.  Returns (checkpoint, bg_thread_or_None);
+        the caller joins the thread before the resize returns."""
+        ckpt, bg = self.store.flush_sync(self.state, generation=generation)
+        self.coordinator.report_checkpoint(int(ckpt.step))
+        return ckpt, bg
+
     def _flush(self, generation: int) -> None:
-        """Synchronously checkpoint the live state (graceful resize:
-        no steps lost)."""
-        self.store.save_async(self.state, generation=generation)
-        self.store.wait()
-        self.coordinator.report_checkpoint(int(jax.device_get(self.state.step)))
+        """Fully synchronous flush (standby / non-resize callers):
+        begin + join, surfacing background hash/spill errors like the
+        old monolithic flush did."""
+        _, bg = self._flush_begin(generation)
+        if bg is not None:
+            bg.join()
+            err = getattr(bg, "edl_error", None)
+            if err is not None:
+                raise err
 
     def _can_flush(self, plan: ElasticPlan) -> bool:
         """Whether the live state can be flushed at this resize.
@@ -343,13 +502,24 @@ class ElasticTrainer:
         over all ``world_size * c`` global devices — not the first
         ``world_size`` (which would exclude every pod but rank 0's
         chips whenever pods carry more than one device)."""
-        self._trainers.clear()
+        self._clear_trainers()
         self.mesh = None
         try:
             devs = self.world_builder(plan)
         except FatalWorldError:
             raise  # loud exit, not hold-and-retry (see the class doc)
         except Exception:
+            # Hold-and-retry is right for transient races (peers on a
+            # fresher plan), but swallowing the traceback entirely made
+            # a DETERMINISTIC builder failure (e.g. an initialize()
+            # kwarg this jax doesn't know) look like an endless silent
+            # hold.  Print once per generation — the retry loop may
+            # re-enter many times a second.
+            if getattr(self, "_last_form_err_gen", None) != plan.generation:
+                self._last_form_err_gen = plan.generation
+                import traceback
+
+                traceback.print_exc()
             return False
         if devs is None:
             return False
@@ -384,7 +554,7 @@ class ElasticTrainer:
                 traceback.print_exc()
         self.state = None
         self._world_members = ()
-        self._trainers.clear()
+        self._clear_trainers()
         self.mesh = None
         if self.world_builder is not None:
             try:
@@ -395,6 +565,50 @@ class ElasticTrainer:
                 pass
         self.generation = plan.generation
         self._standby = True
+
+    def _finish_overlap(
+        self,
+        warm_th: Optional[threading.Thread],
+        warm_stats: Dict[str, float],
+        flush_bg: Optional[threading.Thread],
+        phases: Dict[str, float],
+    ) -> None:
+        """Join the resize window's overlapped background work — the
+        AOT step warm and the flush's hash/spill — and record both
+        sides of the overlap: ``compile``/``flush_bg`` are the threads'
+        own durations, ``*_join`` the residual the window actually
+        waited at the end.  join << duration is the proof the work
+        overlapped instead of serializing."""
+        if warm_th is not None:
+            t = time.perf_counter()
+            warm_th.join()
+            phases["compile_join"] = round(time.perf_counter() - t, 6)
+            phases["compile"] = round(warm_stats.get("seconds", 0.0), 6)
+        if flush_bg is not None:
+            t = time.perf_counter()
+            flush_bg.join()
+            phases["flush_bg_join"] = round(time.perf_counter() - t, 6)
+            phases["flush_bg"] = round(
+                getattr(flush_bg, "edl_seconds", 0.0), 6
+            )
+            err = getattr(flush_bg, "edl_error", None)
+            if err is not None:
+                # Hash/spill failure AFTER the host copy landed: the
+                # DRAM checkpoint is warm and already restored from —
+                # no steps lost, durability alone degraded.  Loudly
+                # logged, never re-raised into a later resize (the
+                # stale-error class of ADVICE r5).
+                import sys
+                import traceback
+
+                print(
+                    "[edl] background flush hash/spill failed (DRAM "
+                    f"checkpoint intact; durable spill skipped): {err}",
+                    file=sys.stderr,
+                )
+                traceback.print_exception(
+                    type(err), err, err.__traceback__
+                )
 
     def _resize(self, plan: ElasticPlan) -> bool:
         from edl_tpu.utils.profiling import annotate
@@ -409,13 +623,17 @@ class ElasticTrainer:
 
         graceful = self.state is not None and self._can_flush(plan)
 
+        flushed: Optional[HostCheckpoint] = None
+        flush_bg: Optional[threading.Thread] = None
         if graceful:
-            # Flush a fresh checkpoint so no steps are lost.  Must land
-            # before any world teardown: the state's device buffers die
-            # with the old process group.
+            # Flush a fresh checkpoint so no steps are lost.  Only the
+            # device-to-host copy is ordered before world teardown (the
+            # state's device buffers die with the old process group);
+            # crc hashing and the durable spill continue on flush_bg,
+            # overlapping everything below, joined before this returns.
             with annotate("resize/flush"):
                 try:
-                    self._flush(plan.generation)
+                    flushed, flush_bg = self._flush_begin(plan.generation)
                 except Exception:
                     # State poisoned by a peer death between the last
                     # step and this resize: degrade to the non-graceful
@@ -424,16 +642,23 @@ class ElasticTrainer:
 
                     traceback.print_exc()
                     graceful = False
+                    flushed = None
+                    flush_bg = None
         t_phase = _mark("flush", t0)
 
         if self.world_builder is not None:
             self.state = None
             with annotate("resize/world_formation"):
                 if not self._rebuild_world(plan):
+                    self._finish_overlap(None, {}, flush_bg, phases)
                     return False
             t_phase = _mark("world_formation", t_phase)
 
         with annotate("resize/remesh"):
+            # An in-flight background prewarm of this very size is
+            # compiling exactly what we need: join it rather than
+            # racing a duplicate compile.
+            self._join_prewarm(plan.world_size)
             trainer = self._trainer_for(plan.world_size)
             self.mesh = trainer.mesh
             # Surface batch/mesh mismatch HERE, outside the step loop's
@@ -443,6 +668,7 @@ class ElasticTrainer:
             try:
                 self.data.validate_mesh(trainer.mesh, batch_axes=BATCH_AXES)
             except ValueError as e:
+                self._finish_overlap(None, {}, flush_bg, phases)
                 raise RuntimeError(
                     f"resize to world {plan.world_size} "
                     f"(x {self.devices_per_trainer} chips/trainer) is "
@@ -453,6 +679,50 @@ class ElasticTrainer:
 
         t_phase = _mark("remesh", t_phase)
 
+        # AOT step warm on a parallel thread: the cold-compile cost
+        # (when the size was not prewarmed and the persistent cache is
+        # cold) overlaps the restore below instead of extending the
+        # window.  Already-warm trainers return instantly.
+        warm_stats: Dict[str, float] = {}
+
+        def _warm():
+            w0 = time.perf_counter()
+            try:
+                self._warm_trainer(trainer)
+            except Exception:
+                # Best-effort: a failed warm only means the first step
+                # pays the JIT, exactly the pre-warmer behavior.
+                import traceback
+
+                traceback.print_exc()
+            finally:
+                warm_stats["seconds"] = time.perf_counter() - w0
+
+        warm_th = threading.Thread(
+            target=_warm, daemon=True, name="edl-resize-warm"
+        )
+        import os as _os
+        if _os.environ.get("EDL_NO_WARM_OVERLAP") == "1" and jax.process_count() > 1:
+            # Debug hatch: serialize the warm before the restore phase
+            # (isolates overlap-related instability in multi-process
+            # worlds).  Phase accounting still records the compile —
+            # inline, its whole duration IS window time, so join = 0.
+            _warm()
+            warm_th = None
+            phases["compile"] = round(warm_stats.get("seconds", 0.0), 6)
+            phases["compile_join"] = 0.0
+        else:
+            warm_th.start()
+
+        # Only a FRESHLY materialized flush (its background hash/spill
+        # thread exists) may restore without the latest_verified() crc
+        # pass: those bytes left the device microseconds ago.  A flush
+        # that DEDUPED against an already-stored interval checkpoint
+        # (flush_bg is None) has sat in DRAM since the save landed —
+        # it keeps the stored-snapshot verify discipline, exactly the
+        # pre-split behavior (chaos[checkpoint.corrupt] targets it).
+        flushed_fresh = flushed if flush_bg is not None else None
+
         transfer_stats = None
         with annotate("resize/restore"):
             if jax.process_count() > 1:
@@ -460,7 +730,9 @@ class ElasticTrainer:
 
                 try:
                     self.state, restored_step, restore_source, transfer_stats = (
-                        self._restore_multiprocess(trainer)
+                        self._restore_multiprocess(
+                            trainer, flushed=flushed_fresh
+                        )
                     )
                 except TransferError:
                     # Torn transfer: world-consistent verdict (every
@@ -479,9 +751,21 @@ class ElasticTrainer:
                     import traceback
 
                     traceback.print_exc()
+                    self._finish_overlap(warm_th, warm_stats, flush_bg, phases)
                     return False
             else:
-                ckpt = self._latest_or_disk(trainer)
+                # The just-flushed checkpoint restores as-is: its bytes
+                # were materialized from the device microseconds ago,
+                # so the latest_verified() crc pass would re-hash state
+                # with no window to have rotted — pure critical-path
+                # cost (one of the two r5 hash passes the resize window
+                # silently grew).  Dedup'd flushes go through
+                # _latest_or_disk's verify instead (see flushed_fresh).
+                ckpt = (
+                    flushed_fresh
+                    if flushed_fresh is not None
+                    else self._latest_or_disk(trainer)
+                )
                 if ckpt is None:
                     # Fresh job: initialize on the new mesh.
                     self.state = trainer.init_state()
@@ -501,7 +785,8 @@ class ElasticTrainer:
                     )
                     restored_step = int(ckpt.step)
                     restore_source = "local"
-        _mark("restore", t_phase)
+        t_phase = _mark("restore", t_phase)
+        self._finish_overlap(warm_th, warm_stats, flush_bg, phases)
         replayed = max(0, self._last_completed_step - restored_step)
 
         self.generation = plan.generation
@@ -551,9 +836,7 @@ class ElasticTrainer:
             return ckpt
         # treedef template from the model's abstract init: no allocation
         # (this runs inside the resize window).
-        template = jax.eval_shape(
-            trainer._init_fn, jax.random.key(trainer.seed)
-        )
+        template = trainer.abstract_state()
         try:
             ckpt = self.store.load_from_disk(template)
         except FileNotFoundError:
@@ -584,9 +867,17 @@ class ElasticTrainer:
         )
         return transfer.JaxProcessFabric(advertise_host=host)
 
-    def _restore_multiprocess(self, trainer: Trainer):
+    def _restore_multiprocess(
+        self, trainer: Trainer, flushed: Optional[HostCheckpoint] = None
+    ):
         """Agree on one state across the (re-formed) process group and
         move ONLY the bytes some member lacks.
+
+        ``flushed``: the checkpoint this resize just flushed, when the
+        resize was graceful — it restores without the
+        ``latest_verified`` crc pass (bytes materialized from the
+        device moments ago cannot have rotted), keeping the hash work
+        on the flush's background thread instead of this window.
 
         Members all-gather (have, step, digest) plus PER-LEAF digests
         (``checkpoint/transfer.py``).  Identical bytes everywhere — the
@@ -615,7 +906,7 @@ class ElasticTrainer:
         # checkpoint then acts as this member's contribution to the
         # agreement (identical spilled bytes everywhere -> local
         # restore; a lone survivor's disk copy -> transfer source).
-        ckpt = self._latest_or_disk(trainer)
+        ckpt = flushed if flushed is not None else self._latest_or_disk(trainer)
         shardings = (
             trainer.state_shardings()
             if self.model.param_partition is not None
@@ -624,9 +915,7 @@ class ElasticTrainer:
         # The model's abstract state is the shared leaf schema: shapes,
         # dtypes, and treedef come from the model, not from any local
         # checkpoint (which may be stale or absent).
-        abstract = jax.eval_shape(
-            trainer._init_fn, jax.random.key(trainer.seed)
-        )
+        abstract = trainer.abstract_state()
         leaves_abs, treedef = jax.tree_util.tree_flatten(abstract)
         if shardings is None:
             from jax.sharding import NamedSharding, PartitionSpec
@@ -796,7 +1085,7 @@ class ElasticTrainer:
         self._leak_dead_world()
         self.state = None
         self._world_members = ()
-        self._trainers.clear()
+        self._clear_trainers()
         self.mesh = None
         self._await_new_generation = True
         self._holding = True
@@ -835,6 +1124,11 @@ class ElasticTrainer:
             # hold cheaply until the lease reaper evicts it and bumps
             # the generation.
             self._holding = self._standby or self._await_new_generation
+            if self.state is not None and not self._holding:
+                # Steady state: act on the autoscaler's prewarm hint so
+                # the NEXT generation's step executable compiles in the
+                # background while this one keeps stepping.
+                self._maybe_prewarm(plan)
             return False
         if self.heartbeat_ids and not self._my_member_ids(plan):
             # Multi-pod scale-down: this pod dropped out of the world's

@@ -15,6 +15,7 @@ rules), XLA inserting the collectives.  Elasticity = constructing a new
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, Tuple
 
 import jax
@@ -102,9 +103,32 @@ class Trainer:
 
         self._constrain = constrain
 
+        def constrain_opt(opt_state, params):
+            """Pin optimizer-state subtrees that mirror the params
+            pytree (adam's mu/nu) to the params' partition layout.
+            Without this, init leaves the moments replicated while the
+            step's GSPMD propagation shards them like the grads — the
+            state's layout would change between step 0 and step 1,
+            silently recompiling the jit path every resize and
+            hard-failing the AOT-warmed executable on its second
+            call (input shardings no longer match what it was
+            compiled for)."""
+            if self._param_spec_fn is None:
+                return opt_state
+            pdef = jax.tree_util.tree_structure(params)
+
+            def mirrors(x):
+                return jax.tree_util.tree_structure(x) == pdef
+
+            return jax.tree_util.tree_map(
+                lambda sub: constrain(sub) if mirrors(sub) else sub,
+                opt_state,
+                is_leaf=mirrors,
+            )
+
         def init_fn(rng):
             params = constrain(model.init_params(rng))
-            opt_state = optimizer.init(params)
+            opt_state = constrain_opt(optimizer.init(params), params)
             return TrainState(
                 step=jnp.zeros((), jnp.int32), params=params, opt_state=opt_state
             )
@@ -122,6 +146,7 @@ class Trainer:
                 state.params
             )
             updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
+            new_opt = constrain_opt(new_opt, state.params)
             new_params = constrain(optax.apply_updates(state.params, updates))
             new_state = TrainState(
                 step=state.step + 1, params=new_params, opt_state=new_opt
@@ -142,6 +167,16 @@ class Trainer:
                 state.params, batch, jax.random.key(0)
             )[0]
         )
+        #: AOT-compiled train step (``warm_step``); when present,
+        #: ``step()`` calls it directly.  On this jax the jit dispatch
+        #: cache is NOT warmed by ``.lower().compile()`` — the first
+        #: real call recompiles from scratch — so holding the compiled
+        #: executable is the only way a pre-warm actually removes the
+        #: cold compile from the first post-resize step.
+        self._compiled_step = None
+        #: serializes state_shardings' init compile across the resize
+        #: window's concurrent threads (restore vs the AOT warmer)
+        self._shardings_lock = threading.Lock()
 
     # -- shardings ----------------------------------------------------------
     def state_shardings(self) -> Any:
@@ -150,18 +185,65 @@ class Trainer:
         Replicated for pure-DP models; for models with partition rules
         the layout is whatever GSPMD propagated from the param
         constraints — derived here by *compiling* init (no execution,
-        no throwaway allocation: this runs inside the resize window)."""
+        no throwaway allocation: this runs inside the resize window).
+        Locked: the resize window computes this from two threads at
+        once (restore placement and the AOT step warmer) — one pays the
+        init compile, the other reuses it."""
         if self._param_spec_fn is None:
             return NamedSharding(self.mesh, P())
-        if self._state_shardings is None:
-            with self.mesh:
-                compiled = (
-                    jax.jit(self._init_fn)
-                    .lower(jax.random.key(self.seed))
-                    .compile()
-                )
-            self._state_shardings = compiled.output_shardings
-        return self._state_shardings
+        with self._shardings_lock:
+            if self._state_shardings is None:
+                with self.mesh:
+                    compiled = (
+                        jax.jit(self._init_fn)
+                        .lower(jax.random.key(self.seed))
+                        .compile()
+                    )
+                self._state_shardings = compiled.output_shardings
+            return self._state_shardings
+
+    def abstract_state(self) -> Any:
+        """TrainState as shape/dtype structs — the shared schema every
+        allocation-free path derives from (AOT warming, the restore
+        transfer's leaf template, cold-start treedefs)."""
+        return jax.eval_shape(self._init_fn, jax.random.key(self.seed))
+
+    # -- AOT warming --------------------------------------------------------
+    def warm_step(self, abstract_batch) -> bool:
+        """AOT-compile the train step from ABSTRACT values — zero
+        device allocation however many world sizes are warmed — and
+        keep the executable for ``step()``.
+
+        ``abstract_batch``: ShapeDtypeStructs carrying the batch's
+        shapes/dtypes/shardings (``ShardedDataIterator.abstract_batch``).
+        The state side comes from ``abstract_state()`` with this mesh's
+        state shardings attached, so the lowered program's layout is
+        identical to what a real call would produce.  Returns True when
+        a compile happened, False when the step was already warm.
+        Idempotent and safe to call from a background thread during
+        steady-state steps (the prewarm path)."""
+        if self._compiled_step is not None:
+            return False
+        shardings = self.state_shardings()
+        abstract = self.abstract_state()
+        if isinstance(shardings, NamedSharding):
+            uniform = shardings
+            shardings = jax.tree_util.tree_map(lambda _: uniform, abstract)
+        abs_state = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract,
+            shardings,
+        )
+        with self.mesh:
+            compiled = self._step.lower(abs_state, abstract_batch).compile()
+        self._compiled_step = compiled
+        return True
+
+    @property
+    def step_warm(self) -> bool:
+        """Whether the train step holds a pre-built executable (the
+        warm-resize accounting the zero-compile tests assert on)."""
+        return self._compiled_step is not None
 
     def init_state(self) -> TrainState:
         """Initialize state directly on the mesh: params laid out by the
@@ -183,6 +265,15 @@ class Trainer:
     # -- stepping -----------------------------------------------------------
     def step(self, state: TrainState, batch) -> Tuple[TrainState, Dict[str, jax.Array]]:
         with self.mesh:
+            if self._compiled_step is not None:
+                # The AOT-warmed executable: identical program, but the
+                # first call pays ZERO JIT (the jit path would recompile
+                # even after lower().compile() — see warm_step).  Input
+                # avals/shardings match by construction: the abstract
+                # lowering used this mesh's state shardings and the
+                # iterator's batch spec, so any mismatch here is a real
+                # schema bug that must surface, not be retried.
+                return self._compiled_step(state, batch)
             return self._step(state, batch)
 
     def eval_loss(self, state: TrainState, batch) -> jax.Array:
@@ -190,9 +281,10 @@ class Trainer:
             return self._eval_loss(state, batch)
 
     def lower_step(self, state, batch):
-        """AOT lowering hook: pre-compile the step for this mesh size so a
-        resize pays no JIT cost on its first step (<60s resize budget,
-        BASELINE.md)."""
+        """AOT lowering hook (HLO inspection / ad-hoc compiles).  NOTE:
+        the returned executable is NOT installed for ``step()`` and the
+        jit dispatch cache is NOT warmed by it — use ``warm_step`` to
+        actually remove the first-step JIT from a resize window."""
         return self._step.lower(state, batch).compile()
 
     @property

@@ -19,6 +19,21 @@ if "--xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# Persistent XLA compilation cache (same default as ci.sh): the suite
+# builds fresh Trainer/jit objects per test, so identical HLO is
+# otherwise recompiled over and over WITHIN one run — the
+# content-addressed disk cache dedupes those, and the multipod tests'
+# subprocess worker pods (which inherit this environment) stop paying
+# the whole model's cold compile per pod per test.  Env vars, not
+# jax.config: they must propagate to the spawned workers.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+        os.environ.get("TMPDIR") or "/tmp", "edl-xla-cache"
+    )
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+os.makedirs(os.environ["JAX_COMPILATION_CACHE_DIR"], exist_ok=True)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

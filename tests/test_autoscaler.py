@@ -538,3 +538,101 @@ def test_shed_skips_nodes_gone_from_inventory():
     assert scale_dry_run(r, a, 0, scale_down=True) == -1
     assert "node-gone" not in r.nodes.cpu_idle_milli
     assert "node-gone" not in r.nodes.tpu_free
+
+
+# ---- actuation prewarm announcement (zero-stall resize) --------------------
+
+
+def _elastic_job(name="j", lo=2, hi=8):
+    return TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": name},
+            "spec": {
+                "fault_tolerant": True,
+                "trainer": {
+                    "entrypoint": "mnist",
+                    "min_instance": lo,
+                    "max_instance": hi,
+                    "slice_topology": "v5e-1",
+                },
+            },
+        }
+    ).validate()
+
+
+def test_actuation_announces_prewarm_before_put():
+    """The scaler announces its planned next parallelism through the
+    coordinator BEFORE any retarget or parallelism PUT — trainers then
+    warm exactly the incoming world size while the actuation is still
+    in flight (the prewarm half of the zero-stall resize)."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+
+    log = []
+
+    class RecCluster:
+        def update_parallelism(self, job, n):
+            log.append(("put", n))
+
+        def delete_pod(self, name):
+            return True
+
+    class RecClient:
+        def set_prewarm(self, w):
+            log.append(("prewarm", w))
+
+        def set_target_world(self, w):
+            log.append(("target", w))
+
+        def plan(self):
+            return None
+
+        def members(self):
+            return []
+
+    job = _elastic_job()
+    sc = Autoscaler(RecCluster(), coord_client_factory=lambda j: RecClient())
+    sc.jobs = {job.name: job}
+
+    sc._actuate({job.name: 4}, {job.name: 2})  # scale-up
+    assert log[0] == ("prewarm", 4)
+    assert log.index(("prewarm", 4)) < log.index(("put", 4))
+    log.clear()
+
+    sc._actuate({job.name: 2}, {job.name: -2})  # scale-down
+    assert log[0] == ("prewarm", 2)  # before retarget AND victim deletion
+    assert log.index(("prewarm", 2)) < log.index(("target", 2))
+    assert ("put", 2) in log
+
+
+def test_actuation_tolerates_clients_without_prewarm():
+    """Injected coordinator doubles (and older coordinators) may lack
+    /prewarm: the announcement must silently no-op, never block the
+    actuation itself."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+
+    log = []
+
+    class RecCluster:
+        def update_parallelism(self, job, n):
+            log.append(("put", n))
+
+        def delete_pod(self, name):
+            return True
+
+    class BareClient:  # no set_prewarm
+        def set_target_world(self, w):
+            log.append(("target", w))
+
+        def plan(self):
+            return None
+
+        def members(self):
+            return []
+
+    job = _elastic_job()
+    sc = Autoscaler(RecCluster(), coord_client_factory=lambda j: BareClient())
+    sc.jobs = {job.name: job}
+    sc._actuate({job.name: 4}, {job.name: 2})
+    assert log == [("put", 4), ("target", 4)]

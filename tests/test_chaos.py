@@ -758,3 +758,72 @@ def test_chaos_soak_long_multi_cycle():
     cycle).  Gated behind -m slow; tier-1 runs the single-cycle soak."""
     r = _run_soak(seed=99, cycles=2)
     _check_soak_invariants(r, cycles=2)
+
+
+# ---- zero-stall resize chaos: background flush + prewarm hint -------------
+
+
+def _elastic_world(store, target=2, trainers=2, ckpt_interval=5):
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=target, max_world=8)
+    for i in range(trainers):
+        coord.register(f"tr{i}")
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-2),
+        it,
+        coord,
+        store=store,
+        checkpoint_interval=ckpt_interval,
+        seed=0,
+    )
+    return et, coord
+
+
+def test_flush_spill_slow_overlaps_resize_window(tmp_path):
+    """chaos[flush.spill.slow]: the flush's background hash/spill
+    thread stalls.  The stall must land on the BACKGROUND phase
+    (overlapping the window), never on the ordered device->host flush
+    phase — and the end-of-window join still guarantees the durable
+    spill landed before the resize returned."""
+    sched = FaultSchedule(0, [FaultEvent(0, "flush.spill.slow", 0.5)])
+    store = HostDRAMStore(spill_dir=str(tmp_path), chaos=sched)
+    et, coord = _elastic_world(store)
+    et.run(8)  # interval save at 5; resize flush at 8 is fresh
+    et.store.wait()
+    sched.advance(0)  # arm the stall for the resize flush
+    coord.set_target_world(1)
+    hist = et.run(12)
+    ev = et.resize_events[-1]
+    assert ev.graceful, "a slow spill must not degrade the resize to replay"
+    ph = ev.phase_seconds
+    assert ph["flush_bg"] >= 0.5, ph  # the stall hit the background thread
+    assert ph["flush"] < 0.5, ph      # ...not the ordered d2h phase
+    # join-before-return: the flushed step's durable spill is on disk
+    assert (tmp_path / f"ckpt-{8:012d}.npz").exists()
+    assert [r.step for r in hist][-4:] == list(range(8, 12))
+    assert not sched.pending()
+
+
+def test_prewarm_hint_dropped_chaos():
+    """chaos[prewarm.hint.dropped]: the autoscaler's hint is lost en
+    route — no prewarm happens, and the subsequent retarget still
+    resizes correctly (cold compile overlapped with restore, not a
+    correctness event)."""
+    sched = FaultSchedule(0, [FaultEvent(0, "prewarm.hint.dropped")])
+    sched.advance(0)
+    store = HostDRAMStore(chaos=sched)
+    et, coord = _elastic_world(store, target=2, trainers=4)
+    et.run(3)
+    coord.set_prewarm(4)
+    et.run(6)  # the hint is consumed — and dropped — here
+    assert et._dropped_prewarm_hints == 1
+    assert 4 not in et._trainers, "dropped hint must not prewarm"
+    coord.set_target_world(4)
+    et.run(9)
+    grow = et.resize_events[-1]
+    assert grow.world_size == 4 and grow.graceful
+    assert [r.step for r in et.history] == list(range(9))
+    assert not sched.pending()

@@ -121,3 +121,39 @@ def test_load_from_disk_missing(tmp_path):
     store2 = HostDRAMStore()
     with pytest.raises(ValueError):
         store2.load_from_disk(template_state={})
+
+
+def test_restore_never_aliases_checkpoint_bytes(trained):
+    """Restored state must live in DEVICE-OWNED buffers, never zero-copy
+    aliases of the checkpoint's host numpy (``leaf_placer``'s owned-copy
+    staging on CPU).  The chain this pins down: CPU device_put zero-
+    copies aligned numpy, a replicated target then backs EVERY replica
+    with the checkpoint's own bytes, and the train step's donated state
+    input turns into an in-place write through them — with a
+    persistent-compilation-cache DESERIALIZED step executable the write
+    really lands (the fresh-compile path copies), so the step counter
+    advanced by world_size per step (each replica incremented the one
+    shared buffer) and the checkpoint silently tracked the live state."""
+    model, mesh, trainer, state, it = trained
+    store = HostDRAMStore()
+    store.save_async(state)
+    store.wait()
+    ckpt = store.latest()
+    before = [np.array(l) for l in ckpt.leaves]
+
+    restored = store.restore(ckpt, mesh)
+    # No restored leaf buffer may share memory with a checkpoint leaf.
+    for host, dev in zip(
+        ckpt.leaves, jax.tree_util.tree_leaves(restored)
+    ):
+        for shard in dev.addressable_shards:
+            view = np.asarray(shard.data)
+            assert not np.shares_memory(host, view), (
+                "restored leaf aliases checkpoint host bytes"
+            )
+    # Stepping the restored state (donating executables) must advance
+    # the counter by exactly 1 and leave the checkpoint bytes untouched.
+    restored, _ = trainer.step(restored, it.device_batch(10, mesh))
+    assert int(restored.step) == 11
+    for b, l in zip(before, ckpt.leaves):
+        np.testing.assert_array_equal(b, l)

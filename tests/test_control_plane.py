@@ -204,6 +204,11 @@ def test_actuation_handshake_e2e(fake_cluster, tmp_path, capsys, monkeypatch):
         # retargeted the coordinator: the plan itself is world 4
         assert coord.target_world() == 4
         assert coord.plan().world_size == 4
+        # and the actuation announced its prewarm hint over the new
+        # /prewarm endpoint before actuating (zero-stall resize):
+        # trainers polling the plan see the incoming size to warm
+        assert coord.prewarm_hint() == 4
+        assert coord.plan().prewarm == 4
     finally:
         server.stop()
 

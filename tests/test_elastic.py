@@ -482,3 +482,123 @@ def test_cold_start_restores_from_durable_dir(tmp_path):
     third = world(HostDRAMStore(spill_dir=spill))
     third.run(16)
     assert third.resize_events[0].restored_step == 15
+
+
+# ---- zero-stall resize: AOT warming, prewarm hints, compile accounting ----
+
+
+def test_coordinator_prewarm_hint_is_advisory():
+    """set_prewarm rides the plan WITHOUT bumping the generation (a
+    hint must never push trainers through a resize barrier), clamps to
+    max_world, and survives plan rebuilds."""
+    c = LocalCoordinator(target_world=2, max_world=4)
+    c.register("a")
+    c.register("b")
+    g = c.plan().generation
+    c.set_prewarm(3)
+    p = c.plan()
+    assert p.generation == g and p.prewarm == 3
+    c.set_prewarm(99)  # clamped like set_target_world
+    assert c.plan().prewarm == 4
+    with pytest.raises(ValueError):
+        c.set_prewarm(-1)
+    c.deregister("b")  # an ACTIVE-world change rebuilds the plan...
+    p2 = c.plan()
+    assert p2.generation > g and p2.prewarm == 4  # ...hint carried over
+
+
+def test_precompile_is_allocation_free(monkeypatch):
+    """Satellite: precompile must warm N world sizes from ABSTRACT
+    shapes — zero real init_state allocations (the old path paid one
+    full device state per legal size just to lower)."""
+    from edl_tpu.runtime.train import Trainer
+
+    et, coord = make_world(target_world=2, n_trainers=4)
+
+    def boom(self):
+        raise AssertionError("precompile allocated a real init_state")
+
+    monkeypatch.setattr(Trainer, "init_state", boom)
+    et.precompile([1, 2, 4])
+    assert sorted(et._trainers) == [1, 2, 4]
+    assert all(et._trainers[w].step_warm for w in (1, 2, 4))
+
+
+def test_warm_resize_zero_xla_compiles(monkeypatch):
+    """The acceptance bar: a warm resize (precompiled step executables)
+    performs ZERO XLA compiles anywhere in the resize window INCLUDING
+    the first post-resize steps — asserted at the backend_compile seam
+    (which persistent-cache hits bypass, so only true compiles count)."""
+    import jax._src.compiler as compiler
+
+    et, coord = make_world(target_world=2, n_trainers=4)
+    et.precompile([2, 4])
+    et.run(5)
+    et.store.wait()  # the step-5 interval save warms the d2h copy jits
+
+    compiles = []
+    real = compiler.backend_compile
+
+    def counting(*args, **kwargs):
+        compiles.append(1)
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(compiler, "backend_compile", counting)
+    coord.set_target_world(4)
+    et.run(9)
+    grow = et.resize_events[-1]
+    assert grow.world_size == 4 and grow.graceful
+    assert compiles == [], (
+        f"{len(compiles)} XLA compile(s) inside a warm resize window"
+    )
+    # the window's phase record proves the warm path ran: the step was
+    # already compiled, so the overlapped compile phase is ~free
+    assert "compile" in grow.phase_seconds
+    assert et._trainers[4].step_warm
+
+
+def test_prewarm_hint_warms_hinted_size_in_background():
+    """Satellite: the autoscaler's prewarm hint actually triggers a
+    background warm of exactly the hinted size, with no resize and no
+    step-loop interruption; the later retarget then reuses it."""
+    et, coord = make_world(target_world=2, n_trainers=4)
+    et.run(3)
+    gen = et.generation
+    coord.set_prewarm(4)
+    assert coord.plan().generation == gen  # advisory, no barrier
+    et.run(6)  # steady-state steps consume the hint
+    th = et._prewarm_threads.get(4)
+    assert th is not None, "hint did not start a prewarm thread"
+    th.join(timeout=120)
+    assert 4 in et._trainers and et._trainers[4].step_warm
+    assert et.generation == gen, "prewarm must not resize"
+
+    coord.set_target_world(4)
+    et.run(9)
+    grow = et.resize_events[-1]
+    assert grow.world_size == 4 and grow.graceful
+    # loss continuity across the prewarmed resize (steps never paused)
+    assert [r.step for r in et.history] == list(range(9))
+
+
+def test_resize_phase_seconds_record_overlap():
+    """phase_seconds carries both sides of the overlapped work: the
+    background flush hash/spill and the (possibly cold) step compile,
+    plus the residual join each cost the window at the end."""
+    et, coord = make_world(target_world=2, n_trainers=4)
+    # Stop at step 6, past the interval save at 5: the resize flush is
+    # then a FRESH flush (a step-5 resize would dedupe against the
+    # interval checkpoint and skip the background thread entirely).
+    et.run(6)
+    et.store.wait()
+    coord.set_target_world(4)  # NOT precompiled: a cold, overlapped compile
+    et.run(9)
+    grow = et.resize_events[-1]
+    ph = grow.phase_seconds
+    for key in ("flush", "remesh", "restore", "compile", "compile_join",
+                "flush_bg", "flush_bg_join"):
+        assert key in ph, (key, ph)
+    # the cold step compile ran on the warm thread...
+    assert ph["compile"] > 0
+    # ...and the first post-resize step reused its executable
+    assert et._trainers[4].step_warm

@@ -88,6 +88,7 @@ def _wait_for(pred, timeout, what, procs=()):
 def _spawn_worker(
     procs, hist, name, base_port, caddr, checkpoint_interval=2, devices=1,
     gbs=8, extra_env=None, entrypoint="fit_a_line", parallelism="",
+    lr="1e-2",
 ):
     """Launch one real launcher 'pod' subprocess against the HTTP
     coordinator (shared by the multipod tests).  ``devices`` forces the
@@ -120,6 +121,11 @@ def _spawn_worker(
             "--checkpoint-interval", str(checkpoint_interval),
             "--history-file", str(hist[name]),
             "--parallelism", parallelism,
+            # fit_a_line at the default 1e-3 descends too shallowly for
+            # the convergence asserts once resizes stop stalling the
+            # step stream (fewer steps elapse per test phase); 1e-2
+            # matches the chaos suite's optimizer for the same model.
+            "--lr", lr,
         ],
         env=env,
         cwd=REPO,
@@ -668,9 +674,14 @@ def test_broken_world_teardown_skips_shutdown_barrier(monkeypatch):
     )
 
     # _world_broken forwards the signal through the builder attribute
+    import threading
+
     et = ElasticTrainer.__new__(ElasticTrainer)
     et.world_builder = build
     et._trainers = {}
+    et._trainer_lock = threading.Lock()
+    et._cache_epoch = 0
+    et._failed_prewarms = set()
     et.state = None
     et.mesh = None
     et._world_broken()

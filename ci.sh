@@ -18,8 +18,19 @@ export JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES="${JAX_PERSISTENT_CACHE_MIN_ENT
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 python tools/lint.py
+
+# Metrics snapshot artifact: tests/conftest.py's sessionfinish hook
+# writes the process-global telemetry registry's Prometheus exposition
+# (+ the flight-recorder tail) here, so every tier-1 run leaves an
+# inspectable record of what the suite's training actually did.
+export EDL_METRICS_ARTIFACT="${EDL_METRICS_ARTIFACT:-${TMPDIR:-/tmp}/edl-ci-metrics.prom}"
+
 # Tier-1: the full quick suite INCLUDING the seeded single-cycle chaos
 # soak (tests/test_chaos.py).  The multi-cycle soak is marked `slow`
 # and excluded so the tier-1 budget (870s) holds; run it explicitly
 # with `./ci.sh -m slow` (the -m below is overridden by a later -m).
 python -m pytest tests/ -x -q -m "not slow" "$@"
+if [ -f "$EDL_METRICS_ARTIFACT" ]; then
+  echo "metrics snapshot artifact: $EDL_METRICS_ARTIFACT"
+  echo "flight recorder artifact:  ${EDL_METRICS_ARTIFACT%.prom}.events.jsonl"
+fi

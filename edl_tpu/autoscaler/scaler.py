@@ -50,6 +50,9 @@ class ScalePlan:
     diff: Dict[str, int]
     have_pending: bool
     pending: PendingDemand
+    #: per-job structured decision trace (goodput-annotated): what the
+    #: dry run proposed, what was observed, why it did/didn't actuate
+    decisions: Optional[List[dict]] = None
 
 
 class Autoscaler:
@@ -74,6 +77,29 @@ class Autoscaler:
         self._stop = threading.Event()
         self.plans: List[ScalePlan] = []
         self._coord_client = coord_client_factory or make_coord_client
+        # Goodput-annotated decision log (edl_tpu.telemetry): each tick
+        # records, per candidate job, the dry-run trace plus the
+        # OBSERVED step rate / resize cost from the job coordinator's
+        # merged trainer telemetry — elastic decisions driven by
+        # measured throughput, not declared replica ranges alone
+        # (Varuna/Bamboo, PAPERS.md).  Bounded; newest last.
+        from edl_tpu import telemetry
+
+        self.decision_log: List[dict] = []
+        self.decision_log_max = 256
+        self._recorder = telemetry.get_recorder()
+        reg = telemetry.get_registry()
+        self._m_ticks = reg.counter("edl_autoscaler_ticks_total")
+        self._m_actuations = reg.counter("edl_autoscaler_actuations_total")
+        self._g_step_rate = reg.gauge("edl_observed_step_rate")
+        self._g_resize_cost = reg.gauge("edl_observed_resize_cost_seconds")
+        #: goodput observation failure memo: job -> tick of last failed
+        #: probe.  An unreachable coordinator (fake clusters, jobs still
+        #: scheduling) must not charge its connect-retry latency to
+        #: EVERY 5s tick — re-probe only every goodput_retry_ticks.
+        self._tick_count = 0
+        self._goodput_failed_tick: Dict[str, int] = {}
+        self.goodput_retry_ticks = 20
 
     # -- event intake (ref OnAdd/OnUpdate/OnDel, :158-171) -------------------
     def on_add(self, job: TrainingJob):
@@ -171,22 +197,114 @@ class Autoscaler:
             self.max_load_desired,
             pending=demand,
         )
+        self._m_ticks.inc()
+        self._tick_count += 1
 
         targets: Dict[str, int] = {}
         for v in candidates:
             if diff.get(v.name):
                 targets[v.name] = v.parallelism + diff[v.name]
-        self._actuate(targets, diff)
+        applied = self._actuate(targets, diff)
+        # Decisions are journaled AFTER actuation so ``actuated``
+        # reports what actually happened (a PUT that gave up under a
+        # conflict storm is exactly the case the log exists for).
+        decisions = self._record_decisions(
+            candidates, diff, targets, have_pending, applied
+        )
         plan = ScalePlan(
             targets=targets,
             diff=diff,
             have_pending=have_pending,
             pending=demand,
+            decisions=decisions,
         )
         self.plans.append(plan)
         return plan
 
-    def _actuate(self, targets: Dict[str, int], diff: Dict[str, int]):
+    def _observe_goodput(self, name: str) -> dict:
+        """Best-effort read of the job coordinator's merged trainer
+        telemetry (``GET /telemetry``): observed step rate, mean resize
+        cost, cumulative steps.  Empty dict when the coordinator is
+        unreachable or predates telemetry — the decision still logs,
+        just without observations."""
+        job = self.jobs.get(name)
+        if job is None:
+            return {}
+        last_fail = self._goodput_failed_tick.get(name)
+        if (
+            last_fail is not None
+            and self._tick_count - last_fail < self.goodput_retry_ticks
+        ):
+            return {}
+        try:
+            client = self._coord_client(job)
+            tel = getattr(client, "telemetry", None)
+            if tel is None:
+                return {}
+            t = tel() or {}
+        except Exception:
+            self._goodput_failed_tick[name] = self._tick_count
+            return {}
+        self._goodput_failed_tick.pop(name, None)
+        merged = t.get("merged") or {}
+        steps = (merged.get("counters") or {}).get("edl_steps_total") or {}
+        obs = {
+            "step_rate": t.get("step_rate"),
+            "resize_cost_seconds": t.get("resize_cost_seconds"),
+            "steps_total": sum(steps.values()),
+        }
+        if obs["step_rate"] is not None:
+            self._g_step_rate.set(obs["step_rate"], job=name)
+        if obs["resize_cost_seconds"] is not None:
+            self._g_resize_cost.set(obs["resize_cost_seconds"], job=name)
+        return obs
+
+    def _record_decisions(
+        self, candidates, diff, targets, have_pending, applied
+    ) -> List[dict]:
+        """One structured decision entry per candidate: the dry-run
+        trace (current -> proposed), the observed goodput inputs, and
+        the reason the tick did or didn't actuate.  ``applied``: the
+        per-job actuation outcome from ``_actuate``.  Appended to the
+        bounded ``decision_log`` and journaled to the flight recorder."""
+        decisions = []
+        for v in candidates:
+            d = diff.get(v.name, 0)
+            obs = self._observe_goodput(v.name)
+            if d > 0:
+                reason = f"dry run found headroom: +{d} replicas"
+            elif d < 0:
+                reason = (
+                    "shed for pending demand"
+                    if have_pending
+                    else f"dry run sheds {-d} replicas"
+                )
+            else:
+                reason = "dry run at fixed point (no diff)"
+            outcome = applied.get(v.name)
+            if v.name in targets and outcome != "applied":
+                reason += f"; actuation {outcome or 'not attempted'}"
+            entry = {
+                "job": v.name,
+                "dry_run": {
+                    "current": v.parallelism,
+                    "diff": d,
+                    "proposed": targets.get(v.name, v.parallelism),
+                },
+                "observed": obs,
+                "have_pending": have_pending,
+                "actuated": outcome == "applied",
+                "reason": reason,
+            }
+            decisions.append(entry)
+            self.decision_log.append(entry)
+            self._recorder.record("autoscaler.decision", entry)
+        del self.decision_log[: -self.decision_log_max]
+        return decisions
+
+    def _actuate(
+        self, targets: Dict[str, int], diff: Dict[str, int]
+    ) -> Dict[str, str]:
         """ref scaleAllJobs (:339-376); the 5-retry conflict loop lives
         in Cluster.update_parallelism.  Beyond the reference: each PUT
         is paired with the coordinator handshake (SURVEY §7.1 row 4) —
@@ -203,9 +321,11 @@ class Autoscaler:
 
         from edl_tpu.cluster.cluster import ParallelismUpdateError
 
+        applied: Dict[str, str] = {}
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
+                applied[name] = "job gone"
                 continue
             # Prewarm announcement FIRST — before any retarget or PUT:
             # trainers AOT-compile the incoming world size's step while
@@ -230,9 +350,15 @@ class Autoscaler:
                     f"{parallelism} gave up ({e}); retrying next tick",
                     file=sys.stderr,
                 )
+                applied[name] = "PUT gave up (retrying next tick)"
                 continue
+            applied[name] = "applied"
+            self._m_actuations.inc(
+                direction="down" if scale_down else "up"
+            )
             if not scale_down:
                 self._retarget(job, parallelism)
+        return applied
 
     def _announce_prewarm(self, job: TrainingJob, world: int) -> None:
         """POST the planned next parallelism to the job's coordinator

@@ -124,7 +124,11 @@ class FaultSchedule:
     # -- event delivery ------------------------------------------------------
     def due(self, point: str, step: Optional[int] = None) -> List[FaultEvent]:
         """Pop (one-shot) every not-yet-fired event for ``point`` whose
-        step is <= the chaos clock (or explicit ``step``)."""
+        step is <= the chaos clock (or explicit ``step``).  Every
+        delivered fault is journaled to the flight recorder and counted
+        (``edl_chaos_injections_total{point=}``) so a soak failure is
+        reconstructible from telemetry alone — before this, injections
+        vanished into logs."""
         with self._lock:
             now = self._now if step is None else step
             hits = [
@@ -135,7 +139,25 @@ class FaultSchedule:
             for ev in hits:
                 self._events.remove(ev)
             self._fired.extend(hits)
-            return hits
+        if hits:
+            from edl_tpu import telemetry
+
+            rec = telemetry.get_recorder()
+            counter = telemetry.get_registry().counter(
+                "edl_chaos_injections_total"
+            )
+            for ev in hits:
+                counter.inc(point=ev.point)
+                rec.record(
+                    "chaos",
+                    {
+                        "point": ev.point,
+                        "scheduled_step": ev.step,
+                        "arg": ev.arg,
+                    },
+                    step=now,
+                )
+        return hits
 
     def pending(self) -> List[FaultEvent]:
         """Events not yet delivered (a finished soak asserts this is

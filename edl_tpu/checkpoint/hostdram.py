@@ -338,6 +338,18 @@ class HostDRAMStore:
         self.keep = keep
         self.spill_dir = spill_dir
         self.chaos = chaos
+        # Default-on telemetry (edl_tpu.telemetry): saves/flushes land
+        # in the metrics registry and the flight recorder.  The journal
+        # entry is written on the CALLER thread at submission so a
+        # seeded soak's event stream stays deterministic regardless of
+        # how save worker threads interleave.
+        from edl_tpu import telemetry
+
+        self.recorder = telemetry.get_recorder()
+        reg = telemetry.get_registry()
+        self._m_saves = reg.counter("edl_checkpoint_saves_total")
+        self._m_save_bytes = reg.counter("edl_checkpoint_bytes_total")
+        self._m_save_seconds = reg.histogram("edl_checkpoint_save_seconds")
         self._lock = threading.Lock()
         self._checkpoints: Dict[int, HostCheckpoint] = {}  # step -> ckpt
         self._pending: List[threading.Thread] = []
@@ -518,6 +530,14 @@ class HostDRAMStore:
             save_id = self._save_seq
 
         leaves = self._snapshot_leaves(leaves)
+        # Journal at submission (caller thread) so the event order is
+        # deterministic; duration/bytes land in the metrics instead.
+        self.recorder.record(
+            "checkpoint.save",
+            {"step": step_val, "kind": "async"},
+            step=step_val,
+            generation=generation,
+        )
 
         def work():
             try:
@@ -541,6 +561,11 @@ class HostDRAMStore:
                 # on the <60s critical path the digest exists to cut.
                 ckpt.digest()
                 self._publish(ckpt)
+                self._m_saves.inc(kind="async")
+                self._m_save_bytes.inc(ckpt.nbytes(), kind="async")
+                self._m_save_seconds.observe(
+                    ckpt.save_seconds, kind="async"
+                )
                 if self.spill_dir:
                     self._spill(ckpt)
             except BaseException as e:  # pragma: no cover - defensive
@@ -634,6 +659,15 @@ class HostDRAMStore:
             save_seconds=time.perf_counter() - t0,
         )
         self._publish(ckpt)
+        self._m_saves.inc(kind="flush")
+        self._m_save_bytes.inc(ckpt.nbytes(), kind="flush")
+        self._m_save_seconds.observe(ckpt.save_seconds, kind="flush")
+        self.recorder.record(
+            "checkpoint.save",
+            {"step": step_val, "kind": "flush"},
+            step=step_val,
+            generation=generation,
+        )
 
         def finish():
             t1 = time.perf_counter()

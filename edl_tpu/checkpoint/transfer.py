@@ -593,6 +593,65 @@ def stream_restore(
     chaos=None,
     on_leaf: Optional[Callable[[int, np.ndarray], None]] = None,
 ) -> TransferResult:
+    """``_stream_restore`` + telemetry publication: the engine's final
+    stats land in the metrics registry (wire-byte counters, the
+    ``edl_transfer_seconds`` histogram) and the flight recorder, so a
+    resize's transfer cost is visible on ``/metrics`` and every
+    transfer is journaled for post-mortems."""
+    result = _stream_restore(
+        fabric,
+        template_leaves,
+        ckpt,
+        chunk_bytes=chunk_bytes,
+        timeout=timeout,
+        chaos=chaos,
+        on_leaf=on_leaf,
+    )
+    from edl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    s = result.stats
+    if s.bytes_sent:
+        reg.counter("edl_transfer_bytes_sent_total").inc(s.bytes_sent)
+    if s.bytes_received:
+        reg.counter("edl_transfer_bytes_received_total").inc(
+            s.bytes_received
+        )
+    if s.chunks_received:
+        reg.counter("edl_transfer_chunks_total").inc(s.chunks_received)
+    if s.leaves_skipped:
+        reg.counter("edl_transfer_leaves_skipped_total").inc(
+            s.leaves_skipped
+        )
+    reg.histogram("edl_transfer_seconds").observe(s.seconds)
+    telemetry.get_recorder().record(
+        "transfer",
+        {
+            "mode": s.mode,
+            "source_rank": s.source_rank,
+            "step": s.step,
+            "bytes_scheduled": s.bytes_scheduled,
+            "bytes_sent": s.bytes_sent,
+            "bytes_received": s.bytes_received,
+            "leaves_received": s.leaves_received,
+            "leaves_skipped": s.leaves_skipped,
+        },
+        step=s.step,
+        timing={"seconds": round(s.seconds, 6)},
+    )
+    return result
+
+
+def _stream_restore(
+    fabric,
+    template_leaves: Sequence[Any],
+    ckpt: Optional[HostCheckpoint],
+    *,
+    chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+    timeout: float = 120.0,
+    chaos=None,
+    on_leaf: Optional[Callable[[int, np.ndarray], None]] = None,
+) -> TransferResult:
     """Agree on one state across the world and move only the deltas.
 
     ``fabric``: agreement transport (rank, world, allgather,

@@ -272,6 +272,69 @@ def cmd_ingest(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Pretty-print a running job's merged metrics and recent
+    flight-recorder events from its coordinator (`edl metrics
+    <host:port>`).  ``--prom`` dumps the raw Prometheus exposition
+    (what a scraper sees); ``--json`` dumps the merged telemetry
+    document."""
+    from edl_tpu.runtime.coord_service import HTTPCoordinator
+
+    client = HTTPCoordinator(args.url, timeout=args.timeout)
+    if args.prom:
+        print(client.metrics_text(), end="")
+        return 0
+    snap = client.metrics()
+    tel = {}
+    try:
+        tel = client.telemetry()
+    except Exception:
+        pass  # pre-telemetry coordinator: snapshot alone still prints
+    if args.json:
+        print(json.dumps({"coordinator": snap, "telemetry": tel}, indent=2))
+        return 0
+
+    print("coordinator")
+    for k in sorted(snap):
+        print(f"  {k:<24} {snap[k]}")
+    merged = tel.get("merged") or {}
+    rate = tel.get("step_rate")
+    cost = tel.get("resize_cost_seconds")
+    print("goodput")
+    print(f"  {'observed_step_rate':<24} "
+          f"{f'{rate:.3f} steps/s' if rate is not None else 'n/a'}")
+    print(f"  {'resize_cost_seconds':<24} "
+          f"{f'{cost:.3f}' if cost is not None else 'n/a'}")
+    counters = merged.get("counters") or {}
+    if counters:
+        print("counters (merged across trainers)")
+        for name in sorted(counters):
+            for key in sorted(counters[name]):
+                label = f"{{{key}}}" if key else ""
+                print(f"  {name}{label:<32} {counters[name][key]:g}")
+    hists = merged.get("histograms") or {}
+    if hists:
+        print("histograms (merged: count / mean)")
+        for name in sorted(hists):
+            for key in sorted(hists[name]):
+                h = hists[name][key]
+                mean = h["sum"] / h["count"] if h["count"] else 0.0
+                label = f"{{{key}}}" if key else ""
+                print(
+                    f"  {name}{label:<32} {h['count']} / {mean:.6f}"
+                )
+    events = (tel.get("events") or [])[-args.events:]
+    if events:
+        print(f"flight recorder (last {len(events)} events)")
+        for ev in events:
+            data = json.dumps(ev.get("data") or {}, sort_keys=True)
+            print(
+                f"  step={ev.get('step'):<7} gen={ev.get('generation'):<4} "
+                f"{ev.get('kind'):<20} {data}"
+            )
+    return 0
+
+
 def cmd_controller(args) -> int:
     """Run the control plane against a real cluster: watch TrainingJob
     CRs and reconcile/autoscale forever — the reference's whole
@@ -433,6 +496,26 @@ def build_parser() -> argparse.ArgumentParser:
         "--seq-len", type=int, default=2048, help="row length (tokens) - 1"
     )
     s.set_defaults(fn=cmd_ingest)
+
+    s = sub.add_parser(
+        "metrics",
+        help="pretty-print a running job's merged metrics + flight "
+        "recorder (from its coordinator URL)",
+    )
+    s.add_argument("url", help="coordinator address (host:port)")
+    s.add_argument(
+        "--events", type=int, default=20, help="flight-recorder tail length"
+    )
+    s.add_argument(
+        "--prom",
+        action="store_true",
+        help="dump the raw Prometheus text exposition instead",
+    )
+    s.add_argument(
+        "--json", action="store_true", help="dump raw JSON instead"
+    )
+    s.add_argument("--timeout", type=float, default=5.0)
+    s.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser(
         "controller", help="run the control-plane daemon against a cluster"

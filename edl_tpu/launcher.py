@@ -58,6 +58,10 @@ def env_config() -> dict:
             else ""
         ),
         "history_file": e.get("EDL_HISTORY_FILE", ""),
+        # flight-recorder JSONL spill ("" = ring buffer only): every
+        # stamped event (resizes, retries, chaos, saves, transfers)
+        # survives the pod for post-mortems
+        "flight_recorder_file": e.get("EDL_FLIGHT_RECORDER_FILE", ""),
         # Multi-host slice placement: replica index from the per-replica
         # Job's env; host index from the Indexed Job's completion index
         # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides
@@ -562,6 +566,13 @@ def run(
     # Before any compile: every generation's step executable lands in /
     # loads from the shared cache (joiners and cold starts skip XLA).
     configure_compile_cache(compile_cache_dir or cfg["compile_cache_dir"])
+    if cfg["flight_recorder_file"]:
+        # Durable flight-recorder journal: the ring buffer's events
+        # also append to this JSONL so a crashed pod leaves its last
+        # moments on disk (the telemetry half of EDL_HISTORY_FILE).
+        from edl_tpu import telemetry
+
+        telemetry.get_recorder().spill_to(cfg["flight_recorder_file"])
     par = ParallelismSpec.from_env(parallelism or cfg["parallelism"])
     layout = par.axes()
     # bind_model validates layout-vs-entrypoint up front (boot-time

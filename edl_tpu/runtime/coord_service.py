@@ -74,16 +74,44 @@ class CoordinatorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, text: str, code=200):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                if self.path == "/plan":
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                query = parse_qs(u.query)
+                if u.path == "/plan":
                     self._reply({"plan": _plan_to_dict(coord.plan())})
-                elif self.path == "/members":
+                elif u.path == "/members":
                     self._reply({"members": coord.members()})
-                elif self.path == "/target":
+                elif u.path == "/target":
                     self._reply({"world": coord.target_world()})
-                elif self.path == "/metrics":
-                    self._reply(coord.metrics())
-                elif self.path == "/healthz":
+                elif u.path == "/metrics":
+                    # Registry-backed Prometheus exposition by default;
+                    # ?format=json keeps the pre-telemetry dict shape
+                    # (HTTPCoordinator.metrics() and the controller's
+                    # status scrape depend on it).  Version-skew note:
+                    # NEW clients fall back against old servers (404
+                    # on the query form -> bare GET), but a
+                    # PRE-telemetry client's bare GET against this
+                    # server receives text — upgrade control-plane
+                    # binaries before (or with) coordinators.
+                    if query.get("format", [""])[0] == "json":
+                        self._reply(coord.metrics())
+                    else:
+                        self._reply_text(coord.metrics_text())
+                elif u.path == "/telemetry":
+                    self._reply(coord.telemetry())
+                elif u.path == "/healthz":
                     self._reply({"ok": True})
                 else:
                     self._reply({"error": "not found"}, 404)
@@ -117,6 +145,18 @@ class CoordinatorServer:
                         # AOT-warm the hinted world size's step before
                         # the retarget lands (zero-stall resize).
                         coord.set_prewarm(req["world"])
+                        self._reply({"ok": True})
+                    elif self.path == "/telemetry":
+                        # Cumulative per-trainer snapshot + an event
+                        # tail, idempotent by (trainer_id, seq) — the
+                        # piggyback ride of the heartbeat cadence.
+                        coord.report_telemetry(
+                            req["trainer_id"],
+                            snapshot=req.get("snapshot"),
+                            seq=int(req.get("seq", 0)),
+                            events=req.get("events"),
+                            boot=str(req.get("boot", "")),
+                        )
                         self._reply({"ok": True})
                     elif self.path == "/checkpoint":
                         coord.report_checkpoint(req["step"])
@@ -302,10 +342,74 @@ class HTTPCoordinator:
         self._post("/complete", step=step)
 
     def completed(self) -> bool:
-        return bool(self._get("/metrics")["completed"])
+        return bool(self.metrics()["completed"])
 
     def metrics(self) -> dict:
-        return self._get("/metrics")
+        """The coordinator snapshot as a dict (the pre-telemetry JSON
+        shape, preserved behind ``?format=json`` — the default GET
+        /metrics now serves Prometheus text, see ``metrics_text``).
+        Falls back to the bare path for PRE-telemetry coordinators
+        (exact-path match: ``?format=json`` 404s there, and the bare
+        ``/metrics`` still answers the JSON dict)."""
+        import urllib.error
+
+        try:
+            return self._get("/metrics?format=json")
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            return self._get("/metrics")
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (registry-backed GET /metrics)."""
+        url = f"{self.address}/metrics"
+        import urllib.error
+        import zlib
+
+        from edl_tpu.utils.retry import GiveUpError
+
+        try:
+            return self.retry_policy.run(
+                lambda: self._open(url).decode(),
+                retryable=lambda e: not isinstance(e, urllib.error.HTTPError),
+                seed=zlib.crc32(self.address.encode()),
+                describe="coordinator metrics scrape",
+            )
+        except GiveUpError as e:
+            raise ConnectionError(
+                f"coordinator unreachable after {e.attempts} tries"
+            ) from e.last_error
+
+    def report_telemetry(
+        self,
+        trainer_id: str,
+        snapshot: Optional[dict] = None,
+        seq: int = 0,
+        events: Optional[list] = None,
+        boot: str = "",
+    ):
+        """ONE attempt, no backoff (unlike every other call): the
+        report is cumulative and re-sent every cadence anyway, and it
+        runs on the trainer's heartbeat thread — a retry storm here
+        could outlast the membership lease and evict a healthy member
+        for the sake of best-effort telemetry."""
+        payload = {
+            "trainer_id": trainer_id,
+            "snapshot": snapshot,
+            "seq": seq,
+            "events": events,
+            "boot": boot,
+        }
+        req = urllib.request.Request(
+            f"{self.address}/telemetry",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        json.loads(self._open(req))
+
+    def telemetry(self) -> dict:
+        return self._get("/telemetry")
 
     def evict_dead(self) -> List[str]:
         return self._post("/evict_dead")["evicted"]

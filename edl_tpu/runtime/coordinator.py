@@ -36,6 +36,14 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
+from edl_tpu.telemetry import (
+    FlightRecorder,
+    TelemetryAggregator,
+    coord_snapshot_gauges,
+    merge_snapshots,
+    render_prometheus,
+)
+
 
 @dataclass(frozen=True)
 class ElasticPlan:
@@ -137,6 +145,14 @@ class LocalCoordinator:
         #: set when a trainer reports the job finished its passes
         self._completed = False
         self._completed_step = -1
+        #: cluster-wide telemetry: trainers POST cumulative registry
+        #: snapshots (piggybacked on the heartbeat cadence); merge is
+        #: idempotent, so a coordinator restart reconverges as soon as
+        #: each live trainer's next report lands (edl_tpu.telemetry)
+        self._telemetry = TelemetryAggregator(clock=self._clock)
+        #: coordinator-side flight recorder: plan rebuilds, evictions,
+        #: and the tails trainers piggyback on their telemetry reports
+        self._recorder = FlightRecorder(capacity=1024)
 
     # -- membership (trainer-facing) ----------------------------------------
     def register(
@@ -247,6 +263,11 @@ class LocalCoordinator:
             for tid in dead:
                 del self._members[tid]
             if dead:
+                self._recorder.record(
+                    "coord.evict",
+                    {"members": sorted(dead)},
+                    generation=self._generation,
+                )
                 self._rebuild_plan("evict")
             return dead
 
@@ -307,6 +328,65 @@ class LocalCoordinator:
                 "completed": self._completed,
                 "completed_step": self._completed_step,
             }
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition: the coordinator snapshot as
+        gauges, merged with the trainers' reported telemetry (the
+        registry-backed replacement for the ad-hoc JSON ``/metrics``;
+        the JSON shape survives behind ``?format=json``).  The
+        aggregator read holds the lock — the ThreadingHTTPServer can
+        run a scrape concurrently with a trainer's POST /telemetry,
+        and the aggregator has no lock of its own."""
+        with self._lock:
+            trainers = self._telemetry.merged()
+        merged = merge_snapshots(
+            [coord_snapshot_gauges(self.metrics()), trainers]
+        )
+        return render_prometheus(merged)
+
+    # -- telemetry (trainer-facing) ------------------------------------------
+    def report_telemetry(
+        self,
+        trainer_id: str,
+        snapshot: Optional[dict] = None,
+        seq: int = 0,
+        events: Optional[List[dict]] = None,
+        boot: str = "",
+    ) -> None:
+        """Ingest one trainer's cumulative telemetry report: the
+        registry snapshot (idempotently merged by (trainer_id, boot,
+        seq) — a restarted trainer's fresh boot supersedes its dead
+        incarnation's high seq) and a tail of its flight-recorder
+        events."""
+        with self._lock:
+            fresh = self._telemetry.report(
+                trainer_id, snapshot or {}, seq, boot=boot
+            )
+        if fresh and events:
+            self._recorder.record(
+                "coord.telemetry",
+                {"source": trainer_id, "events": len(events)},
+            )
+            self._recorder.ingest(events, origin=trainer_id)
+
+    def telemetry(self) -> dict:
+        """Merged cluster telemetry + derived goodput signals (the
+        autoscaler's decision-log inputs) + recent flight events."""
+        with self._lock:
+            merged = self._telemetry.merged()
+            rate = self._telemetry.step_rate()
+            cost = self._telemetry.resize_cost_seconds(merged=merged)
+            sources = self._telemetry.sources()
+        return {
+            "merged": merged,
+            "step_rate": rate,
+            "resize_cost_seconds": cost,
+            "sources": sources,
+            "events": [e.to_dict() for e in self._recorder.events(64)],
+        }
+
+    def recorder(self) -> FlightRecorder:
+        return self._recorder
 
     def generation(self) -> int:
         with self._lock:
@@ -424,5 +504,14 @@ class LocalCoordinator:
                 "world_size": world,
                 "members": active,
             }
+        )
+        self._recorder.record(
+            "coord.plan",
+            {
+                "reason": reason,
+                "world_size": world,
+                "members": list(active),
+            },
+            generation=self._generation,
         )
         self._lock.notify_all()

@@ -259,6 +259,46 @@ class ElasticTrainer:
 
         self.profiler = StepProfiler()
 
+        # Default-on telemetry: the process-global registry + flight
+        # recorder (edl_tpu.telemetry; tests swap them via scoped()).
+        # Handles are resolved once so the hot loop pays only the
+        # handle's own lock — bench.py measures the realized per-step
+        # cost against the median step time (< 1% acceptance bar).
+        from edl_tpu import telemetry
+
+        self.telemetry = telemetry.get_registry()
+        self.recorder = telemetry.get_recorder()
+        self._m_steps = self.telemetry.counter("edl_steps_total")
+        self._m_step_seconds = self.telemetry.histogram("edl_step_seconds")
+        self._m_resizes = self.telemetry.counter("edl_resizes_total")
+        self._m_resize_seconds = self.telemetry.histogram(
+            "edl_resize_seconds"
+        )
+        self._m_resize_phase = self.telemetry.histogram(
+            "edl_resize_phase_seconds"
+        )
+        self._m_replayed = self.telemetry.counter(
+            "edl_replayed_steps_total"
+        )
+        self._m_world_breaks = self.telemetry.counter(
+            "edl_world_breaks_total"
+        )
+        self._m_reports = self.telemetry.counter(
+            "edl_telemetry_reports_total"
+        )
+        #: how often (seconds) the merged-telemetry report piggybacks
+        #: on the heartbeat cadence; 0 disables reporting
+        self.telemetry_interval: float = 5.0
+        self._last_telemetry_report = 0.0
+        self._telemetry_seq = 0
+        self._events_sent_seq = 0
+        # Per-process nonce: lets the aggregator tell a RESTARTED
+        # trainer (fresh seq stream) from a stale replay of the old
+        # incarnation's high-seq reports.
+        import uuid as _uuid
+
+        self._telemetry_boot = _uuid.uuid4().hex[:12]
+
     # -- trainer cache ------------------------------------------------------
     def _mesh_spec(self, total_devices: int) -> MeshSpec:
         """dp x <layout> mesh shape for a world spanning
@@ -611,7 +651,14 @@ class ElasticTrainer:
                 )
 
     def _resize(self, plan: ElasticPlan) -> bool:
-        from edl_tpu.utils.profiling import annotate
+        from functools import partial
+
+        from edl_tpu.telemetry import span as _span
+
+        # span() = the utils.profiling trace annotation AND the
+        # edl_span_seconds{span=...} histogram under ONE name, so a
+        # phase seen in a device trace is searchable on /metrics.
+        annotate = partial(_span, registry=self.telemetry)
 
         t0 = time.perf_counter()
         phases: Dict[str, float] = {}
@@ -805,6 +852,34 @@ class ElasticTrainer:
             transfer=transfer_stats,
         )
         self.resize_events.append(event)
+        # Telemetry: counters/histograms for the merged cluster view,
+        # plus a flight-recorder event whose deterministic identity
+        # (generation/world/restored/replayed/graceful/source — no
+        # timings) lets a chaos soak be reconstructed bit-for-bit.
+        self._m_resizes.inc(
+            graceful=str(graceful).lower(), source=restore_source
+        )
+        self._m_resize_seconds.observe(seconds)
+        for ph, s in phases.items():
+            self._m_resize_phase.observe(s, phase=ph)
+        if replayed:
+            self._m_replayed.inc(replayed)
+        timing = {"seconds": round(seconds, 6), "phases": phases}
+        if transfer_stats:
+            timing["transfer_seconds"] = transfer_stats.get("seconds")
+        self.recorder.record(
+            "resize",
+            {
+                "world_size": plan.world_size,
+                "restored_step": restored_step,
+                "replayed_steps": replayed,
+                "graceful": graceful,
+                "restore_source": restore_source,
+            },
+            step=self._last_completed_step,
+            generation=plan.generation,
+            timing=timing,
+        )
         if self.on_resize is not None:
             self.on_resize(event)
         # Ack only the members this process owns: via the HTTP
@@ -1039,6 +1114,49 @@ class ElasticTrainer:
         self._last_heartbeat = now
         self._beat_once()
 
+    def _maybe_report_telemetry(self) -> None:
+        """Throttled telemetry report.  Runs ONLY on the heartbeat
+        background thread: the step loop's poll->dispatch window must
+        stay tight — a POST between a member's plan poll and its next
+        step dispatch skews the members' resize-barrier entry and can
+        wedge a scale-down (one member standing down while a peer's
+        already-dispatched collective waits for it forever)."""
+        if self.telemetry_interval <= 0:
+            return
+        now = time.monotonic()
+        if now - self._last_telemetry_report < self.telemetry_interval:
+            return
+        self._last_telemetry_report = now
+        self._report_telemetry()
+
+    def _report_telemetry(self) -> None:
+        """Ship this process's cumulative registry snapshot + the
+        flight-recorder tail to the coordinator, piggybacked on the
+        heartbeat cadence.  Cumulative + seq'd = idempotent at the
+        aggregator; best-effort — telemetry must never stall a step."""
+        rep = getattr(self.coordinator, "report_telemetry", None)
+        if rep is None:
+            return  # test doubles / pre-telemetry coordinators
+        source = self.heartbeat_ids[0] if self.heartbeat_ids else "local"
+        # OLDEST unsent first, bounded per report: a burst larger than
+        # one report drains across the next cadences in order (the
+        # watermark only advances past what was actually shipped).
+        events = self.recorder.events_since(self._events_sent_seq)[:64]
+        self._telemetry_seq += 1
+        try:
+            rep(
+                source,
+                snapshot=self.telemetry.snapshot(),
+                seq=self._telemetry_seq,
+                events=[e.to_dict() for e in events],
+                boot=self._telemetry_boot,
+            )
+        except Exception:
+            return  # unreachable coordinator: next cadence retries
+        if events:
+            self._events_sent_seq = events[-1].seq
+        self._m_reports.inc()
+
     def _ensure_heartbeat_thread(self):
         if self._hb_thread is not None and self._hb_thread.is_alive():
             return
@@ -1050,6 +1168,7 @@ class ElasticTrainer:
             while not self._hb_stop.wait(max(self.heartbeat_interval, 0.05)):
                 if self.heartbeat_ids:
                     self._beat_once()
+                    self._maybe_report_telemetry()
 
         self._hb_thread = threading.Thread(
             target=loop, daemon=True, name="edl-heartbeat"
@@ -1089,6 +1208,16 @@ class ElasticTrainer:
         self.mesh = None
         self._await_new_generation = True
         self._holding = True
+        # Defensive: tests drive _world_broken on __new__-constructed
+        # trainers that never ran __init__ (no telemetry handles).
+        if getattr(self, "_m_world_breaks", None) is not None:
+            self._m_world_breaks.inc()
+            self.recorder.record(
+                "world.broken",
+                {"failed_step": self._last_failed_step},
+                step=self._last_completed_step,
+                generation=self.generation,
+            )
 
     def stop_heartbeat(self):
         """Stop beating before deregistering.  Marks the trainer as
@@ -1217,6 +1346,12 @@ class ElasticTrainer:
                     seconds=time.perf_counter() - t0,
                 )
                 self.history.append(rec)
+                # Default-on per-step telemetry: one counter inc, one
+                # histogram observe, one context stamp (measured in
+                # bench.py's telemetry_overhead — ~µs against ms steps).
+                self.recorder.set_context(step, self.generation)
+                self._m_steps.inc()
+                self._m_step_seconds.observe(rec.seconds)
                 if on_step is not None:
                     on_step(rec)
                 done_step = step + 1

@@ -1,0 +1,99 @@
+"""``edl_tpu.telemetry`` — cluster-wide metrics, flight recorder, and
+goodput feedback (SURVEY §5.1: the reference had NO observability; our
+own signals were scattered — ``ResizeEvent.phase_seconds``, a bare
+coordinator ``metrics()`` dict, bench-private compile counters, chaos
+events vanishing into logs).
+
+Three pieces:
+
+- ``registry``: process-local counters / gauges / bounded histograms
+  with catalog-enforced names and bounded label cardinality, plus
+  Prometheus text exposition and idempotently-mergeable snapshots.
+- ``recorder``: the flight recorder — a deterministic (generation,
+  step)-stamped structured event journal with an order-independent
+  digest, fed by resizes, retries, chaos injections, transfers, and
+  checkpoint saves.
+- ``aggregate``: coordinator-side merge of cumulative per-trainer
+  snapshots + the derived goodput signals (observed step rate, resize
+  cost) the autoscaler's decision log records.
+
+Process-global default instances live here; ``scoped()`` swaps them
+for a ``with`` block so tests get hermetic telemetry without threading
+registry arguments through every constructor.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from edl_tpu.telemetry.aggregate import (
+    TelemetryAggregator,
+    coord_snapshot_gauges,
+)
+from edl_tpu.telemetry.catalog import CATALOG
+from edl_tpu.telemetry.recorder import FlightEvent, FlightRecorder
+from edl_tpu.telemetry.registry import (
+    MetricsRegistry,
+    merge_snapshots,
+    render_prometheus,
+)
+from edl_tpu.telemetry.spans import span
+
+__all__ = [
+    "CATALOG",
+    "FlightEvent",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "TelemetryAggregator",
+    "coord_snapshot_gauges",
+    "get_recorder",
+    "get_registry",
+    "merge_snapshots",
+    "render_prometheus",
+    "scoped",
+    "set_recorder",
+    "set_registry",
+    "span",
+]
+
+_lock = threading.Lock()
+_registry = MetricsRegistry()
+_recorder = FlightRecorder()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
+    global _registry
+    with _lock:
+        old, _registry = _registry, registry
+    return old
+
+
+def set_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    global _recorder
+    with _lock:
+        old, _recorder = _recorder, recorder
+    return old
+
+
+@contextmanager
+def scoped(registry=None, recorder=None):
+    """Swap the process-global registry/recorder for the block (tests,
+    hermetic soaks).  Yields (registry, recorder)."""
+    reg = registry if registry is not None else MetricsRegistry()
+    rec = recorder if recorder is not None else FlightRecorder()
+    old_reg = set_registry(reg)
+    old_rec = set_recorder(rec)
+    try:
+        yield reg, rec
+    finally:
+        set_registry(old_reg)
+        set_recorder(old_rec)

@@ -1,0 +1,236 @@
+"""The metric catalog: every metric name this repo may emit.
+
+One dict literal, deliberately import-free and side-effect-free so
+``tools/lint.py`` can ``ast.literal_eval`` it without importing the
+package: the lint gate rejects any ``counter("...")`` / ``gauge`` /
+``histogram`` call whose name literal is not registered here, which is
+what keeps the exposition surface a *catalog* instead of an accretion
+of free-form strings (the pre-telemetry state: ``phase_seconds`` dict
+keys here, a bench-private compile counter there).
+
+Schema per entry:
+
+- ``type``: "counter" | "gauge" | "histogram" — the registry enforces
+  that a name is only ever used as its declared type.
+- ``help``: one-line description (rendered as Prometheus ``# HELP``).
+- ``labels``: allowed label KEYS (a tuple).  The registry rejects
+  undeclared keys in strict mode; label VALUE cardinality is bounded
+  separately (``registry.MAX_LABEL_SETS``).
+- ``buckets`` (histograms only, optional): upper bounds in seconds /
+  units; omitted = ``registry.DEFAULT_BUCKETS``.
+
+Naming follows Prometheus conventions: ``edl_`` prefix, ``_total``
+suffix on counters, base units (seconds, bytes) spelled out.
+"""
+
+# NOTE: keep this a PURE LITERAL (no comprehensions, no names) —
+# tools/lint.py reads it with ast.literal_eval.
+CATALOG = {
+    # -- training hot loop ---------------------------------------------------
+    "edl_steps_total": {
+        "type": "counter",
+        "help": "Completed train steps (replayed steps count again).",
+        "labels": (),
+    },
+    "edl_step_seconds": {
+        "type": "histogram",
+        "help": "Wall-clock seconds per train step.",
+        "labels": (),
+    },
+    # -- resize window -------------------------------------------------------
+    "edl_resizes_total": {
+        "type": "counter",
+        "help": "Resize barriers completed, by gracefulness and how "
+        "state was restored.",
+        "labels": ("graceful", "source"),
+    },
+    "edl_resize_seconds": {
+        "type": "histogram",
+        "help": "End-to-end resize-window seconds.",
+        "labels": (),
+    },
+    "edl_resize_phase_seconds": {
+        "type": "histogram",
+        "help": "Per-phase resize-window seconds (flush / remesh / "
+        "restore / compile ... — the ResizeEvent.phase_seconds keys).",
+        "labels": ("phase",),
+    },
+    "edl_replayed_steps_total": {
+        "type": "counter",
+        "help": "Steps re-run after a non-graceful resize fell back to "
+        "the last interval checkpoint.",
+        "labels": (),
+    },
+    "edl_world_breaks_total": {
+        "type": "counter",
+        "help": "Live process groups abandoned after a peer died "
+        "mid-collective.",
+        "labels": (),
+    },
+    "edl_span_seconds": {
+        "type": "histogram",
+        "help": "Named span durations; span names match the "
+        "utils.profiling trace annotations so traces and metrics "
+        "correlate by name.",
+        "labels": ("span",),
+    },
+    # -- checkpoints ---------------------------------------------------------
+    "edl_checkpoint_saves_total": {
+        "type": "counter",
+        "help": "Checkpoint saves by kind (async interval save vs "
+        "synchronous resize flush).",
+        "labels": ("kind",),
+    },
+    "edl_checkpoint_bytes_total": {
+        "type": "counter",
+        "help": "Bytes captured into host-DRAM checkpoints.",
+        "labels": ("kind",),
+    },
+    "edl_checkpoint_save_seconds": {
+        "type": "histogram",
+        "help": "Seconds to materialize a checkpoint (async save "
+        "thread / flush device-to-host phase).",
+        "labels": ("kind",),
+    },
+    # -- streaming restore transfer ------------------------------------------
+    "edl_transfer_bytes_sent_total": {
+        "type": "counter",
+        "help": "Restore-transfer bytes this process sent.",
+        "labels": (),
+    },
+    "edl_transfer_bytes_received_total": {
+        "type": "counter",
+        "help": "Restore-transfer bytes this process received.",
+        "labels": (),
+    },
+    "edl_transfer_chunks_total": {
+        "type": "counter",
+        "help": "Restore-transfer chunks received.",
+        "labels": (),
+    },
+    "edl_transfer_leaves_skipped_total": {
+        "type": "counter",
+        "help": "Leaves skipped because local bytes already matched "
+        "the source digest (the delta-restore win).",
+        "labels": (),
+    },
+    "edl_transfer_seconds": {
+        "type": "histogram",
+        "help": "Restore-transfer engine seconds (agreement + wire).",
+        "labels": (),
+    },
+    # -- control plane -------------------------------------------------------
+    "edl_retry_attempts_total": {
+        "type": "counter",
+        "help": "Transient failures absorbed by RetryPolicy (one per "
+        "failed attempt that was retried).",
+        "labels": ("op",),
+    },
+    "edl_retry_giveups_total": {
+        "type": "counter",
+        "help": "RetryPolicy exhaustions (GiveUpError raised).",
+        "labels": ("op",),
+    },
+    "edl_chaos_injections_total": {
+        "type": "counter",
+        "help": "Chaos faults actually delivered, by injection point.",
+        "labels": ("point",),
+    },
+    "edl_telemetry_reports_total": {
+        "type": "counter",
+        "help": "Telemetry snapshots shipped to the coordinator.",
+        "labels": (),
+    },
+    "edl_autoscaler_ticks_total": {
+        "type": "counter",
+        "help": "Autoscaler decision cycles (run_once with jobs).",
+        "labels": (),
+    },
+    "edl_autoscaler_actuations_total": {
+        "type": "counter",
+        "help": "Autoscaler actuations applied, by direction.",
+        "labels": ("direction",),
+    },
+    "edl_observed_step_rate": {
+        "type": "gauge",
+        "help": "Observed cluster step rate (steps/s) from merged "
+        "trainer telemetry — the goodput signal the autoscaler logs "
+        "into its decision trace.",
+        "labels": ("job",),
+    },
+    "edl_observed_resize_cost_seconds": {
+        "type": "gauge",
+        "help": "Mean observed resize cost (seconds) from merged "
+        "trainer telemetry.",
+        "labels": ("job",),
+    },
+    # -- coordinator snapshot (GET /metrics exposition) ----------------------
+    "edl_generation": {
+        "type": "gauge",
+        "help": "Coordinator plan generation.",
+        "labels": (),
+    },
+    "edl_world_size": {
+        "type": "gauge",
+        "help": "Active world size of the current plan.",
+        "labels": (),
+    },
+    "edl_members": {
+        "type": "gauge",
+        "help": "Registered live members (active + standby).",
+        "labels": (),
+    },
+    "edl_standby_members": {
+        "type": "gauge",
+        "help": "Registered members beyond the active world.",
+        "labels": (),
+    },
+    "edl_target_world": {
+        "type": "gauge",
+        "help": "Actuation target world size.",
+        "labels": (),
+    },
+    "edl_prewarm_world": {
+        "type": "gauge",
+        "help": "Advisory prewarm hint (0 = none).",
+        "labels": (),
+    },
+    "edl_target_steps": {
+        "type": "gauge",
+        "help": "Steps after which the job completes (0 = open-ended).",
+        "labels": (),
+    },
+    "edl_latest_checkpoint_step": {
+        "type": "gauge",
+        "help": "Latest durable checkpoint step the coordinator knows.",
+        "labels": (),
+    },
+    "edl_plan_rebuilds": {
+        "type": "gauge",
+        "help": "Plan rebuilds (generation bumps) since coordinator "
+        "start.",
+        "labels": (),
+    },
+    "edl_completed": {
+        "type": "gauge",
+        "help": "1 once a trainer reported the job complete.",
+        "labels": (),
+    },
+    "edl_completed_step": {
+        "type": "gauge",
+        "help": "Step at which completion was reported (-1 = none).",
+        "labels": (),
+    },
+    # -- compile accounting (bench + AOT warmers) ----------------------------
+    "edl_xla_compiles_total": {
+        "type": "counter",
+        "help": "True XLA backend compiles observed (bench.py counts "
+        "them at the backend_compile seam).",
+        "labels": (),
+    },
+    "edl_compile_seconds": {
+        "type": "histogram",
+        "help": "AOT step-warm compile seconds (Trainer.warm_step).",
+        "labels": (),
+    },
+}

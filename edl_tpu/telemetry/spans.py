@@ -1,0 +1,37 @@
+"""Spans: one name, two sinks — the device trace AND the metrics.
+
+``span("resize/flush")`` wraps the body in
+``utils.profiling.annotate`` (a named TraceAnnotation when a device
+trace is live, free otherwise) and, on exit, observes the duration
+into the ``edl_span_seconds{span=...}`` histogram.  The point is that
+a phase seen in a TensorBoard trace and a phase seen on ``/metrics``
+carry the SAME name, so a latency regression found in one is directly
+searchable in the other — before this module the resize phases had a
+trace name (``resize/flush``), a ResizeEvent dict key (``flush``), and
+no metric at all.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+@contextmanager
+def span(name: str, registry=None):
+    """Timed, trace-annotated region.  ``registry`` defaults to the
+    process-global one at call time (so a test's scoped registry wins
+    even for code that imported this module earlier)."""
+    from edl_tpu.utils.profiling import annotate
+
+    if registry is None:
+        from edl_tpu.telemetry import get_registry
+
+        registry = get_registry()
+    hist = registry.histogram("edl_span_seconds")
+    t0 = time.perf_counter()
+    with annotate(name):
+        try:
+            yield
+        finally:
+            hist.observe(time.perf_counter() - t0, span=name)

@@ -101,6 +101,7 @@ class RetryPolicy:
         attempts = max(1, self.max_attempts)
         last: Optional[BaseException] = None
         tried = 0
+        what = describe or getattr(fn, "__name__", "operation")
         for attempt in range(attempts):
             tried = attempt + 1
             try:
@@ -111,6 +112,12 @@ class RetryPolicy:
                 if not retryable(e):
                     raise
                 last = e
+                # Telemetry: every absorbed transient failure is
+                # counted and journaled — "the run retried 40 times
+                # before the give-up" is exactly the post-mortem signal
+                # that used to vanish (flight-recorder step/generation
+                # come from the recorder's ambient context).
+                _note_retry(what, attempt, e)
                 if attempt + 1 >= attempts:
                     break
                 d = self.delay(attempt, seed)
@@ -120,9 +127,28 @@ class RetryPolicy:
                 ):
                     break
                 sleep(d)
-        what = describe or getattr(fn, "__name__", "operation")
+        _note_giveup(what, tried)
         raise GiveUpError(
             f"{what} gave up after {tried} attempt(s): {last}",
             last_error=last,
             attempts=tried,
         ) from last
+
+
+def _note_retry(op: str, attempt: int, err: BaseException) -> None:
+    from edl_tpu import telemetry
+
+    telemetry.get_registry().counter("edl_retry_attempts_total").inc(op=op)
+    telemetry.get_recorder().record(
+        "retry",
+        {"op": op, "attempt": attempt, "error": type(err).__name__},
+    )
+
+
+def _note_giveup(op: str, attempts: int) -> None:
+    from edl_tpu import telemetry
+
+    telemetry.get_registry().counter("edl_retry_giveups_total").inc(op=op)
+    telemetry.get_recorder().record(
+        "retry.giveup", {"op": op, "attempts": attempts}
+    )

@@ -48,3 +48,29 @@ def devices8():
     devs = jax.devices("cpu")
     assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs[:8]
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """EDL_METRICS_ARTIFACT: spill the suite's accumulated telemetry
+    (the process-global registry's Prometheus exposition + the flight
+    recorder's tail) as a CI artifact — ci.sh sets the path and points
+    at it after the tier-1 run."""
+    import json
+    import os as _os
+
+    path = _os.environ.get("EDL_METRICS_ARTIFACT")
+    if not path:
+        return
+    try:
+        from edl_tpu import telemetry
+
+        with open(path, "w") as f:
+            f.write(telemetry.get_registry().render())
+        base = path[:-5] if path.endswith(".prom") else path
+        with open(base + ".events.jsonl", "w") as f:
+            for ev in telemetry.get_recorder().events():
+                f.write(json.dumps(ev.to_dict()) + "\n")
+    except Exception:  # the artifact must never fail the suite
+        import traceback
+
+        traceback.print_exc()

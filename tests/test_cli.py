@@ -435,3 +435,57 @@ def test_ingest_tokens_roundtrip(tmp_path, capsys):
     store = load_array_store(str(tmp_path / "tok_store"))
     assert store["tokens"].shape == (15, 64)
     assert store["tokens"].dtype == np.int32
+
+
+def test_metrics_subcommand_pretty_prints_merged_telemetry(capsys):
+    """`edl metrics <url>`: merged metrics + flight-recorder tail from
+    a running job's coordinator, plus --prom / --json raw modes."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.telemetry import MetricsRegistry
+
+    coord = LocalCoordinator(target_world=2, max_world=4)
+    coord.register("a")
+    coord.register("b")
+    reg = MetricsRegistry()
+    reg.counter("edl_steps_total").inc(42)
+    reg.histogram("edl_resize_seconds").observe(0.25)
+    coord.report_telemetry(
+        "a",
+        snapshot=reg.snapshot(),
+        seq=1,
+        events=[
+            {
+                "kind": "resize",
+                "step": 9,
+                "generation": 2,
+                "data": {"world_size": 2, "graceful": True},
+            }
+        ],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    url = f"127.0.0.1:{server.port}"
+    try:
+        assert main(["metrics", url]) == 0
+        out = capsys.readouterr().out
+        assert "coordinator" in out and "goodput" in out
+        assert "edl_steps_total" in out and "42" in out
+        assert "flight recorder" in out
+        assert "resize" in out and "coord.plan" in out
+
+        assert main(["metrics", url, "--prom"]) == 0
+        prom = capsys.readouterr().out
+        assert "# TYPE edl_members gauge" in prom
+        assert "edl_steps_total 42" in prom
+
+        assert main(["metrics", url, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["coordinator"]["members"] == 2
+        assert (
+            doc["telemetry"]["merged"]["counters"]["edl_steps_total"][""]
+            == 42
+        )
+    finally:
+        server.stop()

@@ -13,6 +13,11 @@ Checks, all stdlib:
 - mutable default arguments (list/dict/set literals)
 - f-strings with no placeholders
 - tabs in indentation, trailing whitespace, overlong lines (> MAX_LINE)
+- unregistered metric names: every ``.counter("...")`` /
+  ``.gauge("...")`` / ``.histogram("...")`` call site (outside tests/)
+  must name a metric declared in ``edl_tpu/telemetry/catalog.py``, and
+  the name must be a string LITERAL — free-form/computed names defeat
+  the catalog and are rejected outright
 
 Exit code 1 on any finding — ``ci.sh`` runs this before the tests.
 """
@@ -27,6 +32,70 @@ MAX_LINE = 100
 
 #: names whose import is a re-export or side-effect, not a use
 REEXPORT_FILES = {"__init__.py"}
+
+#: registry handle constructors whose first argument is a metric name
+METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+_CATALOG_CACHE = [False, None]  # [loaded, names-or-None]
+
+
+def _metric_catalog():
+    """Metric names declared in edl_tpu/telemetry/catalog.py, parsed
+    statically (the catalog is a pure literal precisely so this gate
+    needs no imports).  None when the catalog is absent/unparseable —
+    the check then degrades to literal-ness only."""
+    if not _CATALOG_CACHE[0]:
+        _CATALOG_CACHE[0] = True
+        path = (
+            Path(__file__).resolve().parent.parent
+            / "edl_tpu"
+            / "telemetry"
+            / "catalog.py"
+        )
+        try:
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == "CATALOG":
+                            _CATALOG_CACHE[1] = set(
+                                ast.literal_eval(node.value)
+                            )
+        except (OSError, SyntaxError, ValueError):
+            pass
+    return _CATALOG_CACHE[1]
+
+
+def _metric_name_findings(tree: ast.AST, path: Path):
+    """Reject unregistered / free-form metric names (tests excluded:
+    they may exercise non-strict registries on purpose)."""
+    if "tests" in path.parts:
+        return
+    catalog = _metric_catalog()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute) and f.attr in METRIC_METHODS
+        ):
+            continue
+        if not node.args:
+            continue
+        a = node.args[0]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+            if isinstance(a, ast.Constant):
+                continue  # e.g. collections.Counter(5) — not a metric
+            yield node.lineno, (
+                f"free-form metric name passed to .{f.attr}() — metric "
+                "names must be string literals from the catalog"
+            )
+            continue
+        if catalog is not None and a.value not in catalog:
+            yield node.lineno, (
+                f"unregistered metric name {a.value!r} — declare it in "
+                "edl_tpu/telemetry/catalog.py"
+            )
 
 
 def _used_names(tree: ast.AST) -> set:
@@ -77,6 +146,7 @@ def _unused_imports(tree: ast.AST, path: Path):
 
 def _ast_findings(tree: ast.AST, path: Path):
     yield from _unused_imports(tree, path)
+    yield from _metric_name_findings(tree, path)
     # f-string format specs are themselves JoinedStr nodes with no
     # FormattedValue (f"{x:02d}" nests JoinedStr(['02d'])): exclude
     # them from the no-placeholder check or every formatted f-string

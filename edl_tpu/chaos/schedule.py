@@ -60,6 +60,11 @@ KNOWN_POINTS = (
     "kube.release",              # release a held job (arg: job)
     # (5) AOT prewarm (runtime.elastic._maybe_prewarm)
     "prewarm.hint.dropped",      # autoscaler prewarm hint lost en route
+    # (6) steady-state batch stager (runtime.data.BatchStager)
+    "stage.batch.slow",          # background stager stalls arg seconds
+    "stage.batch.failed",        # stager worker fails one batch (the
+                                 # consumer must fall back to staging
+                                 # synchronously, not lose the step)
 )
 
 

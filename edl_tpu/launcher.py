@@ -38,6 +38,8 @@ def env_config() -> dict:
         "num_passes": int(e.get("EDL_NUM_PASSES", "1")),
         "global_batch_size": int(e.get("EDL_GLOBAL_BATCH_SIZE", "0")),
         "checkpoint_interval": int(e.get("EDL_CHECKPOINT_INTERVAL", "100")),
+        # steady-state async pipeline depth (0 = synchronous loop)
+        "pipeline_depth": int(e.get("EDL_PIPELINE_DEPTH", "2")),
         "fault_tolerant": e.get("EDL_FAULT_TOLERANT", "0") == "1",
         "data_dir": e.get("EDL_DATA_DIR", ""),
         # durable checkpoint volume; "" = host-DRAM only
@@ -705,6 +707,7 @@ def run(
         world_builder=world_builder,
         layout=layout,
     )
+    et.pipeline_depth = cfg["pipeline_depth"]
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
     et.register_replica = cfg["replica"]

@@ -15,7 +15,10 @@ batch semantics are invariant to world size.)
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
@@ -171,6 +174,197 @@ class ShardedDataIterator:
             k: jax.device_put(v, NamedSharding(mesh, spec_for(v.ndim)))
             for k, v in gb.items()
         }
+
+
+class BatchStager:
+    """Background device-batch prefetcher for the steady-state pipeline.
+
+    One worker thread builds and places ``device_batch(step)`` for steps
+    ahead of the consumer, so the host-side batch assembly (memmap
+    fancy-index + device placement) overlaps the previous step's device
+    compute instead of serializing with it.  Because the global batch is
+    a pure function of ``(seed, step)`` (the determinism core above),
+    prefetching changes WHEN a batch is built, never WHAT it contains —
+    the batch stream is bit-identical with the stager on or off.
+
+    Staged batches are keyed by a caller-supplied ``key`` (the elastic
+    runtime passes its plan generation): ``rebind(mesh, key)`` with a
+    new key drops everything staged for the old mesh, so a batch placed
+    on a pre-resize mesh can never be dispatched after the world
+    changed.  A worker failure (or chaos ``stage.batch.failed``) marks
+    the step failed and the consumer falls back to staging
+    synchronously — prefetch is an optimization, never a correctness
+    dependency.
+    """
+
+    #: how long ``get`` waits on an in-flight staging before giving up
+    #: and staging synchronously (the worker resolves every task, so
+    #: this only fires if the worker thread itself died)
+    WAIT_TIMEOUT = 60.0
+
+    def __init__(
+        self,
+        data: ShardedDataIterator,
+        depth: int = 2,
+        batch_axes=("dp",),
+        chaos=None,
+    ):
+        self.data = data
+        self.depth = max(1, int(depth))
+        self.batch_axes = tuple(batch_axes)
+        self.chaos = chaos
+        self._cv = threading.Condition()
+        self._key: Any = None
+        self._mesh: Optional[Mesh] = None
+        self._ready: Dict[int, Any] = {}
+        self._failed: set = set()
+        self._inflight: Optional[int] = None
+        self._queue: deque = deque()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"staged": 0, "hits": 0, "misses": 0, "failures": 0}
+        from edl_tpu import telemetry
+
+        self._m_stage_seconds = telemetry.get_registry().histogram(
+            "edl_batch_stage_seconds"
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+    def rebind(self, mesh: Mesh, key: Any) -> None:
+        """Point the stager at ``mesh`` under cache key ``key``.  A new
+        key invalidates everything staged or queued for the old one."""
+        with self._cv:
+            if key == self._key and mesh is self._mesh:
+                return
+            self._key = key
+            self._mesh = mesh
+            self._ready.clear()
+            self._failed.clear()
+            self._queue.clear()
+            self._cv.notify_all()
+
+    def invalidate(self, join: bool = False) -> None:
+        """Drop every staged/queued batch.  ``join=True`` additionally
+        waits (bounded) for an in-flight staging to finish — callers
+        tearing down a device backend must not leave the worker's
+        ``device_put`` racing the teardown."""
+        with self._cv:
+            self._key = None
+            self._mesh = None
+            self._ready.clear()
+            self._failed.clear()
+            self._queue.clear()
+            self._cv.notify_all()
+            if join:
+                self._cv.wait_for(
+                    lambda: self._inflight is None, timeout=10.0
+                )
+
+    # -- consumer API --------------------------------------------------------
+    def get(self, step: int, horizon: Optional[int] = None) -> Any:
+        """The device batch for ``step``, from the prefetch cache when
+        staged (or in flight), synchronously otherwise; then tops the
+        prefetch window back up to ``depth`` steps ahead (bounded by
+        ``horizon``, the run's target step, when given)."""
+        with self._cv:
+            mesh, key = self._mesh, self._key
+            if mesh is None:
+                raise RuntimeError("BatchStager.get before rebind()")
+            batch = self._ready.pop(step, None)
+            if batch is None and step in self._queue:
+                # Not started yet: reclaim it and build synchronously
+                # (waiting on the worker here would serialize for no
+                # overlap gain).
+                self._queue.remove(step)
+            elif batch is None and step == self._inflight:
+                self._cv.wait_for(
+                    lambda: step != self._inflight or self._key != key,
+                    timeout=self.WAIT_TIMEOUT,
+                )
+                batch = self._ready.pop(step, None)
+            self._failed.discard(step)
+            # Drop anything staged for already-consumed steps (a replay
+            # restart re-keys instead, but belt-and-braces here keeps
+            # the cache from pinning stale device arrays).
+            for s in [s for s in self._ready if s <= step]:
+                del self._ready[s]
+            if batch is not None:
+                self.stats["hits"] += 1
+            else:
+                self.stats["misses"] += 1
+        if batch is None:
+            t0 = time.perf_counter()
+            batch = self.data.device_batch(
+                step, mesh, batch_axes=self.batch_axes
+            )
+            self._m_stage_seconds.observe(time.perf_counter() - t0)
+        self._schedule_ahead(step, horizon)
+        return batch
+
+    def _schedule_ahead(self, step: int, horizon: Optional[int]) -> None:
+        last = step + self.depth
+        if horizon is not None:
+            last = min(last, horizon - 1)
+        with self._cv:
+            if self._mesh is None:
+                return
+            for s in range(step + 1, last + 1):
+                if (
+                    s in self._ready
+                    or s in self._queue
+                    or s == self._inflight
+                    or s in self._failed
+                ):
+                    continue
+                self._queue.append(s)
+            if self._queue and (
+                self._thread is None or not self._thread.is_alive()
+            ):
+                self._thread = threading.Thread(
+                    target=self._work, daemon=True, name="edl-batch-stager"
+                )
+                self._thread.start()
+            self._cv.notify_all()
+
+    # -- worker --------------------------------------------------------------
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue:
+                    if not self._cv.wait(timeout=5.0):
+                        return  # idle out; get() respawns on demand
+                step = self._queue.popleft()
+                mesh, key = self._mesh, self._key
+                self._inflight = step
+            try:
+                chaos = self.chaos
+                if chaos is not None:
+                    for ev in chaos.due("stage.batch.slow"):
+                        time.sleep(float(ev.arg or 0.05))
+                    chaos.maybe_raise("stage.batch.failed")
+                t0 = time.perf_counter()
+                batch = self.data.device_batch(
+                    step, mesh, batch_axes=self.batch_axes
+                )
+                self._m_stage_seconds.observe(time.perf_counter() - t0)
+            except Exception:
+                with self._cv:
+                    self._inflight = None
+                    if self._key == key and self._mesh is mesh:
+                        self._failed.add(step)
+                        self.stats["failures"] += 1
+                    self._cv.notify_all()
+                continue
+            with self._cv:
+                self._inflight = None
+                # Publish only if BOTH the key and the mesh this batch
+                # was placed on are still current: a same-generation
+                # world re-formation (state-loss recovery) rebinds with
+                # an identical key but a fresh mesh — a batch built for
+                # the torn-down mesh must never be served as a hit.
+                if self._key == key and self._mesh is mesh:
+                    self._ready[step] = batch
+                    self.stats["staged"] += 1
+                self._cv.notify_all()
 
 
 def synthetic_dataset(

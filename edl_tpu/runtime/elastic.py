@@ -35,13 +35,26 @@ ordered before teardown), the new size's step compile runs parallel to
 restore/transfer, and the autoscaler's prewarm hint
 (``ElasticPlan.prewarm``) warms the incoming size BEFORE the retarget
 even lands — a fully warm resize performs zero XLA compiles.
+
+Steady state is a bounded async pipeline (``pipeline_depth``, default
+2): a background stager builds batches for the next steps while the
+device computes, step metrics stay device futures harvested with a lag,
+and the host tracks the step counter itself — the per-step
+host<->device round trips (batch staging, ``int(state.step)``,
+``float(loss)``) are off the critical path.  The blocking sync happens
+only at the sanctioned sync points (harvest lag, checkpoint interval,
+resize-barrier entry, hold, run exit; ``tools/lint.py`` rejects any
+other blocking fetch in ``run``), and since the global batch is a pure
+function of ``(seed, step)``, the loss stream is bit-identical with the
+pipeline on or off — including across resizes and replays.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -100,7 +113,27 @@ class StepRecord:
     generation: int
     world_size: int
     loss: float
+    #: lag-corrected wall seconds attributed to this step.  With the
+    #: async pipeline, a step's record is finalized when its device
+    #: metrics are HARVESTED (possibly ``pipeline_depth`` steps later):
+    #: ``seconds`` is completion-to-completion time against the
+    #: previous harvested step (first step of a generation: completion
+    #: minus its own dispatch), so steady-state values measure device
+    #: throughput, not host dispatch latency.  With the pipeline off
+    #: (depth 0) this reduces to the old stage+step+sync measure.
     seconds: float
+
+
+@dataclass
+class _InFlightStep:
+    """A dispatched-but-unharvested step: everything needed to finalize
+    its StepRecord once the device metrics resolve."""
+
+    step: int
+    generation: int
+    world_size: int
+    t_dispatch: float
+    metrics: Dict[str, Any] = field(repr=False, default=None)
 
 
 class ElasticTrainer:
@@ -205,6 +238,43 @@ class ElasticTrainer:
         self._dropped_prewarm_hints = 0
         self._last_completed_step = 0
         self._holding = False
+        #: steady-state pipeline: max in-flight (dispatched, metrics
+        #: unharvested) steps.  2 = one step computing while the next
+        #: stages + dispatches; 0 = the legacy synchronous loop (one
+        #: host<->device round trip per step) — the bench A/B mode.
+        #: Donation already permits run-ahead (the jit consumes each
+        #: state exactly once); the cap keeps the resize barrier's
+        #: drain bounded and deterministic.
+        self.pipeline_depth: int = 2
+        #: host-side step counter (the device ``state.step`` fetch that
+        #: used to block every iteration is retired); synced from
+        #: ``restored_step`` at every resize, advanced at dispatch.
+        self._host_step = 0
+        #: dispatched steps whose device metrics are still in flight,
+        #: oldest first — drained at the sanctioned sync points
+        #: (harvest lag, checkpoint interval, resize-barrier entry,
+        #: hold, run exit)
+        self._pending: deque = deque()
+        self._stager = None
+        self._on_step: Optional[Callable[[StepRecord], None]] = None
+        self._last_harvest_t: Optional[float] = None
+        #: step attribution for a failure surfaced at harvest time (a
+        #: poisoned collective raises when the lagged metrics sync, not
+        #: when the step dispatched — replay/max_world_failures need
+        #: the step that actually failed)
+        self._harvest_failed_step: Optional[int] = None
+        #: set by maybe_resize when a barrier is due but in-flight
+        #: steps must drain first (run() drains and re-polls)
+        self._defer_for_drain = False
+        #: cumulative per-phase hot-loop accounting (bench A/B reads
+        #: the deltas): host batch staging, jit dispatch, harvest-time
+        #: device wait, and the deepest in-flight queue observed
+        self.pipeline_stats: Dict[str, float] = {
+            "stage_s": 0.0,
+            "dispatch_s": 0.0,
+            "device_wait_s": 0.0,
+            "max_in_flight": 0,
+        }
         #: how long run() waits for a formable world before giving up
         self.barrier_timeout: float = 300.0
         self.barrier_poll_interval: float = 0.05
@@ -286,6 +356,10 @@ class ElasticTrainer:
         self._m_reports = self.telemetry.counter(
             "edl_telemetry_reports_total"
         )
+        self._m_pipeline_depth = self.telemetry.gauge("edl_pipeline_depth")
+        self._m_device_wait = self.telemetry.histogram(
+            "edl_device_wait_seconds"
+        )
         #: how often (seconds) the merged-telemetry report piggybacks
         #: on the heartbeat cadence; 0 disables reporting
         self.telemetry_interval: float = 5.0
@@ -347,6 +421,13 @@ class ElasticTrainer:
         """Invalidate the compiled-trainer cache.  Bumping the epoch
         makes any in-flight background warm drop its result instead of
         resurrecting a trainer built over dead device objects."""
+        # Staged batches die with the trainers: join the stager's
+        # in-flight device_put first so it can't race a backend
+        # teardown (the callers about to bury a world).  getattr:
+        # tests drive this on __new__-constructed trainers.
+        stager = getattr(self, "_stager", None)
+        if stager is not None:
+            stager.invalidate(join=True)
         with self._trainer_lock:
             self._trainers.clear()
             self._failed_prewarms.clear()
@@ -465,8 +546,15 @@ class ElasticTrainer:
     def inject_failure(self):
         """Simulate losing the world's device state mid-run (e.g. a host
         dies).  The next resize must fall back to the last *async*
-        checkpoint and replay."""
+        checkpoint and replay.  Run-ahead dies with the host: in-flight
+        pipelined steps are discarded (never harvested into history),
+        so the replay accounting is identical with the pipeline on or
+        off — a dead host cannot have confirmed steps it only
+        dispatched."""
         self.state = None
+        self._pending.clear()
+        if self._stager is not None:
+            self._stager.invalidate()
 
     # -- resize barrier -----------------------------------------------------
     def _flush_begin(self, generation: int):
@@ -836,6 +924,13 @@ class ElasticTrainer:
         self._finish_overlap(warm_th, warm_stats, flush_bg, phases)
         replayed = max(0, self._last_completed_step - restored_step)
 
+        # Re-seed the pipeline's host-side counters for the new
+        # generation: stepping resumes at the restored step, the first
+        # post-resize StepRecord times against its own dispatch, and
+        # nothing staged for the old mesh survives (generation-keyed).
+        self._host_step = restored_step
+        self._last_harvest_t = None
+
         self.generation = plan.generation
         self._standby = False
         self._world_members = tuple(plan.members)
@@ -1202,6 +1297,14 @@ class ElasticTrainer:
         except Exception:
             pass
         self._leak_dead_world()
+        # In-flight step futures died with the world; anything not
+        # already salvaged by _absorb_step_failure's drain is gone (the
+        # restored checkpoint replays those steps deterministically).
+        # getattr: tests drive _world_broken on __new__-constructed
+        # trainers that never ran __init__.
+        pending = getattr(self, "_pending", None)
+        if pending is not None:
+            pending.clear()
         self.state = None
         self._world_members = ()
         self._clear_trainers()
@@ -1259,6 +1362,16 @@ class ElasticTrainer:
                 # background while this one keeps stepping.
                 self._maybe_prewarm(plan)
             return False
+        if self._pending:
+            # Sanctioned sync point: resize-barrier entry.  In-flight
+            # steps must harvest BEFORE the barrier tears anything down
+            # (their device futures die with the old world, and their
+            # records must land in history ahead of any replay), and
+            # the drain must run inside run()'s broken-world guard — a
+            # poisoned collective surfaces here, attributed to its
+            # step.  run() drains and re-polls a fresh plan.
+            self._defer_for_drain = True
+            return False
         if self.heartbeat_ids and not self._my_member_ids(plan):
             # Multi-pod scale-down: this pod dropped out of the world's
             # rank order.  Stand by (keep heartbeating) until a future
@@ -1275,6 +1388,162 @@ class ElasticTrainer:
         self._holding = False
         return True
 
+    # -- the async step pipeline --------------------------------------------
+    def _next_batch(self, step: int, trainer: Trainer, horizon: int):
+        """Step ``step``'s device batch: prefetched by the background
+        stager when the pipeline is on, built inline when off.  Either
+        path yields the identical batch — ``(seed, step) -> indices``
+        is pure, so prefetch changes when, never what."""
+        if self.pipeline_depth <= 0:
+            return self.data.device_batch(
+                step, trainer.mesh, batch_axes=BATCH_AXES
+            )
+        if self._stager is None:
+            from edl_tpu.runtime.data import BatchStager
+
+            self._stager = BatchStager(
+                self.data,
+                depth=self.pipeline_depth,
+                batch_axes=BATCH_AXES,
+                chaos=getattr(self.store, "chaos", None),
+            )
+        # Generation-keyed: a resize re-keys the stager, so a batch
+        # placed on the pre-resize mesh can never be dispatched.
+        self._stager.rebind(trainer.mesh, self.generation)
+        return self._stager.get(step, horizon=horizon)
+
+    def _harvest_pending(self, limit: int) -> None:
+        """Harvest (oldest first) until at most ``limit`` steps remain
+        in flight.  limit=pipeline_depth is the steady-state lag;
+        limit=0 is a full drain (the sanctioned sync points)."""
+        while len(self._pending) > limit:
+            self._harvest_one()
+
+    def _harvest_one(self) -> None:
+        """Resolve the oldest in-flight step's device metrics and
+        finalize its StepRecord.  The blocking ``float`` lives HERE —
+        the sanctioned sync point — not in the dispatch loop; a
+        poisoned collective surfacing in it is attributed to this
+        step (``_harvest_failed_step``) for the replay machinery."""
+        rec = self._pending[0]
+        t0 = time.perf_counter()
+        try:
+            loss = float(rec.metrics["loss"])
+        except Exception:
+            self._harvest_failed_step = rec.step
+            self._pending.popleft()
+            raise
+        self._pending.popleft()
+        now = time.perf_counter()
+        self._m_device_wait.observe(now - t0)
+        self.pipeline_stats["device_wait_s"] += now - t0
+        # Lag-corrected timing: completion-to-completion against the
+        # previous harvested step (see StepRecord.seconds).
+        base = (
+            rec.t_dispatch
+            if self._last_harvest_t is None
+            else max(rec.t_dispatch, self._last_harvest_t)
+        )
+        self._last_harvest_t = now
+        srec = StepRecord(
+            step=rec.step,
+            generation=rec.generation,
+            world_size=rec.world_size,
+            loss=loss,
+            seconds=now - base,
+        )
+        self.history.append(srec)
+        # Default-on per-step telemetry: one counter inc, one histogram
+        # observe, one context stamp (measured in bench.py's
+        # telemetry_overhead — ~µs against ms steps).
+        self.recorder.set_context(rec.step, rec.generation)
+        self._m_steps.inc()
+        self._m_step_seconds.observe(srec.seconds)
+        if self._on_step is not None:
+            self._on_step(srec)
+        done_step = rec.step + 1
+        self._last_completed_step = max(
+            self._last_completed_step, done_step
+        )
+        if done_step > self._last_failed_step:
+            # Progress PAST the last failing step: genuine recovery,
+            # re-arm the cap.  Merely replaying the pre-failure
+            # interval does not count — a deterministic error recurring
+            # at one step (e.g. a poisoned checkpoint path) must
+            # exhaust the cap and surface, not loop teardown/replay
+            # forever.
+            self._world_failures = 0
+
+    def _absorb_step_failure(self, dispatch_step: Optional[int]) -> bool:
+        """The broken-world recovery decision, shared by every guarded
+        site of the step loop (dispatch, lagged harvest, barrier-entry
+        drain).  Must be called from inside an ``except`` block.
+        Returns True when the failure was absorbed (world buried, hold
+        for a fresh generation) — False means the caller must re-raise
+        (deterministic bug / no recovery possible)."""
+        # Salvage completed older steps first: a dispatch failure at
+        # step k leaves k-1, k-2... in flight, possibly healthy — their
+        # records belong in history, and the EARLIEST poisoned step is
+        # the honest attribution.  FIFO harvesting stops at the first
+        # failure; the rest died with the world.
+        if self._harvest_failed_step is None and self._pending:
+            try:
+                self._harvest_pending(0)
+            except Exception:
+                pass  # _harvest_failed_step now names the earliest
+        attempted = self._harvest_failed_step
+        self._harvest_failed_step = None
+        if attempted is None:
+            attempted = (
+                dispatch_step
+                if dispatch_step is not None
+                else self._last_completed_step
+            )
+        self._pending.clear()
+        if not (
+            self.world_builder is not None
+            and self.mesh is not None
+            and self._world_size() > 1
+            and self._world_failures < self.max_world_failures
+        ):
+            return False
+        # A peer died mid-collective (SIGKILL, preemption): the process
+        # group is unusable but THIS process is fine.  Survive it: drop
+        # the world, await the eviction-bumped generation, resume from
+        # the last checkpoint with deterministic replay (SURVEY.md §5.3
+        # — the reference delegated exactly this to master/etcd
+        # re-registration).  Capped: repeated failures with no
+        # completed step in between are a deterministic bug, not churn.
+        import traceback
+
+        traceback.print_exc()
+        if attempted != self._last_failed_step:
+            # A failure at a DIFFERENT step than the previous one is
+            # churn (later = progress happened in between; earlier = a
+            # fresh strike during the replay window) — re-arm the cap.
+            # Only a failure pinned at the same step accumulates toward
+            # the deterministic-bug diagnosis.
+            self._world_failures = 0
+        self._world_failures += 1
+        self._last_failed_step = attempted
+        self._world_broken()
+        return True
+
+    def _drain_guarded(self) -> bool:
+        """Full drain under the broken-world guard (the sync points
+        outside the dispatch ``try``: barrier entry, hold).  Returns
+        False when a failure was absorbed (caller re-polls)."""
+        if not self._pending:
+            return True
+        try:
+            self._harvest_pending(0)
+        except Exception:
+            if self._absorb_step_failure(None):
+                return False
+            self._leak_dead_world()
+            raise
+        return True
+
     # -- the loop -----------------------------------------------------------
     def run(
         self,
@@ -1283,141 +1552,150 @@ class ElasticTrainer:
     ) -> List[StepRecord]:
         """Run until the global step counter reaches ``num_steps``.
 
-        The step counter lives in TrainState and survives resizes, so
-        ``num_steps`` counts *completed global steps*, not loop
-        iterations (replayed steps after a failure re-run the same
-        step numbers)."""
+        The step counter survives resizes (re-seeded from the restored
+        checkpoint), so ``num_steps`` counts *completed global steps*,
+        not loop iterations (replayed steps after a failure re-run the
+        same step numbers).
+
+        Steady state is a bounded async pipeline (``pipeline_depth``,
+        default 2): batches for the next steps stage on a background
+        thread while the device computes, dispatched steps run ahead of
+        their metrics, and the blocking device sync happens only at
+        harvest (lagged) or at a sanctioned sync point — checkpoint
+        interval, resize-barrier entry, hold, run exit.  Depth 0
+        restores the synchronous loop.  The loss/metric stream is
+        bit-identical either way: batches are a pure function of
+        ``(seed, step)`` and harvesting only defers WHEN values are
+        read."""
         hold_started: Optional[float] = None
-        while True:
-            self.maybe_resize()
-            if self._holding:
-                # Barrier hold: the coordinator's current plan has no
-                # formable world.  Poll until membership recovers (the
-                # coordinator bumps the generation when it does).
-                # Standby is different: a healthy steady state (the pod
-                # waits to be readmitted), never a timeout.
-                now = time.monotonic()
-                if self._standby:
-                    hold_started = None
-                elif hold_started is None:
-                    hold_started = now
-                elif now - hold_started > self.barrier_timeout:
-                    # BROKEN worlds were already buried by _world_broken;
-                    # this covers the un-broken case (a healthy world
-                    # whose plan shrank to unformable): abandon its
-                    # handles barrier-free so exit destructors can't
-                    # mask this diagnostic.
+        self._on_step = on_step
+        self._m_pipeline_depth.set(self.pipeline_depth)
+        try:
+            while True:
+                self.maybe_resize()
+                if self._defer_for_drain:
+                    # Sanctioned sync point: resize-barrier entry.
+                    self._defer_for_drain = False
+                    self._drain_guarded()
+                    continue  # re-poll; the drained pipeline resizes
+                if self._holding:
+                    # Sanctioned sync point: hold.  A world with no
+                    # formable plan drains its in-flight steps before
+                    # parking (their futures must not outlive whatever
+                    # teardown ends the hold).
+                    if not self._drain_guarded():
+                        continue
+                    # Barrier hold: the coordinator's current plan has
+                    # no formable world.  Poll until membership
+                    # recovers (the coordinator bumps the generation
+                    # when it does).  Standby is different: a healthy
+                    # steady state (the pod waits to be readmitted),
+                    # never a timeout.
+                    now = time.monotonic()
+                    if self._standby:
+                        hold_started = None
+                    elif hold_started is None:
+                        hold_started = now
+                    elif now - hold_started > self.barrier_timeout:
+                        # BROKEN worlds were already buried by
+                        # _world_broken; this covers the un-broken case
+                        # (a healthy world whose plan shrank to
+                        # unformable): abandon its handles barrier-free
+                        # so exit destructors can't mask this
+                        # diagnostic.
+                        self._leak_dead_world()
+                        raise RuntimeError(
+                            f"held at resize barrier > "
+                            f"{self.barrier_timeout}s with no formable "
+                            "world"
+                        )
+                    time.sleep(self.barrier_poll_interval)
+                    continue
+                hold_started = None
+                if self.state is None:
                     self._leak_dead_world()
                     raise RuntimeError(
-                        f"held at resize barrier > {self.barrier_timeout}s "
-                        "with no formable world"
+                        "no plan with world_size >= 1 available"
                     )
-                time.sleep(self.barrier_poll_interval)
-                continue
-            hold_started = None
-            if self.state is None:
-                self._leak_dead_world()
-                raise RuntimeError("no plan with world_size >= 1 available")
-            step = None  # the step this iteration attempts (for the cap)
-            try:
-                # The whole body is guarded: an async collective poisoned
-                # by a peer's ungraceful death can surface at ANY device
-                # access here (step read, the step itself, the loss sync,
-                # the checkpoint's device fetch) — not just inside
-                # trainer.step.
-                step = int(self.state.step)
-                if step >= num_steps:
-                    break
-                trainer = self._trainers[self._world_size()]
-                self.profiler.maybe_start()
-                t0 = time.perf_counter()
-                with self.profiler.step(step):
-                    batch = self.data.device_batch(
-                        step, trainer.mesh, batch_axes=BATCH_AXES
+                step = None  # the step this iteration attempts
+                try:
+                    # The whole body is guarded: an async collective
+                    # poisoned by a peer's ungraceful death can surface
+                    # at ANY device access here (the dispatch itself or
+                    # a lagged harvest) — not just inside trainer.step.
+                    step = self._host_step
+                    if step >= num_steps:
+                        # Sanctioned sync point: run exit.  Every
+                        # dispatched step confirms before returning.
+                        self._harvest_pending(0)
+                        break
+                    trainer = self._trainers[self._world_size()]
+                    self.profiler.maybe_start()
+                    t0 = time.perf_counter()
+                    with self.profiler.step(step):
+                        batch = self._next_batch(step, trainer, num_steps)
+                        t1 = time.perf_counter()
+                        self.state, metrics = trainer.step(
+                            self.state, batch
+                        )
+                    t2 = time.perf_counter()
+                    self.pipeline_stats["stage_s"] += t1 - t0
+                    self.pipeline_stats["dispatch_s"] += t2 - t1
+                    self._pending.append(
+                        _InFlightStep(
+                            step=step,
+                            generation=self.generation,
+                            world_size=self._world_size(),
+                            t_dispatch=t0,
+                            metrics=metrics,
+                        )
                     )
-                    self.state, metrics = trainer.step(self.state, batch)
-                    loss = float(metrics["loss"])
-                self.profiler.maybe_stop()
-                rec = StepRecord(
-                    step=step,
-                    generation=self.generation,
-                    world_size=self._world_size(),
-                    loss=loss,
-                    seconds=time.perf_counter() - t0,
-                )
-                self.history.append(rec)
-                # Default-on per-step telemetry: one counter inc, one
-                # histogram observe, one context stamp (measured in
-                # bench.py's telemetry_overhead — ~µs against ms steps).
-                self.recorder.set_context(step, self.generation)
-                self._m_steps.inc()
-                self._m_step_seconds.observe(rec.seconds)
-                if on_step is not None:
-                    on_step(rec)
-                done_step = step + 1
-                self._last_completed_step = max(
-                    self._last_completed_step, done_step
-                )
-                if (
-                    self.checkpoint_interval > 0
-                    and done_step % self.checkpoint_interval == 0
-                ):
-                    self.store.save_async(
-                        self.state, generation=self.generation
-                    )
-                    self.coordinator.report_checkpoint(done_step)
-                if done_step > self._last_failed_step:
-                    # Progress PAST the last failing step: genuine
-                    # recovery, re-arm the cap.  Merely replaying the
-                    # pre-failure interval does not count — a
-                    # deterministic error recurring at one step (e.g. a
-                    # poisoned checkpoint path) must exhaust the cap
-                    # and surface, not loop teardown/replay forever.
-                    self._world_failures = 0
-            except Exception:
-                if (
-                    self.world_builder is not None
-                    and self._world_size() > 1
-                    and self._world_failures < self.max_world_failures
-                ):
-                    # A peer died mid-collective (SIGKILL, preemption):
-                    # the process group is unusable but THIS process is
-                    # fine.  Survive it: drop the world, await the
-                    # eviction-bumped generation, resume from the last
-                    # checkpoint with deterministic replay (SURVEY.md
-                    # §5.3 — the reference delegated exactly this to
-                    # master/etcd re-registration).  Capped: repeated
-                    # failures with no completed step in between are a
-                    # deterministic bug, not churn — re-raise rather
-                    # than masking it behind a barrier hold.
-                    import traceback
-
-                    traceback.print_exc()
-                    # The step this attempt died on; when the read of
-                    # state.step itself threw, fall back to the loop's
-                    # high-water mark.
-                    attempted = (
-                        step if step is not None else self._last_completed_step
-                    )
-                    if attempted != self._last_failed_step:
-                        # A failure at a DIFFERENT step than the
-                        # previous one is churn (later = progress
-                        # happened in between; earlier = a fresh strike
-                        # during the replay window) — re-arm the cap.
-                        # Only a failure pinned at the same step
-                        # accumulates toward the deterministic-bug
-                        # diagnosis.
-                        self._world_failures = 0
-                    self._world_failures += 1
-                    self._last_failed_step = attempted
-                    self._world_broken()
-                    continue
-                # Fatal: no next formation will tear this world down.
-                # Abandon its handles barrier-free so interpreter-exit
-                # destructors can't hang/abort on dead peers and mask
-                # the diagnostic traceback below.
-                self._leak_dead_world()
-                raise
+                    if self.profiler.tracing:
+                        # Sanctioned sync point: a LIVE bounded trace
+                        # (tracing, not enabled — enabled stays true
+                        # for the whole process and would disable the
+                        # pipeline forever).  The trace must capture
+                        # THIS step's device work too, which is still
+                        # in flight — drain after appending it, before
+                        # maybe_stop() can close the trace, or the
+                        # tail steps' compute is truncated (the old
+                        # loop's per-step sync did this implicitly).
+                        self._harvest_pending(0)
+                    self.profiler.maybe_stop()
+                    self._host_step = step + 1
+                    done_step = step + 1
+                    if (
+                        self.checkpoint_interval > 0
+                        and done_step % self.checkpoint_interval == 0
+                    ):
+                        # Sanctioned sync point: interval checkpoint.
+                        # Confirm every step up to done_step before the
+                        # snapshot (keeps save/record/on_step ordering
+                        # identical to the synchronous loop).
+                        self._harvest_pending(0)
+                        self.store.save_async(
+                            self.state, generation=self.generation
+                        )
+                        self.coordinator.report_checkpoint(done_step)
+                    else:
+                        self._harvest_pending(self.pipeline_depth)
+                    if len(self._pending) > self.pipeline_stats[
+                        "max_in_flight"
+                    ]:
+                        self.pipeline_stats["max_in_flight"] = len(
+                            self._pending
+                        )
+                except Exception:
+                    if self._absorb_step_failure(step):
+                        continue
+                    # Fatal: no next formation will tear this world
+                    # down.  Abandon its handles barrier-free so
+                    # interpreter-exit destructors can't hang/abort on
+                    # dead peers and mask the diagnostic traceback.
+                    self._leak_dead_world()
+                    raise
+        finally:
+            self._on_step = None
         self.profiler.stop()  # close any live trace at target step
         return self.history
 

@@ -16,6 +16,7 @@ rules), XLA inserting the collectives.  Elasticity = constructing a new
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Tuple
 
 import jax
@@ -24,6 +25,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from edl_tpu import telemetry
 from edl_tpu.models.base import ModelDef
 from edl_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP
 
@@ -224,9 +226,7 @@ class Trainer:
         steady-state steps (the prewarm path)."""
         if self._compiled_step is not None:
             return False
-        import time as _time
-
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         shardings = self.state_shardings()
         abstract = self.abstract_state()
         if isinstance(shardings, NamedSharding):
@@ -243,10 +243,8 @@ class Trainer:
         # Telemetry: the AOT warm's cost lands in the registry so the
         # "resize windows perform zero compiles" claim has its measured
         # counterpart (where the compile time actually went).
-        from edl_tpu import telemetry
-
         telemetry.get_registry().histogram("edl_compile_seconds").observe(
-            _time.perf_counter() - t0
+            time.perf_counter() - t0
         )
         return True
 

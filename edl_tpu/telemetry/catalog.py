@@ -37,6 +37,25 @@ CATALOG = {
         "help": "Wall-clock seconds per train step.",
         "labels": (),
     },
+    "edl_pipeline_depth": {
+        "type": "gauge",
+        "help": "Configured steady-state pipeline depth (max in-flight "
+        "steps; 0 = synchronous per-step host<->device sync).",
+        "labels": (),
+    },
+    "edl_batch_stage_seconds": {
+        "type": "histogram",
+        "help": "Seconds to assemble one global batch on the host and "
+        "place it on device (the work the pipeline's background "
+        "stager overlaps with compute).",
+        "labels": (),
+    },
+    "edl_device_wait_seconds": {
+        "type": "histogram",
+        "help": "Seconds the host blocked waiting on a step's device "
+        "metrics at harvest (lag-deferred float(loss) sync).",
+        "labels": (),
+    },
     # -- resize window -------------------------------------------------------
     "edl_resizes_total": {
         "type": "counter",

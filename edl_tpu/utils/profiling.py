@@ -46,6 +46,14 @@ class StepProfiler:
     def enabled(self) -> bool:
         return bool(self.profile_dir)
 
+    @property
+    def tracing(self) -> bool:
+        """Whether a bounded trace is LIVE right now (enabled stays
+        true for the whole process; this window closes after
+        ``max_steps``) — async callers sync their in-flight device
+        work only inside this window."""
+        return self._live
+
     def maybe_start(self) -> None:
         if not self.enabled or self._live or self._steps_seen > 0:
             return

@@ -1,0 +1,206 @@
+"""Golden-transcript test for the ``KubectlAPI`` shell-out surface
+(VERDICT r5 #10).
+
+``KubectlAPI`` is the one process boundary the framework cannot test
+against a real cluster in CI, so its contract is pinned HERE: every
+kubectl invocation's argv (and stdin payload) for the submit / scale /
+delete flows is recorded through a transcript shim in front of
+``fake_kubectl`` and compared against a golden sequence.  A change to
+how the adapter shells out — reordered flags, a renamed verb, a
+different patch shape — fails this test instead of surfacing on a live
+cluster.
+"""
+
+import json
+import stat
+import sys
+import textwrap
+
+import pytest
+
+from edl_tpu.cluster.kube import KubectlAPI, WorkloadInfo
+
+RECORDER = """\
+#!/usr/bin/env python
+import io, json, os, sys
+argv = sys.argv[1:]
+# fake_kubectl reads stdin only for `apply -f -`; mirror that so a
+# transcript run never blocks on an unpiped stdin.
+payload = sys.stdin.read() if ("apply" in argv and "-" in argv) else ""
+with open(os.environ["EDL_KUBECTL_TRANSCRIPT"], "a") as f:
+    f.write(json.dumps({"argv": argv, "stdin": payload}) + chr(10))
+sys.stdin = io.StringIO(payload)
+from edl_tpu.cluster import fake_kubectl
+sys.exit(fake_kubectl.main(argv))
+"""
+
+JOB_MANIFEST = {
+    "apiVersion": "batch/v1",
+    "kind": "Job",
+    "metadata": {"name": "gj-trainer", "labels": {"edl-job": "gj"}},
+    "spec": {
+        "parallelism": 2,
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"},
+                            "limits": {"google.com/tpu": "4"},
+                        }
+                    }
+                ]
+            }
+        },
+    },
+}
+
+
+@pytest.fixture
+def transcript_api(tmp_path, monkeypatch):
+    state = tmp_path / "kube-state.json"
+    state.write_text(
+        json.dumps(
+            {
+                "nodes": [
+                    {
+                        "name": "pool-0",
+                        "cpu_milli": 16000,
+                        "memory_mega": 65536,
+                        "tpu_chips": 8,
+                    }
+                ]
+            }
+        )
+    )
+    recorder = tmp_path / "recorder.py"
+    recorder.write_text(RECORDER)
+    shim = tmp_path / "kubectl"
+    shim.write_text(
+        "#!/bin/sh\n" f'exec {sys.executable} {recorder} "$@"\n'
+    )
+    shim.chmod(shim.stat().st_mode | stat.S_IEXEC)
+    transcript = tmp_path / "transcript.jsonl"
+    monkeypatch.setenv("EDL_FAKE_KUBE_STATE", str(state))
+    monkeypatch.setenv("EDL_KUBECTL_TRANSCRIPT", str(transcript))
+    import os
+
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return KubectlAPI(kubectl=str(shim)), transcript
+
+
+def _read(transcript):
+    return [
+        json.loads(line)
+        for line in transcript.read_text().splitlines()
+        if line.strip()
+    ]
+
+
+def test_golden_transcript_submit_scale_delete(transcript_api):
+    api, transcript = transcript_api
+
+    # -- submit: one `apply -f -` with the manifest List on stdin ------------
+    api.apply_manifests([JOB_MANIFEST])
+    # -- scale: optimistic-concurrency read-modify-patch-reread --------------
+    w = api.get_workload("gj-trainer")
+    assert w is not None and w.parallelism == 2
+    w.parallelism = 3
+    api.update_workload(w)
+    # -- scale-down victim + teardown ----------------------------------------
+    api.delete_pod("gj-pod-000001")
+    api.delete_workload("gj-trainer")
+
+    records = _read(transcript)
+    golden_argv = [
+        # submit
+        ["-n", "default", "apply", "-f", "-"],
+        # scale: GET (fresh resourceVersion) ...
+        ["-n", "default", "get", "job", "gj-trainer", "-o", "json"],
+        # ... PATCH with the rv precondition in the merge body ...
+        [
+            "-n",
+            "default",
+            "patch",
+            "job",
+            "gj-trainer",
+            "--type=merge",
+            "-p",
+            json.dumps(
+                {
+                    "metadata": {"resourceVersion": "1"},
+                    "spec": {"parallelism": 3},
+                }
+            ),
+        ],
+        # ... and the post-patch re-read update_workload returns
+        ["-n", "default", "get", "job", "gj-trainer", "-o", "json"],
+        # named-victim pod delete: non-blocking, idempotent
+        [
+            "-n",
+            "default",
+            "delete",
+            "pod",
+            "gj-pod-000001",
+            "--wait=false",
+            "--ignore-not-found",
+        ],
+        # delete_workload sweeps every kind a job may own, by one name
+        ["-n", "default", "delete", "job", "gj-trainer", "--ignore-not-found"],
+        [
+            "-n",
+            "default",
+            "delete",
+            "deployment",
+            "gj-trainer",
+            "--ignore-not-found",
+        ],
+        [
+            "-n",
+            "default",
+            "delete",
+            "service",
+            "gj-trainer",
+            "--ignore-not-found",
+        ],
+    ]
+    assert [r["argv"] for r in records] == golden_argv
+
+    # the submit payload: a v1 List wrapping the manifests verbatim
+    payload = json.loads(records[0]["stdin"])
+    assert payload == {
+        "apiVersion": "v1",
+        "kind": "List",
+        "items": [JOB_MANIFEST],
+    }
+    # only apply ships stdin
+    assert all(r["stdin"] == "" for r in records[1:])
+
+
+def test_golden_transcript_conflict_surfaces(transcript_api):
+    """A stale resourceVersion must round-trip to ConflictError through
+    the recorded patch invocation (the retry loop's trigger)."""
+    from edl_tpu.cluster.kube import ConflictError
+
+    api, transcript = transcript_api
+    api.apply_manifests([JOB_MANIFEST])
+    stale = WorkloadInfo(
+        name="gj-trainer", job_name="gj", parallelism=5, resource_version=99
+    )
+    with pytest.raises(ConflictError):
+        api.update_workload(stale)
+    records = _read(transcript)
+    assert records[-1]["argv"][2:6] == ["patch", "job", "gj-trainer", "--type=merge"]
+    assert json.loads(records[-1]["argv"][-1]) == {
+        "metadata": {"resourceVersion": "99"},
+        "spec": {"parallelism": 5},
+    }
+
+
+def test_recorder_is_literal_shim():
+    """The transcript recorder must stay a pass-through: it may not
+    reorder or rewrite argv (the golden pins would be meaningless)."""
+    assert "fake_kubectl.main(argv)" in textwrap.dedent(RECORDER)

@@ -1,0 +1,282 @@
+"""Asynchronous steady-state step pipeline tests (ISSUE 7).
+
+The pipeline overlaps batch staging with device compute and defers the
+per-step host<->device metrics sync behind a bounded lag.  Everything
+here asserts ONE invariant from different angles: the pipeline changes
+WHEN work happens, never WHAT is computed — the loss/metric stream is
+bit-identical with the pipeline on or off, through resizes, replays,
+and chaos-injected stager faults.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.models import get_model
+from edl_tpu.runtime import ShardedDataIterator
+from edl_tpu.runtime.coordinator import LocalCoordinator
+from edl_tpu.runtime.data import BatchStager, synthetic_dataset
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+
+def make_world(
+    target_world=2, n_trainers=2, ckpt_interval=5, seed=0, depth=2
+):
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=64, seed=0)
+    coord = LocalCoordinator(target_world=target_world, max_world=8)
+    for i in range(n_trainers):
+        coord.register(f"tr{i}")
+    et = ElasticTrainer(
+        model,
+        optax.adam(1e-2),
+        it,
+        coord,
+        checkpoint_interval=ckpt_interval,
+        seed=seed,
+    )
+    et.pipeline_depth = depth
+    return et, coord
+
+
+def _stream(hist):
+    return [(r.step, r.loss) for r in hist]
+
+
+# ---- bit-identical loss stream ---------------------------------------------
+
+
+def test_loss_stream_bit_identical_pipeline_on_vs_off():
+    """The core determinism claim: the EXACT float stream (not merely
+    allclose) is invariant to the pipeline — batches are a pure
+    function of (seed, step) and harvesting only defers reads."""
+    sync, _ = make_world(depth=0)
+    pipe, _ = make_world(depth=2)
+    s_hist = sync.run(20)
+    p_hist = pipe.run(20)
+    assert _stream(s_hist) == _stream(p_hist)
+    # the pipelined run actually ran ahead (it was not secretly sync)
+    assert pipe.pipeline_stats["max_in_flight"] == 2
+    assert sync.pipeline_stats["max_in_flight"] == 0
+
+
+def test_loss_stream_bit_identical_across_midrun_resize():
+    """Same claim across a 2 -> 4 growth resize: the barrier-entry
+    drain confirms every in-flight step before the world changes, so
+    records, step order, and losses match the synchronous mode."""
+    runs = {}
+    for depth in (0, 2):
+        et, coord = make_world(target_world=2, n_trainers=4, depth=depth)
+        et.run(10)
+        coord.set_target_world(4)
+        runs[depth] = (et, et.run(20))
+    assert _stream(runs[0][1]) == _stream(runs[2][1])
+    for et, hist in runs.values():
+        assert hist[9].world_size == 2 and hist[10].world_size == 4
+        grow = et.resize_events[-1]
+        # the drain ran BEFORE the flush: no steps lost, none replayed
+        assert grow.graceful and grow.replayed_steps == 0
+
+
+def test_loss_stream_bit_identical_across_replay_after_kill():
+    """Replay after a death-with-state-loss: both modes restore the
+    step-5 interval checkpoint and replay the same steps with the same
+    losses (the history contains the pre-kill and replayed records in
+    the same order)."""
+    streams = {}
+    for depth in (0, 2):
+        et, coord = make_world(ckpt_interval=5, depth=depth)
+        et.run(8)
+        et.store.wait()
+        et.inject_failure()  # device state gone; pipeline discarded
+        coord.deregister("tr1")  # failure detection evicts the peer
+        hist = et.run(14)
+        ev = et.resize_events[-1]
+        assert not ev.graceful and ev.restored_step == 5
+        assert ev.replayed_steps == 3
+        streams[depth] = _stream(hist)
+    assert streams[0] == streams[2]
+
+
+def test_chaos_seeded_stager_faults_keep_stream_identical():
+    """chaos[stage.batch.slow] / chaos[stage.batch.failed]: a stalled
+    or dying background stager degrades to synchronous staging — same
+    losses, no lost steps, and the failure is visible in the stager's
+    accounting rather than the run's output."""
+    from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+
+    ref, _ = make_world(depth=2)
+    ref_hist = ref.run(16)
+
+    schedule = FaultSchedule(
+        seed=7,
+        events=[
+            FaultEvent(3, "stage.batch.slow", 0.05),
+            FaultEvent(6, "stage.batch.failed"),
+        ],
+    )
+    et, _ = make_world(depth=2)
+    et.store.chaos = schedule  # the stager reads store.chaos
+    # the chaos clock normally advances via ChaosMonkey.on_step; this
+    # test only needs stager events, so drive it directly
+    hist = et.run(16, on_step=lambda r: schedule.advance(r.step))
+    assert _stream(hist) == _stream(ref_hist)
+    assert schedule.pending() == []
+    assert et._stager.stats["failures"] >= 1
+
+
+def test_pipeline_drains_at_checkpoint_interval_and_run_exit():
+    """Sanctioned sync points: at every interval save and at run exit
+    the in-flight queue is empty, so a checkpoint can never capture a
+    state whose confirming metrics are still in flight."""
+    et, _ = make_world(ckpt_interval=4, depth=2)
+    seen = []
+
+    def on_step(rec):
+        done = rec.step + 1
+        if done % 4 == 0:
+            # the interval-save drain harvests THIS step before the
+            # save; nothing newer may be pending at that moment
+            seen.append(len(et._pending))
+
+    et.run(12, on_step=on_step)
+    assert seen and all(n == 0 for n in seen)
+    assert len(et._pending) == 0
+    assert [r.step for r in et.history] == list(range(12))
+
+
+def test_host_step_counter_retires_device_fetch(monkeypatch):
+    """The hot loop must not fetch state.step from the device: poison
+    the device counter's __int__ path and the loop must still step
+    correctly from its host-side counter."""
+    et, _ = make_world(depth=2)
+    et.run(6)  # host counter live after the initial resize
+
+    impl = type(et.state.step)  # the concrete ArrayImpl class
+
+    def boom(self):
+        raise AssertionError("hot loop fetched a device scalar via int()")
+
+    monkeypatch.setattr(impl, "__int__", boom)
+    try:
+        et.run(10)
+    finally:
+        monkeypatch.undo()
+    assert [r.step for r in et.history] == list(range(10))
+
+
+# ---- BatchStager unit tests -------------------------------------------------
+
+
+@pytest.fixture
+def mesh1():
+    from edl_tpu.parallel.mesh import dp_mesh
+
+    return dp_mesh(1)
+
+
+def test_stager_epoch_boundary_determinism(mesh1):
+    """Prefetch across an epoch boundary yields exactly the batches the
+    synchronous path builds: the (seed, epoch) reshuffle is a pure
+    function, so staging ahead into the next epoch changes nothing."""
+    ds = {"x": np.arange(128, dtype=np.float32)[:, None]}
+    it = ShardedDataIterator(ds, global_batch_size=32, seed=3)
+    assert it.batches_per_epoch == 4
+    stager = BatchStager(it, depth=3)
+    stager.rebind(mesh1, key=0)
+    # steps 2..6 cross the epoch-1 boundary at step 4
+    for step in range(2, 7):
+        got = stager.get(step)
+        want = it.device_batch(step, mesh1)
+        np.testing.assert_array_equal(
+            np.asarray(got["x"]), np.asarray(want["x"])
+        )
+
+
+def test_stager_rebind_invalidates_staged_batches(mesh1):
+    ds = {"x": np.arange(64, dtype=np.float32)[:, None]}
+    it = ShardedDataIterator(ds, global_batch_size=16, seed=0)
+    stager = BatchStager(it, depth=2)
+    stager.rebind(mesh1, key=1)
+    stager.get(0)  # schedules 1, 2
+    stager.rebind(mesh1, key=2)  # a resize: staged batches must drop
+    with stager._cv:
+        assert stager._ready == {} and not stager._queue
+    # and the stager still serves correctly under the new key
+    got = stager.get(1)
+    np.testing.assert_array_equal(
+        np.asarray(got["x"]), np.asarray(it.device_batch(1, mesh1)["x"])
+    )
+
+
+def test_stager_worker_failure_falls_back_synchronously(mesh1, monkeypatch):
+    """A worker that dies on every build must not lose steps or hang
+    the consumer: get() falls back to building inline."""
+    ds = {"x": np.arange(64, dtype=np.float32)[:, None]}
+    it = ShardedDataIterator(ds, global_batch_size=16, seed=0)
+    stager = BatchStager(it, depth=2)
+    stager.rebind(mesh1, key=1)
+
+    real = it.device_batch
+    calls = {"n": 0}
+
+    def flaky(step, mesh, batch_axes=("dp",)):
+        import threading
+
+        if threading.current_thread().name == "edl-batch-stager":
+            calls["n"] += 1
+            raise RuntimeError("worker build failed")
+        return real(step, mesh, batch_axes=batch_axes)
+
+    monkeypatch.setattr(it, "device_batch", flaky)
+    for step in range(4):
+        got = stager.get(step)
+        np.testing.assert_array_equal(
+            np.asarray(got["x"]), np.asarray(real(step, mesh1)["x"])
+        )
+    assert stager.stats["failures"] >= 1
+    assert stager.stats["hits"] == 0
+
+
+# ---- lint gate: the per-step sync cannot silently regress ------------------
+
+
+def test_lint_rejects_blocking_fetch_in_hot_loop(tmp_path):
+    """tools/lint.py must reject float()/int()/.item() device syncs
+    inside ElasticTrainer.run unless the line carries the
+    sanctioned-sync marker."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+
+    bad = tmp_path / "elastic.py"
+    bad.write_text(
+        "class ElasticTrainer:\n"
+        "    def run(self, n):\n"
+        "        loss = float(self.metrics['loss'])\n"
+        "        step = int(self.state.step)\n"
+        "        x = self.arr.item()\n"
+        "    def other(self):\n"
+        "        return float(1)\n"  # outside the hot loop: allowed
+    )
+    findings = [msg for _, msg in lint.lint_file(bad)]
+    assert sum("blocking device fetch" in m for m in findings) == 3
+
+    ok = tmp_path / "elastic_ok.py"
+    ok.write_text(
+        "class ElasticTrainer:\n"
+        "    def run(self, n):\n"
+        "        loss = float(self.m['loss'])  # sanctioned-sync\n"
+    )
+    assert [m for _, m in lint.lint_file(ok) if "blocking" in m] == []
+
+    # the REAL hot loop passes its own gate (regression canary)
+    from pathlib import Path
+
+    real = Path("edl_tpu/runtime/elastic.py")
+    assert [m for _, m in lint.lint_file(real) if "blocking" in m] == []

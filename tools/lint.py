@@ -18,6 +18,12 @@ Checks, all stdlib:
   must name a metric declared in ``edl_tpu/telemetry/catalog.py``, and
   the name must be a string LITERAL — free-form/computed names defeat
   the catalog and are rejected outright
+- blocking device fetches in the elastic hot loop: ``float(...)``,
+  ``int(...)`` and ``.item()`` calls inside ``ElasticTrainer.run`` are
+  rejected — the async step pipeline keeps metrics as device futures
+  and syncs only at the sanctioned sync points (the harvest path), so
+  a per-step host<->device round trip cannot silently regress.  A
+  deliberate sync marks its line ``# sanctioned-sync``.
 
 Exit code 1 on any finding — ``ci.sh`` runs this before the tests.
 """
@@ -35,6 +41,17 @@ REEXPORT_FILES = {"__init__.py"}
 
 #: registry handle constructors whose first argument is a metric name
 METRIC_METHODS = {"counter", "gauge", "histogram"}
+
+#: (class, methods) whose bodies form the elastic hot loop: blocking
+#: device fetches are banned there (see _hot_loop_findings)
+HOT_LOOP_CLASS = "ElasticTrainer"
+HOT_LOOP_METHODS = {"run"}
+
+#: line marker that sanctions a deliberate device sync in the hot loop
+SYNC_MARKER = "# sanctioned-sync"
+
+#: builtins whose call on a jax array blocks on device completion
+BLOCKING_CASTS = {"float", "int"}
 
 _CATALOG_CACHE = [False, None]  # [loaded, names-or-None]
 
@@ -98,6 +115,54 @@ def _metric_name_findings(tree: ast.AST, path: Path):
             )
 
 
+def _hot_loop_findings(tree: ast.AST, path: Path, sanctioned: set):
+    """Reject blocking device fetches in the elastic hot loop.  Scoped
+    to ``ElasticTrainer``'s step-loop methods wherever they are
+    defined: ``float()``/``int()``/``.item()`` there forces a
+    host<->device round trip per step — exactly the per-step sync the
+    async pipeline retired.  ``sanctioned`` holds line numbers carrying
+    the SYNC_MARKER comment (deliberate, reviewed syncs)."""
+    if "tests" in path.parts:
+        return
+    for cls in ast.walk(tree):
+        if not (
+            isinstance(cls, ast.ClassDef) and cls.name == HOT_LOOP_CLASS
+        ):
+            continue
+        for fn in cls.body:
+            if not (
+                isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and fn.name in HOT_LOOP_METHODS
+            ):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if node.lineno in sanctioned:
+                    continue
+                f = node.func
+                if (
+                    isinstance(f, ast.Name)
+                    and f.id in BLOCKING_CASTS
+                    and node.args
+                ):
+                    yield node.lineno, (
+                        f"blocking device fetch {f.id}(...) in "
+                        f"{HOT_LOOP_CLASS}.{fn.name}'s hot path — keep "
+                        "metrics as device futures and harvest at a "
+                        "sanctioned sync point (or mark the line "
+                        f"{SYNC_MARKER!r})"
+                    )
+                elif isinstance(f, ast.Attribute) and f.attr == "item":
+                    yield node.lineno, (
+                        f"blocking device fetch .item() in "
+                        f"{HOT_LOOP_CLASS}.{fn.name}'s hot path — keep "
+                        "metrics as device futures and harvest at a "
+                        "sanctioned sync point (or mark the line "
+                        f"{SYNC_MARKER!r})"
+                    )
+
+
 def _used_names(tree: ast.AST) -> set:
     used = set()
     for node in ast.walk(tree):
@@ -144,9 +209,10 @@ def _unused_imports(tree: ast.AST, path: Path):
                     yield node.lineno, f"unused import {name!r}"
 
 
-def _ast_findings(tree: ast.AST, path: Path):
+def _ast_findings(tree: ast.AST, path: Path, sanctioned: set = frozenset()):
     yield from _unused_imports(tree, path)
     yield from _metric_name_findings(tree, path)
+    yield from _hot_loop_findings(tree, path, sanctioned)
     # f-string format specs are themselves JoinedStr nodes with no
     # FormattedValue (f"{x:02d}" nests JoinedStr(['02d'])): exclude
     # them from the no-placeholder check or every formatted f-string
@@ -209,7 +275,10 @@ def lint_file(path: Path):
     noqa = {
         i for i, line in enumerate(lines, 1) if "# noqa" in line
     }
-    for lineno, msg in _ast_findings(tree, path):
+    sanctioned = {
+        i for i, line in enumerate(lines, 1) if SYNC_MARKER in line
+    }
+    for lineno, msg in _ast_findings(tree, path, sanctioned):
         if lineno not in noqa:
             yield lineno, msg
     for lineno, msg in _line_findings(text):

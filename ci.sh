@@ -19,6 +19,23 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 python tools/lint.py
 
+# Stress lane (EDL_STRESS=1): rerun the multipod elastic scale-down
+# tests N times under the tier-1 timeout — the reproducer that hung
+# 2/5 runs on a loaded box before the consensus step bus (data-plane
+# stop-step agreement), now expected green every iteration.  The
+# delayed-poll chaos test rides along: it provokes the exact poll-skew
+# shape deterministically.
+if [ "${EDL_STRESS:-0}" = "1" ]; then
+  N="${EDL_STRESS_N:-5}"
+  for i in $(seq 1 "$N"); do
+    echo "[stress] multipod scale-down iteration $i/$N"
+    timeout -k 10 870 python -m pytest tests/test_multipod.py -x -q \
+      -k "elastic_1_2_1 or delayed_poll" -p no:cacheprovider "$@"
+  done
+  echo "[stress] $N/$N iterations green"
+  exit 0
+fi
+
 # Metrics snapshot artifact: tests/conftest.py's sessionfinish hook
 # writes the process-global telemetry registry's Prometheus exposition
 # (+ the flight-recorder tail) here, so every tier-1 run leaves an

@@ -100,6 +100,13 @@ class Autoscaler:
         self._tick_count = 0
         self._goodput_failed_tick: Dict[str, int] = {}
         self.goodput_retry_ticks = 20
+        #: how long a scale-down waits for the retargeted world to ack
+        #: (= every member, victims included, left the old world at the
+        #: consensus-agreed stop boundary) before deleting victim pods
+        #: — deleting earlier SIGTERMs a victim mid-quiesce and turns
+        #: the clean agreed-boundary teardown into a world-break +
+        #: replay for the survivors
+        self.victim_drain_timeout = 20.0
 
     # -- event intake (ref OnAdd/OnUpdate/OnDel, :158-171) -------------------
     def on_add(self, job: TrainingJob):
@@ -204,12 +211,12 @@ class Autoscaler:
         for v in candidates:
             if diff.get(v.name):
                 targets[v.name] = v.parallelism + diff[v.name]
-        applied = self._actuate(targets, diff)
+        applied, stop_steps = self._actuate(targets, diff)
         # Decisions are journaled AFTER actuation so ``actuated``
         # reports what actually happened (a PUT that gave up under a
         # conflict storm is exactly the case the log exists for).
         decisions = self._record_decisions(
-            candidates, diff, targets, have_pending, applied
+            candidates, diff, targets, have_pending, applied, stop_steps
         )
         plan = ScalePlan(
             targets=targets,
@@ -260,13 +267,18 @@ class Autoscaler:
         return obs
 
     def _record_decisions(
-        self, candidates, diff, targets, have_pending, applied
+        self, candidates, diff, targets, have_pending, applied,
+        stop_steps=None,
     ) -> List[dict]:
         """One structured decision entry per candidate: the dry-run
         trace (current -> proposed), the observed goodput inputs, and
         the reason the tick did or didn't actuate.  ``applied``: the
-        per-job actuation outcome from ``_actuate``.  Appended to the
-        bounded ``decision_log`` and journaled to the flight recorder."""
+        per-job actuation outcome from ``_actuate``; ``stop_steps``:
+        the coordinator-stamped stop step read back after a scale-down
+        retarget (None otherwise) — with the trainers' ``consensus.*``
+        flight events, a scale-down timeline reconstructs from the
+        journal alone.  Appended to the bounded ``decision_log`` and
+        journaled to the flight recorder."""
         decisions = []
         for v in candidates:
             d = diff.get(v.name, 0)
@@ -295,6 +307,7 @@ class Autoscaler:
                 "have_pending": have_pending,
                 "actuated": outcome == "applied",
                 "reason": reason,
+                "stop_step": (stop_steps or {}).get(v.name),
             }
             decisions.append(entry)
             self.decision_log.append(entry)
@@ -304,7 +317,7 @@ class Autoscaler:
 
     def _actuate(
         self, targets: Dict[str, int], diff: Dict[str, int]
-    ) -> Dict[str, str]:
+    ) -> tuple:
         """ref scaleAllJobs (:339-376); the 5-retry conflict loop lives
         in Cluster.update_parallelism.  Beyond the reference: each PUT
         is paired with the coordinator handshake (SURVEY §7.1 row 4) —
@@ -322,6 +335,9 @@ class Autoscaler:
         from edl_tpu.cluster.cluster import ParallelismUpdateError
 
         applied: Dict[str, str] = {}
+        #: job -> the stop_step the coordinator stamped into the
+        #: retargeted plan (scale-downs; read back for the decision log)
+        stop_steps: Dict[str, Optional[int]] = {}
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
@@ -338,7 +354,22 @@ class Autoscaler:
             if scale_down:
                 client = self._retarget(job, parallelism)
                 if client is not None:
-                    self._delete_dropped_members(job, client)
+                    # ONE plan fetch serves both the decision-log stamp
+                    # and the victim choice: the journaled stop_step and
+                    # the deleted pods must come from the SAME plan (a
+                    # rebuild during the quiesce wait would otherwise
+                    # desync them), and the coordinator round-trip isn't
+                    # paid twice.
+                    plan = None
+                    try:
+                        plan = client.plan()
+                    except Exception:
+                        pass  # decision still logs, without the stamp
+                    if plan is not None:
+                        stop_steps[name] = getattr(
+                            plan, "stop_step", None
+                        )
+                    self._delete_dropped_members(job, client, plan=plan)
             try:
                 self.cluster.update_parallelism(job, parallelism)
             except ParallelismUpdateError as e:
@@ -358,7 +389,7 @@ class Autoscaler:
             )
             if not scale_down:
                 self._retarget(job, parallelism)
-        return applied
+        return applied, stop_steps
 
     def _announce_prewarm(self, job: TrainingJob, world: int) -> None:
         """POST the planned next parallelism to the job's coordinator
@@ -395,15 +426,50 @@ class Autoscaler:
             )
             return None
 
-    def _delete_dropped_members(self, job: TrainingJob, client) -> List[str]:
+    def _wait_for_quiesce(self, client) -> None:
+        """Bounded wait for the retargeted world to re-form — the
+        consensus stop agreement's actuation-side half: until every
+        surviving member acks the new generation, the victims may
+        still be stepping toward the agreed stop boundary, and a
+        SIGTERM (pod deletion) mid-quiesce yanks them out of a live
+        world — exactly the teardown race the step bus closes.  Best
+        effort: coordinators without the signal (test doubles,
+        pre-consensus versions) and worlds with no live trainers
+        (``acked_members`` 0 — control-plane-only tests) skip the
+        wait, and a timeout proceeds to deletion (the broken-world
+        machinery still recovers, it just pays a replay)."""
+        import time
+
+        deadline = time.monotonic() + self.victim_drain_timeout
+        while time.monotonic() < deadline:
+            try:
+                m = client.metrics()
+            except Exception:
+                return
+            if not isinstance(m, dict) or "world_acked" not in m:
+                return  # pre-consensus coordinator: nothing to wait on
+            if m.get("world_acked") or not m.get("acked_members"):
+                return
+            time.sleep(0.5)
+
+    def _delete_dropped_members(
+        self, job: TrainingJob, client, plan=None
+    ) -> List[str]:
         """Delete the pods whose member ids are registered but no
         longer in the plan's rank order (the scale-down victims the
-        coordinator just chose).  Best effort: a failure here only
-        degrades to the reference's behavior (kube picks the victim)."""
+        coordinator just chose).  Sequenced AFTER the retargeted world
+        quiesces (``_wait_for_quiesce``) so the victims leave the old
+        world at the consensus-agreed stop boundary before their pods
+        are SIGTERMed.  ``plan``: the retargeted plan the caller
+        already fetched (victims and the journaled stop_step must come
+        from the same plan).  Best effort: a failure here only degrades
+        to the reference's behavior (kube picks the victim)."""
         import sys
 
+        self._wait_for_quiesce(client)
         try:
-            plan = client.plan()
+            if plan is None:
+                plan = client.plan()
             members = client.members()
         except Exception as e:
             print(

@@ -50,14 +50,14 @@ class ChaosCoordinator:
         self.restarts += 1
 
     # -- intercepted coordinator surface -------------------------------------
-    def heartbeat(self, trainer_id: str):
+    def heartbeat(self, trainer_id: str, step: int = -1):
         for ev in self.schedule.due("coord.heartbeat.drop"):
             self._drop_budget += int(ev.arg or 1)
         if self._drop_budget > 0:
             self._drop_budget -= 1
             self.dropped_heartbeats += 1
             return  # lost in flight: caller sees success, lease ages
-        result = self._inner.heartbeat(trainer_id)
+        result = self._inner.heartbeat(trainer_id, step=step)
         # Backdate AFTER the beat lands (the beat that arrives is old
         # news: the lease reads "last heard arg seconds ago").
         for ev in self.schedule.due("coord.heartbeat.delay"):

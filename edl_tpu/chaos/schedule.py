@@ -65,6 +65,13 @@ KNOWN_POINTS = (
     "stage.batch.failed",        # stager worker fails one batch (the
                                  # consumer must fall back to staging
                                  # synchronously, not lose the step)
+    # (7) data-plane step agreement (edl_tpu.consensus + elastic)
+    "consensus.vote.delayed",    # member's plan poll suppressed arg s
+                                 # at a retarget (the poll-skew race the
+                                 # step bus exists to make harmless)
+    "consensus.watchdog.trip",   # next guarded device fetch treated as
+                                 # a wedged collective (deadline expiry
+                                 # without the wait)
 )
 
 

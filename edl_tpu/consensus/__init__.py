@@ -1,0 +1,50 @@
+"""Data-plane step agreement: the protocol behind deadlock-free
+scale-down.
+
+EDL's contract is that scaling can hit the job at any time, but until
+this subsystem members quiesced by *polling* the coordinator plan: at a
+retarget one member could observe the new plan a step boundary before
+its peer and stand down while the peer's already-dispatched collective
+waited for it forever (the measured 2/5 hang of
+``test_multipod_elastic_1_2_1`` — shutdown barrier vs gloo allreduce,
+neither with a timeout).  Varuna solves exactly this with a "morph"
+signal agreed over the data plane so every worker leaves at the same
+step, and Bamboo shows preemption-tolerant training needs an in-band
+agreement path plus a watchdog rather than trusting the control
+plane's timing (PAPERS.md).
+
+Three pieces:
+
+- ``StepBus``: a tiny int32 control word allgathered over the SAME
+  ``jax.distributed`` world as the model step — every member learns at
+  the same step boundary that a resize is wanted, and all agree on
+  ``stop_step = vote_step + agreement_horizon`` (horizon =
+  ``pipeline_depth + 1``, so the async pipeline keeps its zero per-step
+  host syncs: the word is a device future harvested with the existing
+  lag, and run-ahead dispatch is clamped at the agreed stop step).
+- ``CollectiveWatchdog``: a deadline on in-flight step/control futures
+  so a wedged gloo allreduce (no native timeout) is detected and buried
+  via the shared broken-world recovery path instead of hanging the
+  world.
+- Straggler telemetry: the word's timing lane gives per-member
+  step-skew without any extra traffic.
+"""
+
+from edl_tpu.consensus.bus import (
+    BUS_LANES,
+    BusPoisonError,
+    BusWord,
+    StepBus,
+    timing_bucket,
+)
+from edl_tpu.consensus.watchdog import CollectiveTimeout, CollectiveWatchdog
+
+__all__ = [
+    "BUS_LANES",
+    "BusPoisonError",
+    "BusWord",
+    "StepBus",
+    "timing_bucket",
+    "CollectiveTimeout",
+    "CollectiveWatchdog",
+]

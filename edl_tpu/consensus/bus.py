@@ -1,0 +1,278 @@
+"""The step bus: a per-step int32 control word folded into the
+training world's collectives.
+
+Each step, every member contributes one 4-lane int32 word; the words
+are allgathered over the SAME mesh (and therefore the same
+``jax.distributed`` process group / gloo transport) as the train step,
+so the bus inherits the data plane's synchronization for free: a
+member cannot fall a step boundary behind the bus without falling
+behind the model collectives too, and a wedged peer wedges the bus
+exactly where the watchdog is looking.
+
+Lanes:
+
+- ``LANE_GENERATION``: highest coordinator plan generation this member
+  has SEEN (polled, or learned from a peer via this very lane) — a
+  member whose plan poll is delayed still learns a resize is wanted at
+  the same step boundary as everyone else.
+- ``LANE_STOP``: stop vote / agreement echo.  A member that observed a
+  retarget proposes ``dispatch_step + agreement_horizon``; the FIRST
+  harvested word with a nonzero stop lane defines the agreement (its
+  max), which is >= every member's dispatch frontier + 1 by
+  construction (horizon = pipeline_depth + 1), so nobody has run ahead
+  of the boundary when it is learned.
+- ``LANE_HEALTH``: poison bit — a member that knows it is failing
+  (corrupt store, tripped watchdog) marks the word so peers bury the
+  world proactively instead of discovering the failure as a hang.
+- ``LANE_TIMING``: log2 bucket of the member's last step seconds — the
+  free per-member straggler signal.
+
+The gather is one tiny jit (input sharded one row per device, output
+replicated); it is AOT-warmable from abstract shapes exactly like the
+train step (``warm``), so a warm resize still performs ZERO XLA
+compiles with the bus on.  The gathered word is a device future: the
+elastic runtime harvests it with the same lag as the step metrics, so
+the bus adds no per-step host<->device sync.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+#: word width; see the lane docs above
+BUS_LANES = 4
+LANE_GENERATION = 0
+LANE_STOP = 1
+LANE_HEALTH = 2
+LANE_TIMING = 3
+
+#: timing-lane quantization: bucket 0 is <= BUCKET0_SECONDS, each
+#: bucket doubles; MAX_BUCKET caps pathological stalls
+BUCKET0_SECONDS = 0.001
+MAX_BUCKET = 31
+
+#: buckets of spread between the slowest and fastest member before the
+#: slowest is counted as a straggler (4 buckets = ~16x the fastest)
+STRAGGLER_SPREAD_BUCKETS = 4
+
+
+def timing_bucket(seconds: float) -> int:
+    """Quantize a step duration into the word's log2 timing lane."""
+    if seconds <= BUCKET0_SECONDS:
+        return 0
+    return min(MAX_BUCKET, int(math.log2(seconds / BUCKET0_SECONDS)) + 1)
+
+
+class BusPoisonError(RuntimeError):
+    """A peer marked the word's health lane: some member of this world
+    knows it is failing.  Raised at harvest so the shared broken-world
+    recovery path (``_absorb_step_failure``) buries the world before
+    the failure surfaces as an untimed hang."""
+
+
+@dataclass
+class BusWord:
+    """One decoded (harvested) control word."""
+
+    step: int
+    max_generation: int
+    #: 0 = no stop voted/agreed in this word
+    stop_step: int
+    poisoned: bool
+    #: process rank -> timing bucket (max over the rank's devices)
+    member_buckets: Dict[int, int]
+    #: bucket spread between slowest and fastest member
+    skew: int
+    #: rank of the slowest member when it qualifies as a straggler
+    straggler: Optional[int] = None
+
+
+@dataclass
+class _Binding:
+    """Per-mesh dispatch state: sharding, row ownership, executables."""
+
+    mesh: Any
+    in_sharding: Any
+    n_rows: int
+    row_owner: tuple
+    jitted: Any
+    compiled: Any = None
+
+
+class StepBus:
+    """Dispatch/decode the control word over a mesh.
+
+    Bindings are cached per mesh (the elastic runtime returns to
+    previously-seen world sizes constantly); ``clear()`` drops them
+    when the device objects die (multipod world re-formation)."""
+
+    def __init__(self, registry=None, recorder=None):
+        from edl_tpu import telemetry
+
+        self.registry = registry if registry is not None else telemetry.get_registry()
+        self.recorder = recorder if recorder is not None else telemetry.get_recorder()
+        self._m_words = self.registry.counter("edl_consensus_words_total")
+        self._m_votes = self.registry.counter("edl_consensus_votes_total")
+        self._g_stop = self.registry.gauge("edl_consensus_stop_step")
+        self._g_skew = self.registry.gauge("edl_consensus_step_skew_buckets")
+        self._m_stragglers = self.registry.counter(
+            "edl_consensus_stragglers_total"
+        )
+        #: guards the binding cache against the background AOT prewarm
+        #: threads racing the step loop (a _Binding keeps a strong ref
+        #: to its mesh, so the id() key cannot be recycled while the
+        #: binding lives)
+        self._lock = threading.Lock()
+        self._bindings: Dict[int, _Binding] = {}
+        self._last_straggler: Optional[int] = None
+
+    # -- binding -------------------------------------------------------------
+    def bind(self, mesh) -> _Binding:
+        with self._lock:
+            b = self._bindings.get(id(mesh))
+        if b is not None:
+            return b
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        flat = list(mesh.devices.flatten())
+        axes = tuple(mesh.axis_names)
+        lead = axes if len(axes) > 1 else axes[0]
+        in_sharding = NamedSharding(mesh, P(lead, None))
+        out_sharding = NamedSharding(mesh, P())
+        # Identity with a sharded->replicated reshard: XLA lowers it to
+        # the world's allgather — the one collective the bus needs.
+        jitted = jax.jit(lambda w: w, out_shardings=out_sharding)
+        b = _Binding(
+            mesh=mesh,
+            in_sharding=in_sharding,
+            n_rows=len(flat),
+            row_owner=tuple(
+                int(getattr(d, "process_index", 0)) for d in flat
+            ),
+            jitted=jitted,
+        )
+        with self._lock:
+            return self._bindings.setdefault(id(mesh), b)
+
+    def warm(self, mesh) -> bool:
+        """AOT-compile the gather for ``mesh`` from abstract shapes
+        (zero device allocation) and HOLD the executable — on this jax
+        ``.lower().compile()`` does not warm the jit dispatch cache, so
+        holding it is what keeps a warm resize at zero compiles (the
+        same contract as ``Trainer.warm_step``)."""
+        import jax
+
+        b = self.bind(mesh)
+        if b.compiled is not None:
+            return False
+        abstract = jax.ShapeDtypeStruct(
+            (b.n_rows, BUS_LANES), np.int32, sharding=b.in_sharding
+        )
+        with mesh:
+            b.compiled = b.jitted.lower(abstract).compile()
+        return True
+
+    def clear(self) -> None:
+        """Drop every mesh binding (the device objects are dying —
+        multipod world teardown)."""
+        with self._lock:
+            self._bindings.clear()
+        self._last_straggler = None
+
+    # -- dispatch ------------------------------------------------------------
+    def dispatch(
+        self,
+        mesh,
+        step: int,
+        generation: int,
+        stop: int,
+        poison: bool,
+        bucket: int,
+    ):
+        """Place this member's word and dispatch the allgather.
+        Returns the gathered word as a DEVICE FUTURE — no host sync;
+        the caller harvests it with the step-metrics lag."""
+        import jax
+
+        b = self.bind(mesh)
+        row = np.array(
+            [[generation, stop, 1 if poison else 0, bucket]], np.int32
+        )
+        arr = jax.make_array_from_callback(
+            (b.n_rows, BUS_LANES), b.in_sharding, lambda idx: row
+        )
+        with mesh:
+            if b.compiled is not None:
+                return b.compiled(arr)
+            return b.jitted(arr)
+
+    # -- decode --------------------------------------------------------------
+    def decode(self, mesh, step: int, mat: np.ndarray) -> BusWord:
+        """Decode a harvested (already host-materialized) word matrix
+        and publish its telemetry.  Deterministic: every member decodes
+        the identical gathered matrix, so agreement needs no further
+        communication."""
+        b = self.bind(mesh)
+        buckets: Dict[int, int] = {}
+        for rank, bk in zip(b.row_owner, mat[:, LANE_TIMING]):
+            buckets[rank] = max(buckets.get(rank, 0), int(bk))
+        skew = (max(buckets.values()) - min(buckets.values())) if buckets else 0
+        straggler = None
+        if len(buckets) > 1 and skew >= STRAGGLER_SPREAD_BUCKETS:
+            straggler = max(buckets, key=buckets.get)
+        word = BusWord(
+            step=step,
+            max_generation=int(mat[:, LANE_GENERATION].max()),
+            stop_step=int(mat[:, LANE_STOP].max()),
+            poisoned=bool(mat[:, LANE_HEALTH].max() > 0),
+            member_buckets=buckets,
+            skew=skew,
+            straggler=straggler,
+        )
+        self._m_words.inc()
+        self._g_skew.set(skew)
+        if straggler is not None:
+            self._m_stragglers.inc(rank=str(straggler))
+            if straggler != self._last_straggler:
+                # Journal transitions only: a persistent straggler must
+                # not flood the flight-recorder ring once per step.
+                self.recorder.record(
+                    "consensus.straggler",
+                    {
+                        "rank": straggler,
+                        "skew_buckets": skew,
+                        "buckets": {
+                            str(r): v for r, v in sorted(buckets.items())
+                        },
+                    },
+                    step=step,
+                )
+        self._last_straggler = straggler
+        return word
+
+    # -- agreement accounting ------------------------------------------------
+    def note_vote(self, step: int, generation: int, proposed_stop: int) -> None:
+        self._m_votes.inc()
+        self.recorder.record(
+            "consensus.vote",
+            {"proposed_stop": proposed_stop, "for_generation": generation},
+            step=step,
+        )
+
+    def note_stop(self, vote_step: int, stop_step: int, generation: int) -> None:
+        self._g_stop.set(stop_step)
+        self.recorder.record(
+            "consensus.stop",
+            {
+                "vote_step": vote_step,
+                "stop_step": stop_step,
+                "for_generation": generation,
+            },
+            step=vote_step,
+        )

@@ -64,6 +64,21 @@ def env_config() -> dict:
         # stamped event (resizes, retries, chaos, saves, transfers)
         # survives the pod for post-mortems
         "flight_recorder_file": e.get("EDL_FLIGHT_RECORDER_FILE", ""),
+        # deterministic fault schedule for THIS pod, as JSON
+        # ({"seed": 0, "events": [{"step": 0, "point": "...", "arg":
+        # ...}]}) — how subprocess-worker tests inject per-member chaos
+        # (e.g. the delayed-plan-poll scale-down reproducer)
+        "chaos_spec": e.get("EDL_CHAOS_SPEC", ""),
+        # collective-watchdog deadline override in seconds ("" = auto:
+        # 120s on multipod worlds, disabled single-process)
+        "collective_timeout": (
+            float(e["EDL_COLLECTIVE_TIMEOUT"])
+            if e.get("EDL_COLLECTIVE_TIMEOUT")
+            else None
+        ),
+        # per-step consensus control word (EDL_CONSENSUS=0 disables —
+        # diagnostic escape hatch only: scale-downs then race again)
+        "consensus": e.get("EDL_CONSENSUS", "1") != "0",
         # Multi-host slice placement: replica index from the per-replica
         # Job's env; host index from the Indexed Job's completion index
         # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides
@@ -681,16 +696,42 @@ def run(
     )
     data = ShardedDataIterator(dataset, global_batch_size=gbs, seed=seed)
 
+    # Per-pod deterministic chaos (EDL_CHAOS_SPEC): the schedule rides
+    # the checkpoint store's chaos seam — the same plumbing the
+    # in-process soaks use — so subprocess-worker tests can chaos one
+    # member of a real multi-pod world (delayed plan polls, watchdog
+    # trips) without monkeypatching across a process boundary.
+    chaos_sched = None
+    if cfg["chaos_spec"]:
+        import json as _json
+
+        from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+
+        spec = _json.loads(cfg["chaos_spec"])
+        chaos_sched = FaultSchedule(
+            seed=int(spec.get("seed", 0)),
+            events=[
+                FaultEvent(
+                    step=int(ev["step"]),
+                    point=ev["point"],
+                    arg=ev.get("arg"),
+                )
+                for ev in spec.get("events", ())
+            ],
+        )
+
     spill_dir = checkpoint_dir or cfg["checkpoint_dir"]
     store = None
-    if spill_dir:
+    if spill_dir or chaos_sched is not None:
         from edl_tpu.checkpoint import HostDRAMStore
 
         # Durable checkpoints: every DRAM checkpoint also spills to the
         # mounted volume, and ElasticTrainer's restore paths fall back
         # to it on a cold start (whole-world loss) — see
         # elastic._latest_or_disk.
-        store = HostDRAMStore(spill_dir=spill_dir)
+        store = HostDRAMStore(
+            spill_dir=spill_dir or None, chaos=chaos_sched
+        )
 
     et = ElasticTrainer(
         model_factory if layout else model,
@@ -708,6 +749,8 @@ def run(
         layout=layout,
     )
     et.pipeline_depth = cfg["pipeline_depth"]
+    et.consensus_bus = cfg["consensus"]
+    et.collective_timeout = cfg["collective_timeout"]
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
     et.register_replica = cfg["replica"]
@@ -811,6 +854,16 @@ def run(
                 )
                 + "\n"
             )
+
+    if chaos_sched is not None:
+        # The env-installed schedule has no soak driver: its clock
+        # rides the harvested step stream (advance is monotonic).
+        _inner_on_step = on_step
+
+        def on_step(rec):
+            chaos_sched.advance(rec.step)
+            if _inner_on_step is not None:
+                _inner_on_step(rec)
 
     try:
         if steps is None:

@@ -37,6 +37,7 @@ def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
         "addresses": list(plan.addresses),
         "alive": list(plan.alive),
         "prewarm": plan.prewarm,
+        "stop_step": plan.stop_step,
     }
 
 
@@ -51,6 +52,7 @@ def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
         addresses=tuple(d.get("addresses", ())),
         alive=tuple(d.get("alive", ())),
         prewarm=int(d.get("prewarm", 0)),
+        stop_step=int(d.get("stop_step", -1)),
     )
 
 
@@ -132,7 +134,9 @@ class CoordinatorServer:
                         coord.deregister(req["trainer_id"])
                         self._reply({"ok": True})
                     elif self.path == "/heartbeat":
-                        coord.heartbeat(req["trainer_id"])
+                        coord.heartbeat(
+                            req["trainer_id"], step=int(req.get("step", -1))
+                        )
                         self._reply({"ok": True})
                     elif self.path == "/ack":
                         coord.ack_generation(req["trainer_id"], req["generation"])
@@ -309,11 +313,11 @@ class HTTPCoordinator:
     def deregister(self, trainer_id: str):
         self._post("/deregister", trainer_id=trainer_id)
 
-    def heartbeat(self, trainer_id: str):
+    def heartbeat(self, trainer_id: str, step: int = -1):
         import urllib.error
 
         try:
-            self._post("/heartbeat", trainer_id=trainer_id)
+            self._post("/heartbeat", trainer_id=trainer_id, step=step)
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 # same contract as LocalCoordinator.heartbeat

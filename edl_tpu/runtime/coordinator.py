@@ -73,6 +73,18 @@ class ElasticPlan:
     #: Never changes the generation — an updated hint must not push
     #: trainers through a resize barrier.
     prewarm: int = 0
+    #: coordinator-stamped stop step for THIS generation's resize: the
+    #: last world step any member reported (heartbeat piggyback /
+    #: checkpoint reports) plus ``stop_margin`` at plan-rebuild time;
+    #: -1 when no step was ever reported.  ADVISORY/JOURNAL ONLY: the
+    #: data-plane agreement is the honored boundary — heartbeat lag
+    #: makes this stamp stale by up to one cadence, and honoring a
+    #: stale stamp below the agreement would re-introduce the
+    #: poll-skew teardown race (min(stamped, agreed) floored at the
+    #: agreement reduces to the agreement exactly).  Its job is making
+    #: the scale-down timeline reconstructible from the journal alone
+    #: (``coord.plan`` events + the autoscaler decision log).
+    stop_step: int = -1
 
 
 @dataclass
@@ -137,6 +149,12 @@ class LocalCoordinator:
         self._hosts_per_replica = hosts_per_replica
         self._clock = clock
         self._latest_checkpoint_step = -1
+        #: last world step any member reported (heartbeat piggyback or
+        #: checkpoint report) — the base of the plan's stop_step stamp
+        self._latest_step = -1
+        #: steps past the last reported step the stamped stop allows
+        #: for in-flight progress (heartbeat-cadence staleness)
+        self.stop_margin = 16
         self._prewarm = 0
         self._plan: Optional[ElasticPlan] = None
         self._resize_log: List[dict] = []
@@ -193,12 +211,17 @@ class LocalCoordinator:
             if self._members.pop(trainer_id, None) is not None:
                 self._rebuild_plan("leave")
 
-    def heartbeat(self, trainer_id: str):
+    def heartbeat(self, trainer_id: str, step: int = -1):
+        """``step``: the member's last completed world step, piggybacked
+        on the beat so retarget plans can stamp a stop_step without an
+        extra round-trip (-1 = not reported)."""
         with self._lock:
             m = self._members.get(trainer_id)
             if m is None:
                 raise KeyError(f"unknown trainer {trainer_id}")
             m.last_heartbeat = self._clock()
+            if step > self._latest_step:
+                self._latest_step = step
 
     def ack_generation(self, trainer_id: str, generation: int):
         """Trainer reports it has re-meshed into ``generation``."""
@@ -275,6 +298,8 @@ class LocalCoordinator:
         with self._lock:
             if step > self._latest_checkpoint_step:
                 self._latest_checkpoint_step = step
+            if step > self._latest_step:
+                self._latest_step = step
             if self._target_steps and step >= self._target_steps:
                 self._completed = True
                 self._completed_step = max(self._completed_step, step)
@@ -311,9 +336,25 @@ class LocalCoordinator:
     def metrics(self) -> dict:
         """Observability snapshot (served at the coordinator's /metrics)."""
         with self._lock:
+            plan = self._plan
+            world_acked = bool(plan) and all(
+                self._members[t].acked_generation >= plan.generation
+                for t in plan.members
+                if t in self._members
+            )
             return {
                 "generation": self._generation,
                 "world_size": self._plan.world_size if self._plan else 0,
+                #: every current-plan member has re-meshed into this
+                #: generation — the scale-down actuation's "victims have
+                #: quiesced" signal (the new world cannot form until the
+                #: old one fully left the agreed stop boundary)
+                "world_acked": world_acked,
+                "acked_members": sum(
+                    1
+                    for m in self._members.values()
+                    if m.acked_generation >= 0
+                ),
                 "members": len(self._members),
                 "standby": max(
                     0,
@@ -487,6 +528,11 @@ class LocalCoordinator:
             self._lock.notify_all()
             return
         self._generation += 1
+        stop_step = (
+            self._latest_step + self.stop_margin
+            if self._latest_step >= 0
+            else -1
+        )
         self._plan = ElasticPlan(
             generation=self._generation,
             world_size=world,
@@ -495,6 +541,7 @@ class LocalCoordinator:
             addresses=addresses,
             alive=tuple(self._members),
             prewarm=self._prewarm,
+            stop_step=stop_step,
         )
         self._resize_log.append(
             {
@@ -511,6 +558,7 @@ class LocalCoordinator:
                 "reason": reason,
                 "world_size": world,
                 "members": list(active),
+                "stop_step": stop_step,
             },
             generation=self._generation,
         )

@@ -63,6 +63,12 @@ import optax
 
 from edl_tpu.checkpoint import HostDRAMStore
 from edl_tpu.checkpoint.hostdram import HostCheckpoint
+from edl_tpu.consensus import (
+    BusPoisonError,
+    CollectiveWatchdog,
+    StepBus,
+    timing_bucket,
+)
 from edl_tpu.models.base import ModelDef
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.coordinator import ElasticPlan, LocalCoordinator
@@ -105,6 +111,11 @@ class ResizeEvent:
     #: bytes this member sent/received and the leaves it skipped
     #: because its local bytes already matched the source
     transfer: Optional[Dict[str, Any]] = None
+    #: the stop step this resize honored: the data-plane-agreed boundary
+    #: every member left the old world at; -1 when the resize was
+    #: immediate (no live multi-member world to agree with — the
+    #: coordinator's advisory stamp lives in its own journal)
+    stop_step: int = -1
 
 
 @dataclass
@@ -134,6 +145,9 @@ class _InFlightStep:
     world_size: int
     t_dispatch: float
     metrics: Dict[str, Any] = field(repr=False, default=None)
+    #: the step's gathered control word (edl_tpu.consensus.StepBus) —
+    #: a device future harvested with the same lag as the metrics
+    bus_word: Any = field(repr=False, default=None)
 
 
 class ElasticTrainer:
@@ -373,6 +387,49 @@ class ElasticTrainer:
 
         self._telemetry_boot = _uuid.uuid4().hex[:12]
 
+        # -- data-plane step agreement (edl_tpu.consensus) ------------------
+        #: dispatch the per-step int32 control word (the "step bus") on
+        #: multi-member worlds — generation/stop/health/timing lanes
+        #: allgathered over the SAME collectives as the model step
+        self.consensus_bus: bool = True
+        #: defer teardown at a retarget to the bus-agreed stop step
+        #: (``stop_step = vote_step + pipeline_depth + 1``) so every
+        #: member leaves the old world at the SAME step boundary.  None
+        #: = auto: engaged under a world_builder (multipod — the only
+        #: place the poll-skew teardown race can deadlock a gloo
+        #: collective against a shutdown barrier); local single-process
+        #: worlds resize immediately as before.  NOTE the horizon is
+        #: derived from ``pipeline_depth``, which must agree across
+        #: members (same deployment env) — like every other world-wide
+        #: config knob.
+        self.consensus_stop: Optional[bool] = None
+        #: collective-watchdog deadline on harvest-time device fetches
+        #: (a wedged gloo allreduce has no native timeout); None = auto:
+        #: 120s under a world_builder, disabled single-process
+        self.collective_timeout: Optional[float] = None
+        self._bus = StepBus(registry=self.telemetry, recorder=self.recorder)
+        self._watchdog: Optional[CollectiveWatchdog] = None
+        self._m_quiesce = self.telemetry.histogram(
+            "edl_consensus_quiesce_seconds"
+        )
+        #: stop-agreement state, reset at resize/standby/world-break
+        self._stop_gen = 0  # generation the pending quiesce is for
+        self._stop_agreed: Optional[int] = None
+        self._vote_cast_gen = 0
+        #: highest plan generation learned from a PEER via the word's
+        #: generation lane (a delayed poll still clamps run-ahead)
+        self._bus_seen_gen = 0
+        #: set to mark this member's outgoing words poisoned (peers
+        #: bury the world instead of discovering the failure as a hang)
+        self._bus_poison = False
+        self._last_step_bucket = 0
+        self._quiesce_t0: Optional[float] = None
+        self._quiesce_deadline: Optional[float] = None
+        self._quiesce_recorded = False
+        #: chaos[consensus.vote.delayed]: plan polls suppressed until
+        #: this monotonic deadline (simulated poll skew)
+        self._poll_suppress_until = 0.0
+
     # -- trainer cache ------------------------------------------------------
     def _mesh_spec(self, total_devices: int) -> MeshSpec:
         """dp x <layout> mesh shape for a world spanning
@@ -428,6 +485,11 @@ class ElasticTrainer:
         stager = getattr(self, "_stager", None)
         if stager is not None:
             stager.invalidate(join=True)
+        # The step bus's per-mesh bindings hold executables over the
+        # same dying device objects; drop them with the trainers.
+        bus = getattr(self, "_bus", None)
+        if bus is not None:
+            bus.clear()
         with self._trainer_lock:
             self._trainers.clear()
             self._failed_prewarms.clear()
@@ -448,6 +510,14 @@ class ElasticTrainer:
         warm_leaf_conversions(
             jax.tree_util.tree_leaves(tr.abstract_state())
         )
+        # The step bus's gather compiles per mesh too: warming it here
+        # keeps "a warm resize performs zero XLA compiles" true with
+        # the consensus lane on (its first dispatch is otherwise inside
+        # the first post-resize step's measured window).
+        if self.consensus_bus and (
+            tr.mesh.devices.size // max(1, self.devices_per_trainer) > 1
+        ):
+            self._bus.warm(tr.mesh)
         return warmed
 
     def precompile(self, world_sizes: Sequence[int]):
@@ -553,6 +623,7 @@ class ElasticTrainer:
         dispatched."""
         self.state = None
         self._pending.clear()
+        self._reset_stop_state()
         if self._stager is not None:
             self._stager.invalidate()
 
@@ -667,7 +738,12 @@ class ElasticTrainer:
 
     def _enter_standby(self, plan: ElasticPlan) -> None:
         """This process is not in ``plan``'s world: flush what we have,
-        tear down our slice of the old world, hold until readmitted."""
+        tear down our slice of the old world, hold until readmitted.
+        When a stop agreement ran (scale-down victims quiesce at the
+        agreed boundary like every other member), its latency is
+        journaled on the way out."""
+        self._finish_quiesce()
+        self._reset_stop_state()
         if self.state is not None and self._can_flush(plan):
             try:
                 self._flush(plan.generation)
@@ -755,6 +831,19 @@ class ElasticTrainer:
             now = time.perf_counter()
             phases[name] = round(now - since, 6)
             return now
+
+        # The boundary this resize honored: the data-plane agreement
+        # when one ran; -1 for an immediate resize (no live
+        # multi-member world to agree with — the coordinator's
+        # advisory stamp stays in ITS journal, not here: recording it
+        # as "honored" would fabricate a boundary that never existed).
+        stop_step = self._effective_stop()
+        if stop_step is None:
+            stop_step = -1
+        # Quiesce ends HERE (drained, about to leave the old world):
+        # the latency histogram measures retarget->quiesce, not the
+        # whole resize window.
+        self._finish_quiesce()
 
         graceful = self.state is not None and self._can_flush(plan)
 
@@ -945,6 +1034,7 @@ class ElasticTrainer:
             restore_source=restore_source,
             phase_seconds=phases,
             transfer=transfer_stats,
+            stop_step=stop_step,
         )
         self.resize_events.append(event)
         # Telemetry: counters/histograms for the merged cluster view,
@@ -970,6 +1060,7 @@ class ElasticTrainer:
                 "replayed_steps": replayed,
                 "graceful": graceful,
                 "restore_source": restore_source,
+                "stop_step": stop_step,
             },
             step=self._last_completed_step,
             generation=plan.generation,
@@ -982,6 +1073,7 @@ class ElasticTrainer:
         # barrier before the world actually re-formed (ADVICE r1).
         for tid in self._my_member_ids(plan):
             self.coordinator.ack_generation(tid, plan.generation)
+        self._reset_stop_state()
         return True
 
     def _latest_or_disk(self, trainer: Trainer) -> Optional[HostCheckpoint]:
@@ -1177,7 +1269,16 @@ class ElasticTrainer:
             return
         for tid in list(self.heartbeat_ids):
             try:
-                self.coordinator.heartbeat(tid)
+                try:
+                    # Piggyback the last completed step: retarget plans
+                    # stamp stop_step from it (no extra round-trip).
+                    self.coordinator.heartbeat(
+                        tid, step=self._last_completed_step
+                    )
+                except TypeError:
+                    # Pre-consensus coordinator / test double without
+                    # the step kwarg: the beat itself must still land.
+                    self.coordinator.heartbeat(tid)
             except KeyError:
                 if self._leaving:
                     return  # deregistered on purpose; do not resurrect
@@ -1305,6 +1406,11 @@ class ElasticTrainer:
         pending = getattr(self, "_pending", None)
         if pending is not None:
             pending.clear()
+        if getattr(self, "_bus", None) is not None:
+            # A broken world voids any in-flight stop agreement (the
+            # peers it was made with are gone); the fresh generation's
+            # retarget re-agrees from scratch.
+            self._reset_stop_state()
         self.state = None
         self._world_members = ()
         self._clear_trainers()
@@ -1331,6 +1437,162 @@ class ElasticTrainer:
             self._hb_stop.set()
         if self._hb_thread is not None and self._hb_thread.is_alive():
             self._hb_thread.join(timeout=10)
+
+    # -- data-plane step agreement (edl_tpu.consensus) ----------------------
+    def _agreement_horizon(self) -> int:
+        """Steps between a stop vote and the agreed boundary.  depth+1
+        guarantees the boundary is past EVERY member's run-ahead
+        frontier when the agreement is learned: word k is harvested no
+        later than after dispatching step k+depth, so stop = k+depth+1
+        is always >= frontier+1 — nobody has dispatched a collective
+        the others will not join."""
+        return max(0, self.pipeline_depth) + 1
+
+    def _bus_active(self) -> bool:
+        return (
+            self.consensus_bus
+            and self.mesh is not None
+            and self._world_size() > 1
+        )
+
+    def _consensus_stop_active(self) -> bool:
+        """Whether a retarget must quiesce at the bus-agreed boundary
+        instead of tearing down on sight of the new plan."""
+        if self.state is None or not self._bus_active():
+            return False
+        on = self.consensus_stop
+        if on is None:
+            on = self.world_builder is not None
+        return bool(on)
+
+    def _watchdog_fetch(self, fn, what: str = "step metrics"):
+        """Harvest-time device fetch under the collective watchdog's
+        deadline (lazy-built: the chaos schedule and timeout knobs are
+        attached after construction)."""
+        wd = self._watchdog
+        if wd is None:
+            timeout = self.collective_timeout
+            if timeout is None:
+                timeout = 120.0 if self.world_builder is not None else 0.0
+            wd = CollectiveWatchdog(
+                timeout=timeout,
+                chaos=getattr(self.store, "chaos", None),
+                registry=self.telemetry,
+                recorder=self.recorder,
+            )
+            self._watchdog = wd
+        return wd.fetch(fn, what=what)
+
+    def _dispatch_bus_word(self, step: int):
+        """This step's outgoing control word (a device future, no host
+        sync).  The stop lane carries this member's vote (first step
+        after it observed a retarget) or echoes the agreement."""
+        if not self._bus_active():
+            return None
+        gen_seen = max(self.generation, self._stop_gen, self._bus_seen_gen)
+        stop = 0
+        if self._stop_agreed is not None:
+            stop = self._stop_agreed
+        elif self._stop_gen > self.generation:
+            stop = step + self._agreement_horizon()
+            if self._vote_cast_gen != self._stop_gen:
+                self._vote_cast_gen = self._stop_gen
+                self._bus.note_vote(step, self._stop_gen, stop)
+        return self._bus.dispatch(
+            self.mesh,
+            step,
+            gen_seen,
+            stop,
+            self._bus_poison,
+            self._last_step_bucket,
+        )
+
+    def _absorb_bus_word(self, rec: _InFlightStep) -> None:
+        """Harvest-time decode of step ``rec.step``'s gathered word.
+        Every member decodes the identical matrix in the same step
+        order, so the agreement needs no further communication."""
+        mat = self._watchdog_fetch(
+            lambda: np.asarray(rec.bus_word), what="control word"
+        )
+        word = self._bus.decode(self.mesh, rec.step, mat)
+        if word.max_generation > max(self.generation, self._bus_seen_gen):
+            # A peer saw a plan generation we have not polled yet: a
+            # resize is wanted — the run-ahead clamp holds even while
+            # our own poll is delayed.
+            self._bus_seen_gen = word.max_generation
+        if word.stop_step and self._stop_agreed is None:
+            # FIRST word with a nonzero stop lane IS the agreement (the
+            # voter proposed vote_step + horizon in it); later words'
+            # larger proposals are ignored by everyone alike.
+            self._stop_agreed = word.stop_step
+            self._stop_gen = max(self._stop_gen, word.max_generation)
+            self._start_quiesce_clock()
+            self._bus.note_stop(rec.step, word.stop_step, self._stop_gen)
+        if word.poisoned:
+            raise BusPoisonError(
+                f"a peer marked step {rec.step}'s control word poisoned "
+                "(member self-reported failure)"
+            )
+
+    def _arm_stop(self, plan: ElasticPlan) -> None:
+        """This member observed a retarget on a live multi-member
+        world: quiesce via the bus instead of tearing down now."""
+        if plan.generation > self._stop_gen:
+            self._stop_gen = plan.generation
+        self._start_quiesce_clock()
+
+    def _effective_stop(self) -> Optional[int]:
+        """The boundary this member quiesces at: the data-plane
+        agreement.  Of min(coordinator-stamped, agreed), a stamp below
+        the agreement is unsafe to honor (the agreement is the
+        EARLIEST step no member has dispatched past — stopping under
+        it re-opens the poll-skew deadlock this subsystem closes) and
+        a stamp above it never shortens the quiesce, so the min-with-
+        floor reduces to the agreement exactly; the stamp's job is the
+        journal (``coord.plan`` events, the autoscaler decision log),
+        not the boundary."""
+        return self._stop_agreed
+
+    def _stop_reached(self) -> bool:
+        stop = self._effective_stop()
+        return stop is not None and self._host_step >= stop
+
+    def _start_quiesce_clock(self) -> None:
+        if self._quiesce_t0 is None:
+            self._quiesce_t0 = time.perf_counter()
+            self._quiesce_deadline = time.monotonic() + self.barrier_timeout
+
+    def _note_quiesced(self) -> None:
+        if self._quiesce_recorded:
+            return
+        self._quiesce_recorded = True
+        self.recorder.record(
+            "consensus.quiesce",
+            {
+                "stop_step": self._effective_stop(),
+                "for_generation": self._stop_gen,
+            },
+            step=self._last_completed_step,
+            generation=self.generation,
+        )
+
+    def _finish_quiesce(self) -> None:
+        """Journal + observe the retarget->quiesce latency (once per
+        agreement); called on the way into resize/standby."""
+        if self._quiesce_t0 is None:
+            return
+        self._note_quiesced()
+        self._m_quiesce.observe(time.perf_counter() - self._quiesce_t0)
+        self._quiesce_t0 = None
+
+    def _reset_stop_state(self) -> None:
+        self._stop_gen = 0
+        self._stop_agreed = None
+        self._vote_cast_gen = 0
+        self._bus_seen_gen = 0
+        self._quiesce_t0 = None
+        self._quiesce_deadline = None
+        self._quiesce_recorded = False
 
     def maybe_resize(self) -> bool:
         self._heartbeat()
@@ -1362,6 +1624,30 @@ class ElasticTrainer:
                 # background while this one keeps stepping.
                 self._maybe_prewarm(plan)
             return False
+        if self._consensus_stop_active():
+            # A retarget hit a LIVE multi-member world: leaving on
+            # sight of the new plan is the poll-skew race (one member
+            # stands down a step boundary before its peer and the
+            # peer's dispatched collective waits forever).  Quiesce via
+            # the step bus instead: vote, agree on
+            # stop_step = vote_step + horizon in-band, and keep
+            # stepping to that exact boundary — every member leaves the
+            # old world at the SAME step.
+            chaos = getattr(self.store, "chaos", None)
+            if chaos is not None:
+                for ev in chaos.due("consensus.vote.delayed"):
+                    # chaos[consensus.vote.delayed]: this member's plan
+                    # poll is suppressed — it must keep stepping
+                    # obliviously, and the stop must still reach it
+                    # in-band (the property the point exists to prove).
+                    self._poll_suppress_until = time.monotonic() + float(
+                        ev.arg or 1.0
+                    )
+            if time.monotonic() < self._poll_suppress_until:
+                return False
+            self._arm_stop(plan)
+            if not self._stop_reached():
+                return False
         if self._pending:
             # Sanctioned sync point: resize-barrier entry.  In-flight
             # steps must harvest BEFORE the barrier tears anything down
@@ -1428,12 +1714,22 @@ class ElasticTrainer:
         rec = self._pending[0]
         t0 = time.perf_counter()
         try:
-            loss = float(rec.metrics["loss"])
+            loss = self._watchdog_fetch(lambda: float(rec.metrics["loss"]))
         except Exception:
             self._harvest_failed_step = rec.step
             self._pending.popleft()
             raise
         self._pending.popleft()
+        if rec.bus_word is not None:
+            try:
+                # Decode the step's control word (same sanctioned sync:
+                # the gather resolves with the step stream).  A
+                # poisoned word or wedged gather is attributed to this
+                # step for the broken-world recovery, like the loss.
+                self._absorb_bus_word(rec)
+            except Exception:
+                self._harvest_failed_step = rec.step
+                raise
         now = time.perf_counter()
         self._m_device_wait.observe(now - t0)
         self.pipeline_stats["device_wait_s"] += now - t0
@@ -1452,6 +1748,9 @@ class ElasticTrainer:
             loss=loss,
             seconds=now - base,
         )
+        # The NEXT outgoing control word carries this step's timing
+        # bucket — the free per-member straggler signal.
+        self._last_step_bucket = timing_bucket(srec.seconds)
         self.history.append(srec)
         # Default-on per-step telemetry: one counter inc, one histogram
         # observe, one context stamp (measured in bench.py's
@@ -1617,6 +1916,30 @@ class ElasticTrainer:
                     raise RuntimeError(
                         "no plan with world_size >= 1 available"
                     )
+                if self._stop_agreed is not None and self._stop_reached():
+                    # Quiesced at the data-plane-agreed stop boundary:
+                    # run-ahead is clamped HERE — no member dispatches a
+                    # collective past the step every member agreed to
+                    # leave at.  Park (drained) until the new plan is
+                    # visible to this member too (maybe_resize completes
+                    # the resize/standby from the top of the loop); a
+                    # chaos-delayed poll sits in this state until the
+                    # suppression expires.
+                    if not self._drain_guarded():
+                        continue
+                    self._note_quiesced()
+                    if (
+                        self._quiesce_deadline is not None
+                        and time.monotonic() > self._quiesce_deadline
+                    ):
+                        self._leak_dead_world()
+                        raise RuntimeError(
+                            "quiesced at agreed stop step "
+                            f"{self._effective_stop()} but no actionable "
+                            f"plan arrived within {self.barrier_timeout}s"
+                        )
+                    time.sleep(self.barrier_poll_interval)
+                    continue
                 step = None  # the step this iteration attempts
                 try:
                     # The whole body is guarded: an async collective
@@ -1648,6 +1971,10 @@ class ElasticTrainer:
                             world_size=self._world_size(),
                             t_dispatch=t0,
                             metrics=metrics,
+                            # The step's control word rides the same
+                            # world as the step itself (a device
+                            # future, harvested with the same lag).
+                            bus_word=self._dispatch_bus_word(step),
                         )
                     )
                     if self.profiler.tracing:

@@ -240,6 +240,49 @@ CATALOG = {
         "help": "Step at which completion was reported (-1 = none).",
         "labels": (),
     },
+    # -- consensus: data-plane step agreement (edl_tpu.consensus) ------------
+    "edl_consensus_words_total": {
+        "type": "counter",
+        "help": "Step-bus control words harvested (one per train step "
+        "on multi-member worlds).",
+        "labels": (),
+    },
+    "edl_consensus_votes_total": {
+        "type": "counter",
+        "help": "Stop votes this member cast on the step bus (one per "
+        "observed retarget).",
+        "labels": (),
+    },
+    "edl_consensus_stop_step": {
+        "type": "gauge",
+        "help": "Last data-plane-agreed stop step (the boundary every "
+        "member leaves the old world at).",
+        "labels": (),
+    },
+    "edl_consensus_step_skew_buckets": {
+        "type": "gauge",
+        "help": "Timing-lane bucket spread between the slowest and "
+        "fastest member in the last harvested word (log2 buckets).",
+        "labels": (),
+    },
+    "edl_consensus_stragglers_total": {
+        "type": "counter",
+        "help": "Words where one member's timing bucket exceeded the "
+        "fastest by the straggler spread, by process rank.",
+        "labels": ("rank",),
+    },
+    "edl_consensus_watchdog_trips_total": {
+        "type": "counter",
+        "help": "Collective-watchdog deadline expiries (wedged "
+        "step/control futures buried via broken-world recovery).",
+        "labels": (),
+    },
+    "edl_consensus_quiesce_seconds": {
+        "type": "histogram",
+        "help": "Seconds from observing a retarget to quiescing at the "
+        "agreed stop step (drain complete, ready to leave the world).",
+        "labels": (),
+    },
     # -- compile accounting (bench + AOT warmers) ----------------------------
     "edl_xla_compiles_total": {
         "type": "counter",

@@ -1,0 +1,488 @@
+"""Data-plane step agreement (edl_tpu.consensus): the step bus, the
+stop-step protocol, the collective watchdog, and their journal trail.
+
+The multipod half — two real processes, one with a chaos-delayed plan
+poll, leaving the old world at the same step boundary — lives in
+``tests/test_multipod.py`` (it needs real subprocess pods); this file
+covers the protocol and its pieces on the in-process 8-device world.
+"""
+
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.consensus import (
+    CollectiveTimeout,
+    CollectiveWatchdog,
+    StepBus,
+    timing_bucket,
+)
+from edl_tpu.models import get_model
+from edl_tpu.runtime import ShardedDataIterator
+from edl_tpu.runtime.coordinator import LocalCoordinator
+from edl_tpu.runtime.data import synthetic_dataset
+from edl_tpu.runtime.elastic import ElasticTrainer
+
+
+def _world(devices, n=4, gbs=8, ckpt_interval=0, chaos=None, **kw):
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 256, seed=0)
+    it = ShardedDataIterator(ds, global_batch_size=gbs, seed=0)
+    coord = LocalCoordinator(
+        target_world=n, max_world=n, legal_sizes=[1, 2, 4][: n.bit_length()]
+    )
+    for i in range(n):
+        coord.register(f"t{i}")
+    et = ElasticTrainer(
+        model,
+        optax.sgd(0.05),
+        it,
+        coord,
+        devices=devices[:n],
+        checkpoint_interval=ckpt_interval,
+        store=HostDRAMStore(chaos=chaos) if chaos is not None else None,
+        **kw,
+    )
+    return et, coord
+
+
+# ---- the bus itself -------------------------------------------------------
+def test_bus_word_roundtrip(devices8):
+    from edl_tpu.parallel.mesh import dp_mesh
+
+    mesh = dp_mesh(4)
+    with telemetry.scoped() as (reg, rec):
+        bus = StepBus(registry=reg, recorder=rec)
+        out = bus.dispatch(
+            mesh, step=7, generation=3, stop=10, poison=False, bucket=2
+        )
+        word = bus.decode(mesh, 7, np.asarray(out))
+    assert word.step == 7
+    assert word.max_generation == 3
+    assert word.stop_step == 10
+    assert not word.poisoned
+    # single process: every row belongs to rank 0, identical bucket
+    assert word.member_buckets == {0: 2}
+    assert word.skew == 0
+
+
+def test_bus_decode_detects_straggler_and_poison():
+    """Unit-level decode over a crafted gathered matrix: per-member
+    timing buckets, the straggler call, and the poison bit."""
+    from edl_tpu.parallel.mesh import dp_mesh
+
+    mesh = dp_mesh(4)
+    with telemetry.scoped() as (reg, rec):
+        bus = StepBus(registry=reg, recorder=rec)
+        b = bus.bind(mesh)
+        # pretend rows 0/1 belong to rank 0, rows 2/3 to rank 1
+        object.__setattr__(b, "row_owner", (0, 0, 1, 1))
+        mat = np.array(
+            [
+                [5, 0, 0, 1],
+                [5, 0, 0, 1],
+                [5, 0, 1, 9],  # rank 1: poisoned, 8 buckets slower
+                [5, 0, 0, 9],
+            ],
+            np.int32,
+        )
+        word = bus.decode(mesh, 3, mat)
+        assert word.poisoned
+        assert word.member_buckets == {0: 1, 1: 9}
+        assert word.skew == 8
+        assert word.straggler == 1
+        snap = reg.snapshot()
+        assert snap["counters"]["edl_consensus_stragglers_total"]
+        kinds = [e.kind for e in rec.events(10)]
+        assert "consensus.straggler" in kinds
+
+
+def test_bus_warm_makes_dispatch_zero_compile(devices8, monkeypatch):
+    """The warm-resize zero-compile contract extends to the bus: after
+    ``warm(mesh)``, the first dispatch performs no backend compile."""
+    import jax._src.compiler as _compiler
+
+    from edl_tpu.parallel.mesh import dp_mesh
+
+    mesh = dp_mesh(4)
+    with telemetry.scoped() as (reg, rec):
+        bus = StepBus(registry=reg, recorder=rec)
+        bus.warm(mesh)
+        compiles = []
+        real = _compiler.backend_compile
+
+        def counting(*args, **kwargs):
+            compiles.append(1)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(_compiler, "backend_compile", counting)
+        out = bus.dispatch(
+            mesh, step=0, generation=1, stop=0, poison=False, bucket=0
+        )
+        np.asarray(out)
+    assert compiles == [], f"{len(compiles)} compiles after warm"
+
+
+def test_timing_bucket_quantization():
+    assert timing_bucket(0.0005) == 0
+    assert timing_bucket(0.0015) == 1
+    assert timing_bucket(0.1) > timing_bucket(0.01) > timing_bucket(0.002)
+    assert timing_bucket(1e9) == 31
+
+
+# ---- stop agreement on a live local world ---------------------------------
+def test_stop_agreement_quiesces_at_one_boundary(devices8):
+    """A retarget on a live multi-member world must NOT tear down on
+    sight of the plan: the vote rides the step bus and the world leaves
+    at ``stop_step = vote_step + pipeline_depth + 1`` — the old world's
+    step stream runs exactly to the boundary, the new world starts at
+    it, and the agreement is journaled end to end (consensus.vote /
+    consensus.stop / consensus.quiesce + ResizeEvent.stop_step)."""
+    et, coord = _world(devices8, n=4)
+    et.consensus_stop = True  # force the multipod-only default on
+
+    fired = []
+
+    def on_step(rec):
+        if rec.step == 5 and not fired:
+            fired.append(rec.step)
+            coord.heartbeat("t0", step=rec.step)
+            coord.set_target_world(2)
+
+    with telemetry.scoped() as (reg, rec):
+        et.telemetry = reg
+        et.recorder = rec
+        et._bus = StepBus(registry=reg, recorder=rec)
+        hist = et.run(30, on_step=on_step)
+        events = {e.kind: e for e in rec.events(200)}
+
+    ev = et.resize_events[-1]
+    assert ev.world_size == 2
+    stop = ev.stop_step
+    assert stop > 5, f"agreed stop {stop} not after the retarget step"
+    # THE boundary property: every old-world step is < stop, the new
+    # world starts exactly AT stop, nothing is lost or doubled.
+    old = [r.step for r in hist if r.world_size == 4]
+    new = [r.step for r in hist if r.world_size == 2]
+    assert max(old) == stop - 1
+    assert min(new) == stop
+    assert sorted(old + new) == list(range(30))
+    # journal trail
+    assert events["consensus.vote"].data["for_generation"] == ev.generation
+    assert events["consensus.stop"].data["stop_step"] == stop
+    assert events["consensus.quiesce"].data["stop_step"] == stop
+    vote_step = events["consensus.stop"].data["vote_step"]
+    assert stop == vote_step + et.pipeline_depth + 1
+
+
+def test_stop_agreement_synchronous_pipeline(devices8):
+    """Depth 0 (the synchronous loop): horizon collapses to 1 — the
+    world leaves one step after the vote, still as one boundary."""
+    et, coord = _world(devices8, n=2, gbs=8)
+    et.consensus_stop = True
+    et.pipeline_depth = 0
+
+    def on_step(rec):
+        if rec.step == 4:
+            coord.set_target_world(1)
+
+    hist = et.run(12, on_step=on_step)
+    ev = et.resize_events[-1]
+    assert ev.world_size == 1
+    old = [r.step for r in hist if r.world_size == 2]
+    assert max(old) == ev.stop_step - 1
+    assert sorted(r.step for r in hist) == list(range(12))
+
+
+def test_consensus_losses_bit_identical_bus_on_off(devices8):
+    """The control word rides beside the model step: the loss stream
+    must be BIT-identical with the bus on or off (no resize)."""
+
+    def run(bus_on):
+        et, _ = _world(devices8, n=4)
+        et.consensus_bus = bus_on
+        return [r.loss for r in et.run(12)]
+
+    assert run(True) == run(False)
+
+
+def test_plan_stamps_stop_step_from_heartbeat():
+    coord = LocalCoordinator(target_world=2, max_world=2)
+    coord.register("a")
+    coord.register("b")
+    assert coord.plan().stop_step == -1  # nothing reported yet
+    coord.heartbeat("a", step=40)
+    coord.set_target_world(1)
+    plan = coord.plan()
+    assert plan.stop_step == 40 + coord.stop_margin
+    # checkpoint reports feed the stamp too (retarget forces a rebuild)
+    coord.report_checkpoint(90)
+    coord.set_target_world(2)
+    assert coord.plan().stop_step == 90 + coord.stop_margin
+
+
+def test_plan_stop_step_over_http():
+    from edl_tpu.runtime.coord_service import (
+        CoordinatorServer,
+        HTTPCoordinator,
+    )
+
+    coord = LocalCoordinator(target_world=2, max_world=2)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    try:
+        client = HTTPCoordinator(f"127.0.0.1:{server.port}")
+        client.register("a")
+        client.register("b")
+        client.heartbeat("a", step=25)
+        client.set_target_world(1)
+        plan = client.plan()
+        assert plan.stop_step == 25 + coord.stop_margin
+    finally:
+        server.stop()
+
+
+def test_immediate_resize_journals_no_fabricated_boundary(devices8):
+    """An IMMEDIATE resize (no live multi-member world, no agreement)
+    must journal stop_step = -1 even when the coordinator stamped an
+    advisory stop into the plan: the stamp lives in the coordinator's
+    own journal (coord.plan events / decision log), and recording it
+    as 'honored' would fabricate a boundary that never existed."""
+    et, coord = _world(devices8, n=2, gbs=8)
+    coord.heartbeat("t0", step=0)
+    coord.set_target_world(1)  # before any world forms
+    assert coord.plan().stop_step >= 0  # the stamp IS in the plan
+    et.run(4)
+    first = et.resize_events[0]
+    assert first.stop_step == -1, first
+    # ...and the honored boundary is always the agreement alone
+    et._stop_agreed = 9
+    assert et._effective_stop() == 9
+
+
+def test_vote_delay_chaos_defers_the_poll(devices8):
+    """chaos[consensus.vote.delayed]: the member keeps stepping
+    obliviously while its plan poll is suppressed, then quiesces and
+    resizes normally once the suppression expires."""
+    sched = FaultSchedule(
+        0, [FaultEvent(0, "consensus.vote.delayed", 0.3)]
+    )
+    et, coord = _world(devices8, n=2, gbs=8, chaos=sched)
+    et.consensus_stop = True
+    marks = {}
+
+    def on_step(rec):
+        sched.advance(rec.step)
+        time.sleep(0.005)  # keep the run alive past the suppression
+        if rec.step == 3 and "t0" not in marks:
+            marks["t0"] = time.monotonic()
+            coord.set_target_world(1)
+
+    hist = et.run(200, on_step=on_step)
+    ev = et.resize_events[-1]
+    assert ev.world_size == 1
+    assert time.monotonic() - marks["t0"] >= 0.3
+    assert not sched.pending(), "the delay event never fired"
+    assert sorted(r.step for r in hist) == list(range(200))
+
+
+# ---- collective watchdog --------------------------------------------------
+def test_watchdog_passthrough_and_timeout():
+    with telemetry.scoped() as (reg, rec):
+        wd = CollectiveWatchdog(timeout=5.0, registry=reg, recorder=rec)
+        assert wd.fetch(lambda: 42) == 42
+        # exceptions propagate unchanged
+        with pytest.raises(ValueError, match="boom"):
+            wd.fetch(lambda: (_ for _ in ()).throw(ValueError("boom")))
+        wd.timeout = 0.1
+        release = threading.Event()
+        with pytest.raises(CollectiveTimeout, match="watchdog"):
+            wd.fetch(release.wait)  # wedged "collective"
+        assert wd.trips == 1
+        release.set()  # unwedge the abandoned worker
+        # a fresh worker serves the next fetch
+        assert wd.fetch(lambda: 7) == 7
+        snap = reg.snapshot()
+        assert (
+            sum(
+                snap["counters"][
+                    "edl_consensus_watchdog_trips_total"
+                ].values()
+            )
+            == 1
+        )
+        kinds = [e.kind for e in rec.events(10)]
+        assert "consensus.watchdog" in kinds
+
+
+def test_watchdog_disabled_runs_inline():
+    wd = CollectiveWatchdog(timeout=0.0)
+    assert wd.fetch(lambda: threading.current_thread().name) == (
+        threading.current_thread().name
+    )
+
+
+def test_watchdog_chaos_trip_without_wait():
+    sched = FaultSchedule(0, [FaultEvent(0, "consensus.watchdog.trip")])
+    sched.advance(0)
+    with telemetry.scoped() as (reg, rec):
+        wd = CollectiveWatchdog(timeout=0.0, chaos=sched, registry=reg, recorder=rec)
+        t0 = time.perf_counter()
+        with pytest.raises(CollectiveTimeout, match="chaos"):
+            wd.fetch(lambda: 1)
+        assert time.perf_counter() - t0 < 1.0  # no actual wait
+    # one-shot: the next fetch is clean
+    assert wd.fetch(lambda: 1) == 1
+
+
+def test_watchdog_trip_buries_world_and_recovers(devices8):
+    """A tripped watchdog mid-run takes the broken-world recovery path
+    (world buried, hold, re-form on the generation bump) — the wedged-
+    collective hang becomes a bounded resize + replay."""
+    sched = FaultSchedule(0, [FaultEvent(4, "consensus.watchdog.trip")])
+    et, coord = _world(
+        devices8,
+        n=2,
+        gbs=8,
+        ckpt_interval=2,
+        chaos=sched,
+        world_builder=lambda plan: devices8[:2],
+    )
+    et.heartbeat_ids = ["t0", "t1"]
+    et.barrier_poll_interval = 0.01
+
+    def on_step(rec):
+        sched.advance(rec.step)
+
+    # the reaper analog: re-admit the world after the break
+    stop = threading.Event()
+
+    def bumper():
+        while not stop.wait(0.25):
+            coord.deregister("t1")
+            coord.register("t1")
+
+    th = threading.Thread(target=bumper, daemon=True)
+    th.start()
+    try:
+        hist = et.run(10, on_step=on_step)
+    finally:
+        stop.set()
+        th.join(timeout=5)
+    assert sorted(set(r.step for r in hist)) == list(range(10))
+    assert et._watchdog is not None and et._watchdog.trips == 1
+    kinds = [e.kind for e in et.recorder.events(400)]
+    assert "world.broken" in kinds
+
+
+def test_poisoned_word_buries_world(devices8):
+    """A peer's poison bit surfaces as BusPoisonError at harvest and
+    takes the same recovery path as a mid-collective death."""
+    et, coord = _world(
+        devices8,
+        n=2,
+        gbs=8,
+        ckpt_interval=2,
+        world_builder=lambda plan: devices8[:2],
+    )
+    et.heartbeat_ids = ["t0", "t1"]
+    et.barrier_poll_interval = 0.01
+    et._bus_poison = True  # this member self-reports failure
+
+    def unpoison_and_bump():
+        et._bus_poison = False
+        coord.deregister("t1")
+        coord.register("t1")
+
+    timer = threading.Timer(0.3, unpoison_and_bump)
+    timer.start()
+    try:
+        hist = et.run(6)
+    finally:
+        timer.cancel()
+    assert sorted(set(r.step for r in hist)) == list(range(6))
+    assert et._m_world_breaks.value() >= 1
+
+
+# ---- actuation sequencing -------------------------------------------------
+def test_autoscaler_victim_deletion_waits_for_world_ack():
+    """The scale-down actuation must not SIGTERM victim pods while the
+    world is still quiescing toward the agreed stop: deletion waits
+    (bounded) until every member of the retargeted plan acked the new
+    generation (= the old world fully left the boundary).  Coordinators
+    without the signal, and worlds with no live trainers, skip the
+    wait."""
+    from edl_tpu.autoscaler.scaler import Autoscaler
+
+    asc = Autoscaler.__new__(Autoscaler)
+    asc.victim_drain_timeout = 5.0
+
+    # (1) live world mid-quiesce: the wait holds until the ack lands
+    coord = LocalCoordinator(target_world=2, max_world=2)
+    coord.register("a")
+    coord.register("b")
+    gen = coord.plan().generation
+    coord.ack_generation("a", gen)
+    coord.ack_generation("b", gen)
+    coord.set_target_world(1)  # retarget: nobody acked the new gen yet
+    new_gen = coord.plan().generation
+
+    def ack_later():
+        coord.ack_generation("a", new_gen)
+
+    t = threading.Timer(0.4, ack_later)
+    t.start()
+    t0 = time.monotonic()
+    try:
+        asc._wait_for_quiesce(coord)
+    finally:
+        t.cancel()
+    waited = time.monotonic() - t0
+    assert 0.3 <= waited < 5.0, waited
+
+    # (2) no live trainers (nobody ever acked): no wait at all
+    cold = LocalCoordinator(target_world=2, max_world=2)
+    cold.register("x")
+    cold.set_target_world(1)
+    t0 = time.monotonic()
+    asc._wait_for_quiesce(cold)
+    assert time.monotonic() - t0 < 0.3
+
+    # (3) pre-consensus coordinator shape: no signal, no wait
+    class Legacy:
+        def metrics(self):
+            return {"generation": 1}
+
+    t0 = time.monotonic()
+    asc._wait_for_quiesce(Legacy())
+    assert time.monotonic() - t0 < 0.3
+
+
+# ---- lint: chaos injection points are registry-checked --------------------
+def test_lint_rejects_unregistered_chaos_point(tmp_path):
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    try:
+        import lint
+    finally:
+        _sys.path.pop(0)
+
+    bad = tmp_path / "edl_tpu" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        'def f(s, p):\n'
+        '    s.maybe_raise("consensus.watchdog.tripp")\n'
+        '    s.due(p)\n'
+        '    s.due("consensus.watchdog.trip")\n'
+    )
+    msgs = [m for _, m in lint.lint_file(bad)]
+    assert any("unregistered chaos injection point" in m for m in msgs)
+    assert any("free-form chaos point" in m for m in msgs)
+    # the registered literal on the last line is NOT flagged
+    assert sum("chaos" in m for m in msgs) == 2

@@ -266,6 +266,134 @@ def test_multipod_elastic_1_2_1(tmp_path):
         server.stop()
 
 
+def test_multipod_scale_down_delayed_poll_same_boundary(tmp_path):
+    """THE deadlock-shaped regression test for the consensus step bus:
+    at a retarget, one member's plan poll is chaos-delayed
+    (``consensus.vote.delayed``) — the exact poll-skew the pre-consensus
+    runtime raced on (the early poller stood down into the shutdown
+    barrier while the oblivious peer's dispatched gloo collective waited
+    for it forever; measured 2/5 hangs of ``test_multipod_elastic_1_2_1``
+    at ``bb253ec`` on a loaded box).  With the bus, the on-time member's
+    vote rides the data plane: BOTH members must agree on one stop step
+    in their flight-recorder journals and leave the old world at that
+    exact boundary — the delayed member included, steps before it ever
+    sees the new plan."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(
+        target_world=1, max_world=2, heartbeat_timeout=60.0, legal_sizes=[1, 2]
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {w: tmp_path / f"{w}.jsonl" for w in ("p1", "p2")}
+    events = {w: tmp_path / f"{w}.events.jsonl" for w in ("p1", "p2")}
+    procs = []
+
+    def read_events(name, kind):
+        return [
+            e["data"]
+            for e in _read_lines(events[name])
+            if e.get("kind") == kind
+        ]
+
+    try:
+        p1 = _spawn_worker(
+            procs, hist, "p1", 12100, caddr,
+            extra_env={"EDL_FLIGHT_RECORDER_FILE": str(events["p1"])},
+        )
+        _wait_for(
+            lambda: len(_read_history(hist["p1"])) >= 5,
+            180, "p1 stepping at world 1", procs,
+        )
+        # p2's plan poll will be suppressed 3s at the NEXT retarget it
+        # observes on a live multi-member world (the scale-down below).
+        p2 = _spawn_worker(
+            procs, hist, "p2", 12160, caddr,
+            extra_env={
+                "EDL_FLIGHT_RECORDER_FILE": str(events["p2"]),
+                "EDL_CHAOS_SPEC": json.dumps(
+                    {
+                        "seed": 0,
+                        "events": [
+                            {
+                                "step": 0,
+                                "point": "consensus.vote.delayed",
+                                "arg": 3.0,
+                            }
+                        ],
+                    }
+                ),
+            },
+        )
+        coord.set_target_world(2)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 2 for r in _read_history(hist["p1"])
+            )
+            and any(r["world_size"] == 2 for r in _read_history(hist["p2"])),
+            240, "the 2-pod world to step", procs,
+        )
+
+        down_mark = len(_read_history(hist["p1"]))
+        coord.set_target_world(1)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 1
+                for r in _read_history(hist["p1"])[down_mark:]
+            ),
+            240, "p1 back at world 1 (past the delayed-poll window)", procs,
+        )
+        for name, proc in (("p2", p2), ("p1", p1)):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+
+        # -- the agreement, from the journals alone -----------------------
+        stops1 = read_events("p1", "consensus.stop")
+        stops2 = read_events("p2", "consensus.stop")
+        assert stops1 and stops2, (stops1, stops2)
+        s1, s2 = stops1[-1], stops2[-1]
+        assert s1["stop_step"] == s2["stop_step"], (s1, s2)
+        assert s1["for_generation"] == s2["for_generation"], (s1, s2)
+        stop = s1["stop_step"]
+        # Both members' old-world step streams end at EXACTLY stop-1:
+        # same boundary, zero skew — including the member that had not
+        # yet seen the plan when it quiesced.
+        last1 = max(
+            r["step"]
+            for r in _read_history(hist["p1"])
+            if r["world_size"] == 2
+        )
+        last2 = max(
+            r["step"]
+            for r in _read_history(hist["p2"])
+            if r["world_size"] == 2
+        )
+        assert last1 == last2 == stop - 1, (last1, last2, stop)
+        # The survivor's scale-down resize journaled the same boundary,
+        # and the new world resumed AT it (no replay, no gap).
+        down = [
+            rz
+            for rz in _read_resizes(hist["p1"])
+            if rz["world_size"] == 1 and rz["generation"] > 2
+        ]
+        assert down and down[-1]["stop_step"] == stop, (down, stop)
+        h1 = _read_history(hist["p1"])
+        steps_done = sorted(r["step"] for r in h1)
+        assert steps_done == list(range(steps_done[-1] + 1)), "step gaps"
+        assert all(math.isfinite(r["loss"]) for r in h1)
+        # The chaos really delayed the poll (journaled injection).
+        chaos_fired = read_events("p2", "chaos")
+        assert any(
+            c["point"] == "consensus.vote.delayed" for c in chaos_fired
+        ), chaos_fired
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
 def test_multipod_multichip_pods_1_2_1(tmp_path):
     """The deployed flagship shape: trainer pods that own a multi-chip
     slice (the spec's default ``slice_topology: v5e-4`` gives 4 chips

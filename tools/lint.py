@@ -18,6 +18,12 @@ Checks, all stdlib:
   must name a metric declared in ``edl_tpu/telemetry/catalog.py``, and
   the name must be a string LITERAL — free-form/computed names defeat
   the catalog and are rejected outright
+- unregistered chaos injection points: every ``.due("...")`` /
+  ``.maybe_raise("...")`` / ``.roll("...")`` / ``.rng("...")`` call
+  site (outside tests/ and the registry module itself) must name a
+  point declared in ``edl_tpu/chaos/schedule.py``'s ``KNOWN_POINTS``
+  — a typo'd point would otherwise silently never fire, turning a
+  chaos test into a vacuous pass
 - blocking device fetches in the elastic hot loop: ``float(...)``,
   ``int(...)`` and ``.item()`` calls inside ``ElasticTrainer.run`` are
   rejected — the async step pipeline keeps metrics as device futures
@@ -42,6 +48,14 @@ REEXPORT_FILES = {"__init__.py"}
 #: registry handle constructors whose first argument is a metric name
 METRIC_METHODS = {"counter", "gauge", "histogram"}
 
+#: FaultSchedule methods whose first argument is an injection-point
+#: name (the chaos analog of METRIC_METHODS)
+CHAOS_METHODS = {"due", "maybe_raise", "roll", "rng"}
+
+#: the chaos registry module — its own internals legitimately pass
+#: computed point names (event delivery iterates the schedule)
+CHAOS_REGISTRY = ("edl_tpu", "chaos", "schedule.py")
+
 #: (class, methods) whose bodies form the elastic hot loop: blocking
 #: device fetches are banned there (see _hot_loop_findings)
 HOT_LOOP_CLASS = "ElasticTrainer"
@@ -54,6 +68,7 @@ SYNC_MARKER = "# sanctioned-sync"
 BLOCKING_CASTS = {"float", "int"}
 
 _CATALOG_CACHE = [False, None]  # [loaded, names-or-None]
+_CHAOS_CACHE = [False, None]  # [loaded, points-or-None]
 
 
 def _metric_catalog():
@@ -81,6 +96,71 @@ def _metric_catalog():
         except (OSError, SyntaxError, ValueError):
             pass
     return _CATALOG_CACHE[1]
+
+
+def _chaos_registry():
+    """Injection points declared in edl_tpu/chaos/schedule.py's
+    KNOWN_POINTS, parsed statically (the registry is a pure tuple
+    literal for exactly this reason).  None when absent/unparseable —
+    the check then degrades to literal-ness only."""
+    if not _CHAOS_CACHE[0]:
+        _CHAOS_CACHE[0] = True
+        path = Path(__file__).resolve().parent.parent.joinpath(
+            *CHAOS_REGISTRY
+        )
+        try:
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and t.id == "KNOWN_POINTS"
+                        ):
+                            _CHAOS_CACHE[1] = set(
+                                ast.literal_eval(node.value)
+                            )
+        except (OSError, SyntaxError, ValueError):
+            pass
+    return _CHAOS_CACHE[1]
+
+
+def _chaos_point_findings(tree: ast.AST, path: Path):
+    """Reject unregistered / free-form chaos injection-point names —
+    the mirror of the catalog-strict metrics check.  A typo'd point
+    would silently never fire (``due`` just matches nothing), so the
+    chaos test guarding a recovery path would pass vacuously.  Tests
+    and the registry module itself are excluded (tests exercise
+    unknown-point rejection on purpose; the registry's delivery loop
+    passes computed names)."""
+    if "tests" in path.parts or path.parts[-3:] == CHAOS_REGISTRY:
+        return
+    registry = _chaos_registry()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute) and f.attr in CHAOS_METHODS
+        ):
+            continue
+        if not node.args:
+            continue
+        a = node.args[0]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+            if isinstance(a, ast.Constant):
+                continue  # not a chaos point (e.g. some .due(3))
+            yield node.lineno, (
+                f"free-form chaos point passed to .{f.attr}() — "
+                "injection points must be string literals from "
+                "chaos/schedule.py KNOWN_POINTS"
+            )
+            continue
+        if registry is not None and a.value not in registry:
+            yield node.lineno, (
+                f"unregistered chaos injection point {a.value!r} — "
+                "declare it in edl_tpu/chaos/schedule.py KNOWN_POINTS"
+            )
 
 
 def _metric_name_findings(tree: ast.AST, path: Path):
@@ -212,6 +292,7 @@ def _unused_imports(tree: ast.AST, path: Path):
 def _ast_findings(tree: ast.AST, path: Path, sanctioned: set = frozenset()):
     yield from _unused_imports(tree, path)
     yield from _metric_name_findings(tree, path)
+    yield from _chaos_point_findings(tree, path)
     yield from _hot_loop_findings(tree, path, sanctioned)
     # f-string format specs are themselves JoinedStr nodes with no
     # FormattedValue (f"{x:02d}" nests JoinedStr(['02d'])): exclude

@@ -172,6 +172,17 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
             th.observe(0.001)
         per_step_overhead = (time.perf_counter() - t0) / n_ops
 
+    # Goodput ledger across the whole cycle (steady stepping + every
+    # resize + any replay), read from the same shared registry a
+    # production scrape sees: the fraction of wall clock spent
+    # stepping, with the resizing[:phase] / holding / replaying
+    # decomposition the autoscaler's decision log records.
+    from edl_tpu.telemetry import goodput_decomposition
+
+    goodput = goodput_decomposition(
+        telemetry.get_registry().snapshot()
+    )
+
     return {
         "telemetry": {
             "per_step_overhead_s": round(per_step_overhead, 9),
@@ -180,6 +191,8 @@ def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
             # read back from the SHARED registry (what /metrics serves)
             "steps_total": et._m_steps.value(),
         },
+        "goodput": goodput,
+        "goodput_frac": (goodput or {}).get("frac"),
         "resize_s": statistics.median(resize_windows),
         "resize_max_s": max(resize_windows),
         "step_s": statistics.median(step_times),

@@ -27,10 +27,32 @@ python tools/lint.py
 # shape deterministically.
 if [ "${EDL_STRESS:-0}" = "1" ]; then
   N="${EDL_STRESS_N:-5}"
+  # Post-mortem wiring: each iteration leaves a metrics snapshot +
+  # flight-recorder journal; on failure the journal is merged into a
+  # Chrome-trace/Perfetto timeline (edl trace) next to the snapshot,
+  # so a stress flake ships with its causal picture attached.
+  export EDL_METRICS_ARTIFACT="${EDL_METRICS_ARTIFACT:-${TMPDIR:-/tmp}/edl-stress-metrics.prom}"
   for i in $(seq 1 "$N"); do
     echo "[stress] multipod scale-down iteration $i/$N"
-    timeout -k 10 870 python -m pytest tests/test_multipod.py -x -q \
-      -k "elastic_1_2_1 or delayed_poll" -p no:cacheprovider "$@"
+    if ! timeout -k 10 870 python -m pytest tests/test_multipod.py -x -q \
+      -k "elastic_1_2_1 or delayed_poll" -p no:cacheprovider "$@"; then
+      echo "[stress] FAILED iteration $i/$N"
+      events="${EDL_METRICS_ARTIFACT%.prom}.events.jsonl"
+      trace_out="${EDL_METRICS_ARTIFACT%.prom}.trace.json"
+      # A timeout/SIGKILL kills pytest before its sessionfinish hook
+      # writes the journal — the artifacts then simply don't exist;
+      # say so instead of exiting silently.
+      if [ -f "$events" ]; then
+        python -m edl_tpu.cli trace --journal "pytest=$events" \
+          --out "$trace_out" --summary || true
+        echo "metrics snapshot artifact: $EDL_METRICS_ARTIFACT"
+        echo "merged trace artifact:     $trace_out"
+      else
+        echo "no flight-recorder journal at $events (pytest killed" \
+          "before its sessionfinish hook could spill one)"
+      fi
+      exit 1
+    fi
   done
   echo "[stress] $N/$N iterations green"
   exit 0

@@ -211,12 +211,13 @@ class Autoscaler:
         for v in candidates:
             if diff.get(v.name):
                 targets[v.name] = v.parallelism + diff[v.name]
-        applied, stop_steps = self._actuate(targets, diff)
+        applied, stop_steps, traces = self._actuate(targets, diff)
         # Decisions are journaled AFTER actuation so ``actuated``
         # reports what actually happened (a PUT that gave up under a
         # conflict storm is exactly the case the log exists for).
         decisions = self._record_decisions(
-            candidates, diff, targets, have_pending, applied, stop_steps
+            candidates, diff, targets, have_pending, applied, stop_steps,
+            traces,
         )
         plan = ScalePlan(
             targets=targets,
@@ -255,10 +256,17 @@ class Autoscaler:
         self._goodput_failed_tick.pop(name, None)
         merged = t.get("merged") or {}
         steps = (merged.get("counters") or {}).get("edl_steps_total") or {}
+        goodput = t.get("goodput") or {}
         obs = {
             "step_rate": t.get("step_rate"),
             "resize_cost_seconds": t.get("resize_cost_seconds"),
             "steps_total": sum(steps.values()),
+            # The goodput ledger's job-level read: the wall-clock
+            # fraction actually spent stepping, plus its decomposition
+            # (resizing[:phase] / holding / replaying / broken ...) —
+            # the signal a step RATE alone cannot carry.
+            "goodput_frac": goodput.get("frac"),
+            "goodput_seconds": goodput.get("seconds"),
         }
         if obs["step_rate"] is not None:
             self._g_step_rate.set(obs["step_rate"], job=name)
@@ -268,17 +276,20 @@ class Autoscaler:
 
     def _record_decisions(
         self, candidates, diff, targets, have_pending, applied,
-        stop_steps=None,
+        stop_steps=None, traces=None,
     ) -> List[dict]:
         """One structured decision entry per candidate: the dry-run
         trace (current -> proposed), the observed goodput inputs, and
         the reason the tick did or didn't actuate.  ``applied``: the
         per-job actuation outcome from ``_actuate``; ``stop_steps``:
         the coordinator-stamped stop step read back after a scale-down
-        retarget (None otherwise) — with the trainers' ``consensus.*``
-        flight events, a scale-down timeline reconstructs from the
-        journal alone.  Appended to the bounded ``decision_log`` and
-        journaled to the flight recorder."""
+        retarget (None otherwise); ``traces``: the per-job causal-trace
+        id this decision minted — with the trainers' flight events
+        carrying the same id, the whole decision-to-first-step chain
+        reconstructs from the journal alone (``edl trace``).  Appended
+        to the bounded ``decision_log`` and journaled to the flight
+        recorder (the trace id in the NON-identity trace field, so
+        chaos-soak digests stay deterministic)."""
         decisions = []
         for v in candidates:
             d = diff.get(v.name, 0)
@@ -296,6 +307,7 @@ class Autoscaler:
             outcome = applied.get(v.name)
             if v.name in targets and outcome != "applied":
                 reason += f"; actuation {outcome or 'not attempted'}"
+            trace_id = (traces or {}).get(v.name, "")
             entry = {
                 "job": v.name,
                 "dry_run": {
@@ -308,10 +320,14 @@ class Autoscaler:
                 "actuated": outcome == "applied",
                 "reason": reason,
                 "stop_step": (stop_steps or {}).get(v.name),
+                "trace_id": trace_id,
             }
             decisions.append(entry)
             self.decision_log.append(entry)
-            self._recorder.record("autoscaler.decision", entry)
+            data = {k: v2 for k, v2 in entry.items() if k != "trace_id"}
+            self._recorder.record(
+                "autoscaler.decision", data, trace=trace_id
+            )
         del self.decision_log[: -self.decision_log_max]
         return decisions
 
@@ -332,27 +348,34 @@ class Autoscaler:
         graceful resize into a lease-timeout + replay."""
         import sys
 
+        from edl_tpu import telemetry
         from edl_tpu.cluster.cluster import ParallelismUpdateError
 
         applied: Dict[str, str] = {}
         #: job -> the stop_step the coordinator stamped into the
         #: retargeted plan (scale-downs; read back for the decision log)
         stop_steps: Dict[str, Optional[int]] = {}
+        #: job -> the causal-trace id THIS decision minted; it rides
+        #: the prewarm hint and the retarget into ElasticPlan.trace_id,
+        #: so every member journals the whole resize under it
+        traces: Dict[str, str] = {}
         for name, parallelism in targets.items():
             job = self.jobs.get(name)
             if job is None:
                 applied[name] = "job gone"
                 continue
+            trace_id = telemetry.new_trace_id()
+            traces[name] = trace_id
             # Prewarm announcement FIRST — before any retarget or PUT:
             # trainers AOT-compile the incoming world size's step while
             # still stepping at the current one, so the resize window
             # this actuation triggers contains zero cold compiles
             # (zero-stall resize).  Purely advisory and best-effort: a
             # lost hint only costs the overlapped cold compile.
-            self._announce_prewarm(job, parallelism)
+            self._announce_prewarm(job, parallelism, trace_id)
             scale_down = diff.get(name, 0) < 0
             if scale_down:
-                client = self._retarget(job, parallelism)
+                client = self._retarget(job, parallelism, trace_id)
                 if client is not None:
                     # ONE plan fetch serves both the decision-log stamp
                     # and the victim choice: the journaled stop_step and
@@ -388,35 +411,46 @@ class Autoscaler:
                 direction="down" if scale_down else "up"
             )
             if not scale_down:
-                self._retarget(job, parallelism)
-        return applied, stop_steps
+                self._retarget(job, parallelism, trace_id)
+        return applied, stop_steps, traces
 
-    def _announce_prewarm(self, job: TrainingJob, world: int) -> None:
+    def _announce_prewarm(
+        self, job: TrainingJob, world: int, trace_id: str = ""
+    ) -> None:
         """POST the planned next parallelism to the job's coordinator
         (``/prewarm``) so trainers warm exactly the incoming world
-        size.  Tolerates clients without the endpoint (injected test
-        doubles, older coordinators) — the hint is an optimization, a
-        failure to deliver it must never block the actuation."""
+        size — carrying this decision's causal-trace id, so even the
+        warm-ahead compile journals under it.  Tolerates clients
+        without the endpoint (injected test doubles, older
+        coordinators) — the hint is an optimization, a failure to
+        deliver it must never block the actuation."""
         try:
             client = self._coord_client(job)
             hint = getattr(client, "set_prewarm", None)
             if hint is not None:
-                hint(world)
+                try:
+                    hint(world, trace_id=trace_id)
+                except TypeError:
+                    hint(world)  # pre-tracing client/double
         except Exception:
             pass  # the resize still works, with an overlapped cold compile
 
-    def _retarget(self, job: TrainingJob, world: int):
+    def _retarget(self, job: TrainingJob, world: int, trace_id: str = ""):
         """POST the new target world to the job's coordinator.  Returns
         the client on success, None on failure.  Failure is tolerated
         (the coordinator may still be scheduling) but LOGGED — a
         persistently unreachable coordinator (bad Service, NetworkPolicy)
         must be visible; the controller's level-triggered
-        ``reconcile_targets`` converges the handshake on a later tick."""
+        ``reconcile_targets`` converges the handshake on a later tick.
+        ``trace_id`` stamps the retargeted plan (ElasticPlan.trace_id)."""
         import sys
 
         try:
             client = self._coord_client(job)
-            client.set_target_world(world)
+            try:
+                client.set_target_world(world, trace_id=trace_id)
+            except TypeError:
+                client.set_target_world(world)  # pre-tracing double
             return client
         except Exception as e:
             print(

@@ -335,6 +335,94 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Merge the cluster's flight-recorder journals into ONE causally
+    ordered Chrome-trace/Perfetto timeline (`edl trace <host:port>`):
+    the coordinator's journal (which already holds every member's
+    reported event tail, origin-tagged) is fetched over `/telemetry`,
+    member lanes are clock-aligned with the NTP-style offsets the
+    members estimated from their heartbeats, and the result is written
+    as JSON for ui.perfetto.dev / chrome://tracing — pid = member,
+    tid = subsystem, duration slices for resizes (with per-phase child
+    slices), instants for votes/quiesce/saves/decisions.
+
+    ``--journal name=path`` merges on-disk JSONL spills
+    (EDL_FLIGHT_RECORDER_FILE) instead of / in addition to the live
+    coordinator — the post-mortem path.  ``--trace-id`` filters to one
+    causal chain; ``--summary`` prints the goodput decomposition and
+    the trace chains instead of only writing the file."""
+    from edl_tpu.telemetry import trace as tracing
+
+    streams = {}
+    offsets = {}
+    goodput = None
+    if args.url:
+        from edl_tpu.runtime.coord_service import HTTPCoordinator
+
+        client = HTTPCoordinator(args.url, timeout=args.timeout)
+        tel = client.telemetry()
+        streams.update(tracing.member_streams(tel.get("events") or []))
+        offsets = {
+            m: o
+            for m, o in (tel.get("clock_offsets") or {}).items()
+            if o is not None
+        }
+        goodput = tel.get("goodput")
+    for spec in args.journal or []:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            import os
+
+            name, path = os.path.basename(spec), spec
+        streams[name] = tracing.load_journal(path)
+    if not streams:
+        print(
+            "error: nothing to merge (give a coordinator URL and/or "
+            "--journal name=events.jsonl)",
+            file=sys.stderr,
+        )
+        return 2
+    merged = tracing.merge_events(streams, offsets)
+    if args.summary:
+        print(f"events merged: {len(merged)} from {len(streams)} lane(s)")
+        if offsets:
+            for m in sorted(offsets):
+                print(f"  clock offset {m:<20} {offsets[m]:+.6f}s")
+        print("goodput")
+        if goodput:
+            print(f"  {'frac':<24} {goodput['frac']:.4f}")
+            print(f"  {'total_s':<24} {goodput['total_s']:.3f}")
+            for state in sorted(goodput.get("seconds") or {}):
+                print(
+                    f"  {state:<24} {goodput['seconds'][state]:.3f}s"
+                )
+        else:
+            print("  n/a (no goodput ledger reported)")
+        chains = tracing.trace_chains(merged)
+        if chains:
+            print(f"causal chains ({len(chains)})")
+            for tid_, evs in sorted(
+                chains.items(), key=lambda kv: kv[1][0]["wall_aligned"]
+            ):
+                kinds = [e.get("kind") for e in evs]
+                members = sorted({e["member"] for e in evs})
+                print(
+                    f"  {tid_}  {len(evs)} events over "
+                    f"{','.join(members)}: {' -> '.join(kinds[:10])}"
+                    + (" ..." if len(kinds) > 10 else "")
+                )
+    doc = tracing.chrome_trace(merged, trace_id=args.trace_id)
+    out = args.out
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    print(
+        f"merged trace: {out} "
+        f"({len(doc['traceEvents'])} trace events; open at "
+        "ui.perfetto.dev or chrome://tracing)"
+    )
+    return 0
+
+
 def cmd_controller(args) -> int:
     """Run the control plane against a real cluster: watch TrainingJob
     CRs and reconcile/autoscale forever — the reference's whole
@@ -516,6 +604,38 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--timeout", type=float, default=5.0)
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "trace",
+        help="merge coordinator + member flight journals into one "
+        "clock-aligned Perfetto timeline (+ goodput summary)",
+    )
+    s.add_argument(
+        "url",
+        nargs="?",
+        default="",
+        help="coordinator address (host:port); omit for --journal-only",
+    )
+    s.add_argument(
+        "--journal",
+        action="append",
+        metavar="NAME=PATH",
+        help="merge an on-disk flight-recorder JSONL spill "
+        "(EDL_FLIGHT_RECORDER_FILE) as lane NAME (repeatable)",
+    )
+    s.add_argument(
+        "--out", default="edl-trace.json", help="output Chrome-trace JSON"
+    )
+    s.add_argument(
+        "--trace-id", default="", help="filter to one causal chain"
+    )
+    s.add_argument(
+        "--summary",
+        action="store_true",
+        help="print the goodput decomposition + causal chains",
+    )
+    s.add_argument("--timeout", type=float, default=5.0)
+    s.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser(
         "controller", help="run the control-plane daemon against a cluster"

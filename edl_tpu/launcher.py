@@ -79,6 +79,13 @@ def env_config() -> dict:
         # per-step consensus control word (EDL_CONSENSUS=0 disables —
         # diagnostic escape hatch only: scale-downs then race again)
         "consensus": e.get("EDL_CONSENSUS", "1") != "0",
+        # how often (seconds) the telemetry snapshot + event tail +
+        # clock-offset estimate piggyback on the heartbeat cadence
+        # (0 disables reporting; tests tighten it so merged traces
+        # converge fast)
+        "telemetry_interval": float(
+            e.get("EDL_TELEMETRY_INTERVAL", "5.0")
+        ),
         # Multi-host slice placement: replica index from the per-replica
         # Job's env; host index from the Indexed Job's completion index
         # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides
@@ -751,6 +758,7 @@ def run(
     et.pipeline_depth = cfg["pipeline_depth"]
     et.consensus_bus = cfg["consensus"]
     et.collective_timeout = cfg["collective_timeout"]
+    et.telemetry_interval = cfg["telemetry_interval"]
     et.heartbeat_ids = heartbeat_ids
     et.register_address = pod_address
     et.register_replica = cfg["replica"]

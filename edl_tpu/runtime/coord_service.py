@@ -38,6 +38,8 @@ def _plan_to_dict(plan: Optional[ElasticPlan]) -> Optional[dict]:
         "alive": list(plan.alive),
         "prewarm": plan.prewarm,
         "stop_step": plan.stop_step,
+        "trace_id": plan.trace_id,
+        "prewarm_trace": plan.prewarm_trace,
     }
 
 
@@ -53,6 +55,8 @@ def _plan_from_dict(d: Optional[dict]) -> Optional[ElasticPlan]:
         alive=tuple(d.get("alive", ())),
         prewarm=int(d.get("prewarm", 0)),
         stop_step=int(d.get("stop_step", -1)),
+        trace_id=str(d.get("trace_id", "")),
+        prewarm_trace=str(d.get("prewarm_trace", "")),
     )
 
 
@@ -134,33 +138,67 @@ class CoordinatorServer:
                         coord.deregister(req["trainer_id"])
                         self._reply({"ok": True})
                     elif self.path == "/heartbeat":
-                        coord.heartbeat(
+                        # The reply carries the server's wall clock:
+                        # with the client's t0/t1 stamps it is one
+                        # NTP-style offset sample for the merged
+                        # timeline's clock alignment (zero extra
+                        # round-trips).  Coordinator doubles without
+                        # the return value simply reply without it.
+                        r = coord.heartbeat(
                             req["trainer_id"], step=int(req.get("step", -1))
                         )
-                        self._reply({"ok": True})
+                        self._reply(
+                            {"ok": True, **(r if isinstance(r, dict) else {})}
+                        )
                     elif self.path == "/ack":
                         coord.ack_generation(req["trainer_id"], req["generation"])
                         self._reply({"ok": True})
                     elif self.path == "/target":
-                        coord.set_target_world(req["world"])
+                        # trace_id: the autoscaler decision's causal
+                        # trace, stamped into the retargeted plan.
+                        try:
+                            coord.set_target_world(
+                                req["world"],
+                                trace_id=str(req.get("trace_id", "")),
+                            )
+                        except TypeError:
+                            # pre-tracing coordinator double
+                            coord.set_target_world(req["world"])
                         self._reply({"ok": True})
                     elif self.path == "/prewarm":
                         # Advisory pre-actuation announcement: trainers
                         # AOT-warm the hinted world size's step before
-                        # the retarget lands (zero-stall resize).
-                        coord.set_prewarm(req["world"])
+                        # the retarget lands (zero-stall resize).  The
+                        # decision's trace id rides the hint.
+                        try:
+                            coord.set_prewarm(
+                                req["world"],
+                                trace_id=str(req.get("trace_id", "")),
+                            )
+                        except TypeError:
+                            coord.set_prewarm(req["world"])
                         self._reply({"ok": True})
                     elif self.path == "/telemetry":
                         # Cumulative per-trainer snapshot + an event
                         # tail, idempotent by (trainer_id, seq) — the
                         # piggyback ride of the heartbeat cadence.
-                        coord.report_telemetry(
-                            req["trainer_id"],
-                            snapshot=req.get("snapshot"),
-                            seq=int(req.get("seq", 0)),
-                            events=req.get("events"),
-                            boot=str(req.get("boot", "")),
-                        )
+                        try:
+                            coord.report_telemetry(
+                                req["trainer_id"],
+                                snapshot=req.get("snapshot"),
+                                seq=int(req.get("seq", 0)),
+                                events=req.get("events"),
+                                boot=str(req.get("boot", "")),
+                                clock=req.get("clock"),
+                            )
+                        except TypeError:
+                            coord.report_telemetry(
+                                req["trainer_id"],
+                                snapshot=req.get("snapshot"),
+                                seq=int(req.get("seq", 0)),
+                                events=req.get("events"),
+                                boot=str(req.get("boot", "")),
+                            )
                         self._reply({"ok": True})
                     elif self.path == "/checkpoint":
                         coord.report_checkpoint(req["step"])
@@ -231,6 +269,7 @@ class HTTPCoordinator:
         hardcoded ``0.2 * 2**attempt`` with no deadline): callers
         inside a bounded control tick pass a deadline, the step loop
         keeps the default.  ``retry_policy`` overrides wholesale."""
+        from edl_tpu.telemetry.trace import ClockOffsetEstimator
         from edl_tpu.utils.retry import RetryPolicy
 
         if "://" not in address:
@@ -244,6 +283,11 @@ class HTTPCoordinator:
             max_delay=2.0,
             deadline=retry_deadline,
         )
+        #: NTP-style estimate of the coordinator's clock vs ours, fed
+        #: by heartbeat request/response pairs (min-RTT filtered) —
+        #: what lets the merged cluster timeline causally order events
+        #: across members with skewed wall clocks
+        self.clock_estimator = ClockOffsetEstimator()
 
     def _open(self, req) -> bytes:
         """One raw HTTP round-trip.  The chaos transport wrapper
@@ -314,27 +358,36 @@ class HTTPCoordinator:
         self._post("/deregister", trainer_id=trainer_id)
 
     def heartbeat(self, trainer_id: str, step: int = -1):
+        import time as _time
         import urllib.error
 
         try:
-            self._post("/heartbeat", trainer_id=trainer_id, step=step)
+            t0 = _time.time()
+            r = self._post("/heartbeat", trainer_id=trainer_id, step=step)
+            t1 = _time.time()
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 # same contract as LocalCoordinator.heartbeat
                 raise KeyError(trainer_id) from None
             raise
+        # One free clock-offset sample per beat (retries inflate the
+        # apparent RTT; the estimator's min-RTT filter discards them).
+        st = r.get("server_time")
+        if st is not None:
+            self.clock_estimator.add(t0, float(st), t1)
 
     def ack_generation(self, trainer_id: str, generation: int):
         self._post("/ack", trainer_id=trainer_id, generation=generation)
 
-    def set_target_world(self, n: int):
-        self._post("/target", world=n)
+    def set_target_world(self, n: int, trace_id: str = ""):
+        self._post("/target", world=n, trace_id=trace_id)
 
-    def set_prewarm(self, n: int):
+    def set_prewarm(self, n: int, trace_id: str = ""):
         """Announce the autoscaler's planned next parallelism so
         trainers warm that world size's compiled step ahead of the
-        actual retarget (see ``LocalCoordinator.set_prewarm``)."""
-        self._post("/prewarm", world=n)
+        actual retarget (see ``LocalCoordinator.set_prewarm``).  The
+        decision's causal-trace id rides the hint."""
+        self._post("/prewarm", world=n, trace_id=trace_id)
 
     def get_target_world(self) -> int:
         return self._get("/target")["world"]
@@ -391,18 +444,25 @@ class HTTPCoordinator:
         seq: int = 0,
         events: Optional[list] = None,
         boot: str = "",
+        clock: Optional[dict] = None,
     ):
         """ONE attempt, no backoff (unlike every other call): the
         report is cumulative and re-sent every cadence anyway, and it
         runs on the trainer's heartbeat thread — a retry storm here
         could outlast the membership lease and evict a healthy member
-        for the sake of best-effort telemetry."""
+        for the sake of best-effort telemetry.  ``clock`` defaults to
+        this client's own heartbeat-fed offset estimate."""
+        if clock is None:
+            off = self.clock_estimator.offset()
+            if off is not None:
+                clock = {"offset": off, "rtt": self.clock_estimator.rtt()}
         payload = {
             "trainer_id": trainer_id,
             "snapshot": snapshot,
             "seq": seq,
             "events": events,
             "boot": boot,
+            "clock": clock,
         }
         req = urllib.request.Request(
             f"{self.address}/telemetry",

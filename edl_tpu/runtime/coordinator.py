@@ -41,6 +41,7 @@ from edl_tpu.telemetry import (
     TelemetryAggregator,
     coord_snapshot_gauges,
     merge_snapshots,
+    new_trace_id,
     render_prometheus,
 )
 
@@ -85,6 +86,19 @@ class ElasticPlan:
     #: the scale-down timeline reconstructible from the journal alone
     #: (``coord.plan`` events + the autoscaler decision log).
     stop_step: int = -1
+    #: causal-trace correlation id of the decision that produced THIS
+    #: generation (autoscaler-minted and delivered with the retarget /
+    #: prewarm hint; coordinator-minted for join/evict/leave rebuilds).
+    #: Members install it as their flight recorder's ambient trace, so
+    #: every event of the resize — vote, quiesce, flush, transfer,
+    #: restore, first step — journals under one id
+    #: (``edl_tpu.telemetry.trace``).
+    trace_id: str = ""
+    #: trace id of the UPCOMING decision announced via the prewarm
+    #: hint (same generation — advisory, like ``prewarm`` itself), so
+    #: the background AOT warm journals under the decision that asked
+    #: for it before the retarget even lands
+    prewarm_trace: str = ""
 
 
 @dataclass
@@ -156,6 +170,18 @@ class LocalCoordinator:
         #: for in-flight progress (heartbeat-cadence staleness)
         self.stop_margin = 16
         self._prewarm = 0
+        #: trace id of the decision currently being actuated (set by
+        #: the prewarm announcement and/or the retarget itself;
+        #: consumed by the retarget's plan rebuild)
+        self._pending_trace = ""
+        #: trace id of an actuation still CONVERGING: a scale-up's
+        #: retarget rebuild fires before the new pods exist, so the
+        #: join rebuilds that grow the world toward the target are part
+        #: of the same decision and must journal under the same id —
+        #: cleared once the world reaches the target
+        self._actuation_trace = ""
+        #: generation whose coord.world_acked event already journaled
+        self._acked_journaled = -1
         self._plan: Optional[ElasticPlan] = None
         self._resize_log: List[dict] = []
         #: target training steps (passes x batches-per-pass); 0 = open-ended
@@ -211,10 +237,14 @@ class LocalCoordinator:
             if self._members.pop(trainer_id, None) is not None:
                 self._rebuild_plan("leave")
 
-    def heartbeat(self, trainer_id: str, step: int = -1):
+    def heartbeat(self, trainer_id: str, step: int = -1) -> dict:
         """``step``: the member's last completed world step, piggybacked
         on the beat so retarget plans can stamp a stop_step without an
-        extra round-trip (-1 = not reported)."""
+        extra round-trip (-1 = not reported).  Returns the server's
+        wall clock: with the client's t0/t1 stamps around the beat it
+        is the NTP-style offset sample the merged-timeline clock
+        alignment runs on (``telemetry.trace.ClockOffsetEstimator``) —
+        piggybacked so alignment costs zero extra round-trips."""
         with self._lock:
             m = self._members.get(trainer_id)
             if m is None:
@@ -222,50 +252,115 @@ class LocalCoordinator:
             m.last_heartbeat = self._clock()
             if step > self._latest_step:
                 self._latest_step = step
+        return {"server_time": time.time()}
 
     def ack_generation(self, trainer_id: str, generation: int):
-        """Trainer reports it has re-meshed into ``generation``."""
+        """Trainer reports it has re-meshed into ``generation``.  The
+        moment EVERY planned member has acked the current generation is
+        journaled once (``coord.world_acked``, under the plan's trace):
+        it is the victim-drain signal the autoscaler's scale-down waits
+        on before deleting pods, and the merged timeline should show
+        it on the coordinator's lane."""
         with self._lock:
             m = self._members.get(trainer_id)
             if m is not None:
                 m.acked_generation = generation
                 self._lock.notify_all()
+            plan = self._plan
+            if (
+                plan is not None
+                and plan.generation > self._acked_journaled
+                and all(
+                    self._members[t].acked_generation >= plan.generation
+                    for t in plan.members
+                    if t in self._members
+                )
+            ):
+                self._acked_journaled = plan.generation
+                self._recorder.record(
+                    "coord.world_acked",
+                    {"world_size": plan.world_size},
+                    generation=plan.generation,
+                    trace=plan.trace_id,
+                )
 
     # -- control (autoscaler/controller-facing) -----------------------------
-    def set_target_world(self, n: int):
+    def set_target_world(self, n: int, trace_id: str = ""):
         """The actuation analog of the reference's Parallelism PUT
         (``pkg/autoscaler.go:339-376``): declare the desired trainer
         count, clamped to ``max_world``; the plan shrinks immediately
         (members beyond the target drop out of rank order) or grows as
-        new trainers register."""
+        new trainers register.  ``trace_id``: the autoscaler decision's
+        causal-trace id — stamped into the retargeted plan so every
+        member journals the whole resize under it."""
         if n < 1:
             raise ValueError("target world must be >= 1")
         with self._lock:
             n = min(n, self._max_world)
             if n == self._target_world:
+                # No-op retarget: the decision actuated a target
+                # already in place, so no resize will carry its id —
+                # drop any pending trace rather than letting a LATER
+                # unrelated retarget consume it (mis-attribution).
+                self._pending_trace = ""
                 return
+            if trace_id:
+                self._pending_trace = trace_id
+            else:
+                # A traceless retarget is a DIFFERENT actor (operator
+                # CLI, chaos monkey, controller reconcile): a trace
+                # staged by an earlier decision — a prewarm whose PUT
+                # gave up, a scale-up whose pods never arrived — must
+                # not bleed onto this resize or its converging joins.
+                self._pending_trace = ""
+                self._actuation_trace = ""
+            if self._pending_trace:
+                # A scale-up retarget usually fires before its pods
+                # exist: the active world is unchanged, the rebuild
+                # below early-returns, and the decision only LANDS at
+                # the later join rebuilds — which must then journal
+                # under this id (see _rebuild_plan's join branch).
+                self._actuation_trace = self._pending_trace
             self._target_world = n
             self._rebuild_plan("retarget")
+            # The pending trace never outlives the retarget call it was
+            # staged for: when the rebuild early-returned (active world
+            # unchanged — pods not yet registered), leaving it set
+            # would hand this decision's id to a LATER unrelated
+            # traceless retarget (confirmed mis-attribution); the
+            # converging joins use _actuation_trace instead.
+            self._pending_trace = ""
 
-    def set_prewarm(self, n: int):
+    def set_prewarm(self, n: int, trace_id: str = ""):
         """Announce the world size the autoscaler intends to actuate
         next (the prewarm half of the actuation handshake).  Purely
         advisory: the current plan is re-issued with the hint attached
         — SAME generation, so no trainer resizes — and trainers
         background-compile that size's step executable so the upcoming
         retarget's resize window contains zero cold compiles.  ``0``
-        clears the hint."""
+        clears the hint.  ``trace_id`` rides the hint (and is held for
+        the retarget it announces) so the warm-ahead work journals
+        under the decision that asked for it."""
         if n < 0:
             raise ValueError("prewarm world must be >= 0")
         with self._lock:
             n = min(n, self._max_world)
-            if n == self._prewarm:
+            if trace_id:
+                self._pending_trace = trace_id
+            if n == self._prewarm and not trace_id:
                 return
             self._prewarm = n
-            if self._plan is not None and self._plan.prewarm != n:
+            if self._plan is not None and (
+                self._plan.prewarm != n
+                or (trace_id and self._plan.prewarm_trace != trace_id)
+            ):
                 from dataclasses import replace
 
-                self._plan = replace(self._plan, prewarm=n)
+                self._plan = replace(
+                    self._plan,
+                    prewarm=n,
+                    prewarm_trace=trace_id or self._plan.prewarm_trace,
+                )
             self._lock.notify_all()
 
     def prewarm_hint(self) -> int:
@@ -393,15 +488,17 @@ class LocalCoordinator:
         seq: int = 0,
         events: Optional[List[dict]] = None,
         boot: str = "",
+        clock: Optional[dict] = None,
     ) -> None:
         """Ingest one trainer's cumulative telemetry report: the
         registry snapshot (idempotently merged by (trainer_id, boot,
         seq) — a restarted trainer's fresh boot supersedes its dead
-        incarnation's high seq) and a tail of its flight-recorder
-        events."""
+        incarnation's high seq), a tail of its flight-recorder events,
+        and its clock-offset estimate (the merged timeline's
+        alignment input)."""
         with self._lock:
             fresh = self._telemetry.report(
-                trainer_id, snapshot or {}, seq, boot=boot
+                trainer_id, snapshot or {}, seq, boot=boot, clock=clock
             )
         if fresh and events:
             self._recorder.record(
@@ -412,18 +509,23 @@ class LocalCoordinator:
 
     def telemetry(self) -> dict:
         """Merged cluster telemetry + derived goodput signals (the
-        autoscaler's decision-log inputs) + recent flight events."""
+        autoscaler's decision-log inputs) + recent flight events +
+        per-member clock offsets (the merged-timeline alignment)."""
         with self._lock:
             merged = self._telemetry.merged()
             rate = self._telemetry.step_rate()
             cost = self._telemetry.resize_cost_seconds(merged=merged)
+            goodput = self._telemetry.goodput(merged=merged)
             sources = self._telemetry.sources()
+            offsets = self._telemetry.clock_offsets()
         return {
             "merged": merged,
             "step_rate": rate,
             "resize_cost_seconds": cost,
+            "goodput": goodput,
             "sources": sources,
-            "events": [e.to_dict() for e in self._recorder.events(64)],
+            "clock_offsets": offsets,
+            "events": [e.to_dict() for e in self._recorder.events(256)],
         }
 
     def recorder(self) -> FlightRecorder:
@@ -533,6 +635,35 @@ class LocalCoordinator:
             if self._latest_step >= 0
             else -1
         )
+        # The causal-trace id of THIS generation: a retarget consumes
+        # the actuation's pending trace (delivered with the prewarm
+        # hint and/or the retarget PUT); every other rebuild — join,
+        # leave, eviction — mints its own, so membership-churn resizes
+        # are just as traceable as autoscaler decisions.  Random, and
+        # carried only in non-identity journal fields: chaos-soak
+        # digests stay bit-identical.
+        prev_world = self._plan.world_size if self._plan else 0
+        if reason == "retarget" and self._pending_trace:
+            trace = self._pending_trace
+            self._pending_trace = ""
+        elif (
+            reason == "join"
+            and self._actuation_trace
+            and prev_world < world <= self._target_world
+        ):
+            # A pod registering while a traced scale-up is still
+            # converging IS that actuation landing: the generation the
+            # members actually resize into must journal under the
+            # decision's id, not a fresh join-minted one.
+            trace = self._actuation_trace
+        else:
+            # Other join/evict/leave rebuilds mint their own id and do
+            # NOT consume a pending actuation trace: a pod registering
+            # between the prewarm announcement and the retarget must
+            # not steal the decision's id from the retarget it tags.
+            trace = new_trace_id()
+        if world >= self._target_world:
+            self._actuation_trace = ""  # actuation converged
         self._plan = ElasticPlan(
             generation=self._generation,
             world_size=world,
@@ -542,6 +673,7 @@ class LocalCoordinator:
             alive=tuple(self._members),
             prewarm=self._prewarm,
             stop_step=stop_step,
+            trace_id=trace,
         )
         self._resize_log.append(
             {
@@ -561,5 +693,6 @@ class LocalCoordinator:
                 "stop_step": stop_step,
             },
             generation=self._generation,
+            trace=trace,
         )
         self._lock.notify_all()

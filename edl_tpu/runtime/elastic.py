@@ -386,6 +386,33 @@ class ElasticTrainer:
         import uuid as _uuid
 
         self._telemetry_boot = _uuid.uuid4().hex[:12]
+        self._m_clock_offset = self.telemetry.gauge(
+            "edl_clock_offset_seconds"
+        )
+
+        # Goodput ledger: the honest wall-clock decomposition
+        # (stepping / staging_stalled / resizing[:phase] / holding /
+        # replaying / broken) behind edl_goodput_* — fed at the loop's
+        # existing transition points, aggregated job-wide by the
+        # coordinator, read back by the autoscaler's decision log.
+        from edl_tpu.telemetry.ledger import GoodputLedger
+
+        self.ledger = GoodputLedger(registry=self.telemetry)
+        #: steps below this replay work already completed before a
+        #: non-graceful fallback (the ledger's "replaying" bound)
+        self._replay_until = 0
+
+        # Causal tracing (edl_tpu.telemetry.trace): the plan's trace id
+        # is installed as the recorder's ambient trace for the whole
+        # resize and cleared after the first post-resize step journals
+        # (step.first) — one id from autoscaler decision to first step.
+        self._first_step_trace_gen: Optional[int] = None
+        #: the trace id step.first must close (captured at resize time
+        #: — the AMBIENT trace may already belong to a NEWER plan when
+        #: a pending step harvests inside the next barrier's drain)
+        self._first_step_trace = ""
+        #: (hint, trace) pairs already journaled (prewarm.hint dedup)
+        self._hint_journaled: set = set()
 
         # -- data-plane step agreement (edl_tpu.consensus) ------------------
         #: dispatch the per-step int32 control word (the "step bus") on
@@ -610,7 +637,22 @@ class ElasticTrainer:
             # gracefully rather than stalling).
             self._dropped_prewarm_hints += 1
             return
-        self.prewarm_async(hint)
+        if self.prewarm_async(hint) is not None:
+            # Journal the warm-ahead under the decision that asked for
+            # it (the hint carries the upcoming actuation's trace id),
+            # once per (hint, trace): the merged timeline then shows
+            # the compile STARTING before the retarget even lands —
+            # the zero-stall-resize overlap, visible.
+            hint_trace = getattr(plan, "prewarm_trace", "")
+            key = (hint, hint_trace)
+            if key not in self._hint_journaled:
+                self._hint_journaled.add(key)
+                self.recorder.record(
+                    "prewarm.hint",
+                    {"world_size": hint},
+                    generation=self.generation,
+                    trace=hint_trace,
+                )
 
     # -- fault injection (what the reference never had; SURVEY.md §5.3) -----
     def inject_failure(self):
@@ -742,6 +784,7 @@ class ElasticTrainer:
         When a stop agreement ran (scale-down victims quiesce at the
         agreed boundary like every other member), its latency is
         journaled on the way out."""
+        self.ledger.transition("holding")
         self._finish_quiesce()
         self._reset_stop_state()
         if self.state is not None and self._can_flush(plan):
@@ -769,6 +812,9 @@ class ElasticTrainer:
                 pass
         self.generation = plan.generation
         self._standby = True
+        # A standby member's chain ends here (it takes no first step):
+        # stop charging steady-state standby events to the resize.
+        self.recorder.set_trace("")
 
     def _finish_overlap(
         self,
@@ -824,6 +870,7 @@ class ElasticTrainer:
         # phase seen in a device trace is searchable on /metrics.
         annotate = partial(_span, registry=self.telemetry)
 
+        self.ledger.transition("resizing")
         t0 = time.perf_counter()
         phases: Dict[str, float] = {}
 
@@ -1019,6 +1066,19 @@ class ElasticTrainer:
         # nothing staged for the old mesh survives (generation-keyed).
         self._host_step = restored_step
         self._last_harvest_t = None
+        # Goodput: refine the just-attributed resize bucket into its
+        # measured serial phases, and bound the replay stretch the
+        # loop will attribute until the step counter catches back up.
+        self.ledger.split_resize(phases)
+        self._replay_until = restored_step + replayed
+        # Causal trace: the first post-resize step closes this plan's
+        # chain (step.first journals in _harvest_one, then the ambient
+        # trace clears).
+        self._first_step_trace_gen = plan.generation
+        self._first_step_trace = getattr(plan, "trace_id", "")
+        # Re-arm the device profiler so a bounded trace window can open
+        # around THIS resize's first steps (EDL_PROFILE_EACH_RESIZE).
+        self.profiler.note_resize()
 
         self.generation = plan.generation
         self._standby = False
@@ -1339,14 +1399,29 @@ class ElasticTrainer:
         # watermark only advances past what was actually shipped).
         events = self.recorder.events_since(self._events_sent_seq)[:64]
         self._telemetry_seq += 1
+        # Clock alignment piggyback: the HTTP client's heartbeat-fed
+        # offset estimate rides the report so the coordinator can
+        # place this member's events on the merged timeline.
+        clock = None
+        est = getattr(self.coordinator, "clock_estimator", None)
+        if est is not None:
+            off = est.offset()
+            if off is not None:
+                clock = {"offset": off, "rtt": est.rtt()}
+                self._m_clock_offset.set(off)
         try:
-            rep(
-                source,
+            kwargs = dict(
                 snapshot=self.telemetry.snapshot(),
                 seq=self._telemetry_seq,
                 events=[e.to_dict() for e in events],
                 boot=self._telemetry_boot,
             )
+            try:
+                rep(source, clock=clock, **kwargs)
+            except TypeError:
+                # pre-tracing coordinator / test double without the
+                # clock kwarg: the report itself must still land
+                rep(source, **kwargs)
         except Exception:
             return  # unreachable coordinator: next cadence retries
         if events:
@@ -1419,6 +1494,8 @@ class ElasticTrainer:
         self._holding = True
         # Defensive: tests drive _world_broken on __new__-constructed
         # trainers that never ran __init__ (no telemetry handles).
+        if getattr(self, "ledger", None) is not None:
+            self.ledger.transition("broken")
         if getattr(self, "_m_world_breaks", None) is not None:
             self._m_world_breaks.inc()
             self.recorder.record(
@@ -1607,6 +1684,16 @@ class ElasticTrainer:
         if plan.generation != self.generation:
             # A fresh generation supersedes any broken-world hold.
             self._await_new_generation = False
+            # Install the plan's causal-trace id as the recorder's
+            # ambient trace: every event this member journals on the
+            # way through the resize — vote, quiesce, flush, transfer,
+            # restore — now carries the id the autoscaler minted (or
+            # the coordinator minted for membership churn).  Cleared
+            # when the first post-resize step journals.  Idempotent
+            # across the repeated polls of a quiescing member.
+            plan_trace = getattr(plan, "trace_id", "")
+            if plan_trace:
+                self.recorder.set_trace(plan_trace)
         if plan.generation == self.generation and (
             self.state is not None
             or self._standby
@@ -1758,6 +1845,28 @@ class ElasticTrainer:
         self.recorder.set_context(rec.step, rec.generation)
         self._m_steps.inc()
         self._m_step_seconds.observe(srec.seconds)
+        if self._first_step_trace_gen is not None and (
+            rec.generation >= self._first_step_trace_gen
+        ):
+            # The first harvested step of the fresh generation closes
+            # the resize's causal chain — under the trace CAPTURED at
+            # resize time, not the ambient one: a pending step
+            # harvesting inside the NEXT barrier's drain (back-to-back
+            # retargets within the pipeline lag) would otherwise
+            # journal under the newer plan's just-installed trace and
+            # clear it mid-resize.
+            self._first_step_trace_gen = None
+            self.recorder.record(
+                "step.first",
+                {"world_size": rec.world_size},
+                step=rec.step,
+                generation=rec.generation,
+                trace=self._first_step_trace,
+            )
+            if self.recorder.trace_context() == self._first_step_trace:
+                self.recorder.set_trace("")
+            self._first_step_trace = ""
+        self.ledger.touch()
         if self._on_step is not None:
             self._on_step(srec)
         done_step = rec.step + 1
@@ -1878,6 +1987,19 @@ class ElasticTrainer:
                     self._drain_guarded()
                     continue  # re-poll; the drained pipeline resizes
                 if self._holding:
+                    # A hold after a world break is the BREAK's wait
+                    # (recovery hasn't happened yet); an ordinary hold
+                    # is just an unformable plan.  touch() keeps the
+                    # counters accruing through a LONG park — the
+                    # telemetry reports riding the heartbeat cadence
+                    # must show the degradation while it is happening,
+                    # not after the park ends.
+                    self.ledger.transition(
+                        "broken"
+                        if self._await_new_generation
+                        else "holding"
+                    )
+                    self.ledger.touch()
                     # Sanctioned sync point: hold.  A world with no
                     # formable plan drains its in-flight steps before
                     # parking (their futures must not outlive whatever
@@ -1925,6 +2047,8 @@ class ElasticTrainer:
                     # the resize/standby from the top of the loop); a
                     # chaos-delayed poll sits in this state until the
                     # suppression expires.
+                    self.ledger.transition("holding")
+                    self.ledger.touch()
                     if not self._drain_guarded():
                         continue
                     self._note_quiesced()
@@ -1953,7 +2077,14 @@ class ElasticTrainer:
                         self._harvest_pending(0)
                         break
                     trainer = self._trainers[self._world_size()]
-                    self.profiler.maybe_start()
+                    self.profiler.maybe_start(step)
+                    # Goodput: replayed steps re-earn work a fallback
+                    # already completed once — not fresh progress.
+                    self.ledger.transition(
+                        "replaying"
+                        if step < self._replay_until
+                        else "stepping"
+                    )
                     t0 = time.perf_counter()
                     with self.profiler.step(step):
                         batch = self._next_batch(step, trainer, num_steps)
@@ -1964,6 +2095,10 @@ class ElasticTrainer:
                     t2 = time.perf_counter()
                     self.pipeline_stats["stage_s"] += t1 - t0
                     self.pipeline_stats["dispatch_s"] += t2 - t1
+                    # The host time blocked on batch assembly/placement
+                    # is the ledger's staging_stalled carve-out (the
+                    # stall the async stager exists to hide).
+                    self.ledger.note_staging(t1 - t0)
                     self._pending.append(
                         _InFlightStep(
                             step=step,

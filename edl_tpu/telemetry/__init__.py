@@ -31,7 +31,8 @@ from edl_tpu.telemetry.aggregate import (
     TelemetryAggregator,
     coord_snapshot_gauges,
 )
-from edl_tpu.telemetry.catalog import CATALOG
+from edl_tpu.telemetry.catalog import CATALOG, KNOWN_EVENT_KINDS
+from edl_tpu.telemetry.ledger import GoodputLedger, goodput_decomposition
 from edl_tpu.telemetry.recorder import FlightEvent, FlightRecorder
 from edl_tpu.telemetry.registry import (
     MetricsRegistry,
@@ -39,17 +40,23 @@ from edl_tpu.telemetry.registry import (
     render_prometheus,
 )
 from edl_tpu.telemetry.spans import span
+from edl_tpu.telemetry.trace import ClockOffsetEstimator, new_trace_id
 
 __all__ = [
     "CATALOG",
+    "ClockOffsetEstimator",
     "FlightEvent",
     "FlightRecorder",
+    "GoodputLedger",
+    "KNOWN_EVENT_KINDS",
     "MetricsRegistry",
     "TelemetryAggregator",
     "coord_snapshot_gauges",
     "get_recorder",
     "get_registry",
+    "goodput_decomposition",
     "merge_snapshots",
+    "new_trace_id",
     "render_prometheus",
     "scoped",
     "set_recorder",

@@ -69,16 +69,27 @@ class TelemetryAggregator:
         #: attributes one source's whole history to a short window
         self._rate_window = max(2, rate_window)
         self._rate_points: Dict[str, deque] = {}
+        #: source -> latest reported clock info ({"offset", "rtt"}) —
+        #: the NTP-style wall-clock alignment the timeline merger uses
+        #: (edl_tpu.telemetry.trace.ClockOffsetEstimator, client-side)
+        self._clock_info: Dict[str, dict] = {}
         self.reports = 0
 
     def report(
-        self, source: str, snapshot: dict, seq: int = 0, boot: str = ""
+        self,
+        source: str,
+        snapshot: dict,
+        seq: int = 0,
+        boot: str = "",
+        clock: Optional[dict] = None,
     ) -> bool:
         """Store ``source``'s cumulative snapshot.  Returns False (and
         changes nothing) when ``seq`` is not newer than what's stored
         for the same boot — the idempotence half of the contract.  A
         DIFFERENT boot always wins: the process restarted, its new
-        cumulative stream replaces the dead incarnation's."""
+        cumulative stream replaces the dead incarnation's.  ``clock``:
+        the source's estimated wall-clock offset vs this coordinator
+        (kept per source for the merged-timeline alignment)."""
         prev = self._by_source.get(source)
         if prev is not None and boot == prev[0] and seq <= prev[1]:
             return False
@@ -86,6 +97,8 @@ class TelemetryAggregator:
             # fresh incarnation: its counter stream restarts too
             self._rate_points.pop(source, None)
         self._by_source[source] = (boot, int(seq), snapshot or {})
+        if clock:
+            self._clock_info[source] = dict(clock)
         self.reports += 1
         self._rate_points.setdefault(
             source, deque(maxlen=self._rate_window)
@@ -131,3 +144,21 @@ class TelemetryAggregator:
         total = sum(h["sum"] for h in hist.values())
         count = sum(h["count"] for h in hist.values())
         return (total / count) if count else None
+
+    def goodput(self, merged: Optional[dict] = None) -> Optional[dict]:
+        """Job-level goodput decomposition (per-state seconds + the
+        stepping fraction) from the members' merged
+        ``edl_goodput_seconds_total`` counters; None until some member
+        reported a ledger."""
+        from edl_tpu.telemetry.ledger import goodput_decomposition
+
+        m = merged if merged is not None else self.merged()
+        return goodput_decomposition(m)
+
+    def clock_offsets(self) -> Dict[str, Optional[float]]:
+        """Latest per-source wall-clock offset estimate (seconds to add
+        to the member's wall to land on this coordinator's timeline)."""
+        return {
+            src: info.get("offset")
+            for src, info in self._clock_info.items()
+        }

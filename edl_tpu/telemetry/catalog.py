@@ -295,4 +295,69 @@ CATALOG = {
         "help": "AOT step-warm compile seconds (Trainer.warm_step).",
         "labels": (),
     },
+    # -- goodput ledger (edl_tpu.telemetry.ledger) ---------------------------
+    "edl_goodput_seconds_total": {
+        "type": "counter",
+        "help": "Wall-clock seconds this process spent in each "
+        "training state (stepping / staging_stalled / resizing[:phase] "
+        "/ holding / replaying / broken) — the honest decomposition "
+        "behind the goodput fraction the autoscaler reads back.",
+        "labels": ("state",),
+    },
+    "edl_goodput_frac": {
+        "type": "gauge",
+        "help": "Fraction of attributed wall-clock this process spent "
+        "actually stepping (stepping / total ledger seconds).",
+        "labels": (),
+    },
+    # -- tracing / flight-recorder plumbing ----------------------------------
+    "edl_flight_spill_dropped_total": {
+        "type": "counter",
+        "help": "Flight-recorder JSONL spill writes dropped (write "
+        "failed, or spill temporarily disabled after a failure).",
+        "labels": (),
+    },
+    "edl_clock_offset_seconds": {
+        "type": "gauge",
+        "help": "NTP-style estimated offset of this process's wall "
+        "clock vs the coordinator's (add to local wall to get "
+        "coordinator time), from heartbeat request/response pairs.",
+        "labels": (),
+    },
+}
+
+# Every flight-recorder event kind the stack may journal (outside
+# tests/), mirrored by a tools/lint.py gate exactly like the metric
+# catalog and the chaos-point registry: free-form kinds are what make
+# merged cluster timelines unreadable.  PURE LITERAL — the lint gate
+# reads it with ast.literal_eval.
+KNOWN_EVENT_KINDS = {
+    # training / resize lifecycle (runtime.elastic)
+    "resize": "a resize barrier completed on this member",
+    "step.first": "first harvested step of a fresh generation",
+    "world.broken": "live process group abandoned mid-collective",
+    "prewarm.hint": "background AOT warm spawned for a hinted size",
+    "profile.window": "a bounded device-trace window opened/closed",
+    # checkpoints / transfer
+    "checkpoint.save": "checkpoint materialization submitted",
+    "transfer": "streaming restore-transfer summary",
+    # control plane (runtime.coordinator)
+    "coord.plan": "coordinator plan rebuild (generation bump)",
+    "coord.evict": "heartbeat-lease eviction",
+    "coord.telemetry": "trainer telemetry report ingested",
+    "coord.world_acked": "every planned member acked the generation",
+    # consensus (edl_tpu.consensus)
+    "consensus.vote": "stop vote cast on the step bus",
+    "consensus.stop": "stop agreement learned from a harvested word",
+    "consensus.quiesce": "member drained at the agreed stop boundary",
+    "consensus.straggler": "timing-lane straggler transition",
+    "consensus.watchdog": "collective watchdog deadline expired",
+    # resilience plumbing
+    "retry": "transient failure absorbed by RetryPolicy",
+    "retry.giveup": "RetryPolicy exhausted (GiveUpError)",
+    "chaos": "a scheduled fault was actually delivered",
+    # autoscaler
+    "autoscaler.decision": "one goodput-annotated decision-log entry",
+    # recorder-internal default for ingested events missing a kind
+    "event": "unclassified ingested event",
 }

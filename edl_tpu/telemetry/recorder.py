@@ -19,17 +19,34 @@ identity.
 Writers that only know *when* (not *where in training*) an event
 happened inherit the step/generation from the recorder's context,
 which the elastic step loop refreshes at every step boundary.
+
+Trace context: events may carry a cluster-wide ``trace`` id (the
+causal-tracing correlation key minted per autoscaler decision /
+coordinator plan rebuild, ``edl_tpu.telemetry.trace``).  Like ``wall``
+and ``timing`` it is a NON-identity field — trace ids are random, and
+including them in ``identity()`` would break the chaos-soak digest
+determinism contract.  ``set_trace`` installs an ambient trace id that
+stamps every subsequent record until cleared.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
+
+#: default JSONL spill rotation bound (``EDL_FLIGHT_RECORDER_MAX_MB``)
+DEFAULT_SPILL_MAX_MB = 64.0
+
+#: after a spill write failure, skip (and count) spill attempts for
+#: this many seconds before retrying — a gone disk must not charge an
+#: open() syscall to every recorded event
+SPILL_RETRY_SECONDS = 5.0
 
 
 def json_safe(v: Any) -> Any:
@@ -56,6 +73,9 @@ class FlightEvent:
     wall: float = 0.0
     #: non-deterministic measurements (durations...), excluded too
     timing: Optional[Dict[str, Any]] = None
+    #: causal-trace correlation id (autoscaler decision -> resize);
+    #: random per decision, so excluded from identity/digest too
+    trace: str = ""
 
     def identity(self) -> str:
         """The deterministic part, canonically serialized."""
@@ -76,6 +96,8 @@ class FlightEvent:
         }
         if self.timing:
             d["timing"] = self.timing
+        if self.trace:
+            d["trace"] = self.trace
         return d
 
 
@@ -87,6 +109,7 @@ class FlightRecorder:
         capacity: int = 2048,
         spill_path: str = "",
         clock=time.time,
+        spill_max_mb: Optional[float] = None,
     ):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=max(1, capacity))
@@ -94,13 +117,39 @@ class FlightRecorder:
         self._clock = clock
         self._spill_path = spill_path
         self._spill_f = None
+        if spill_max_mb is None:
+            spill_max_mb = float(
+                os.environ.get(
+                    "EDL_FLIGHT_RECORDER_MAX_MB", str(DEFAULT_SPILL_MAX_MB)
+                )
+            )
+        #: rotation bound for the JSONL spill: at most ~2x this many
+        #: bytes on disk (live file + one rotated predecessor)
+        self._spill_max_bytes = max(1, int(spill_max_mb * (1 << 20)))
+        self._spill_bytes = 0
+        #: monotonic-ish deadline before which spill attempts are
+        #: skipped (set after a write failure — see SPILL_RETRY_SECONDS)
+        self._spill_retry_at = 0.0
+        #: spill writes dropped (failed or skipped while disabled) —
+        #: also published as edl_flight_spill_dropped_total
+        self.spill_dropped = 0
         #: (step, generation) ambient context for writers that don't
         #: know their training position (updated by the step loop)
         self._context = (-1, -1)
+        #: ambient causal-trace id (see module doc)
+        self._trace = ""
 
     # -- context --------------------------------------------------------------
     def set_context(self, step: int, generation: int) -> None:
         self._context = (step, generation)
+
+    def set_trace(self, trace_id: str) -> None:
+        """Install (or clear, with "") the ambient trace id stamped on
+        every subsequent record that doesn't pass its own."""
+        self._trace = trace_id or ""
+
+    def trace_context(self) -> str:
+        return self._trace
 
     # -- spill ----------------------------------------------------------------
     def spill_to(self, path: str) -> None:
@@ -113,18 +162,64 @@ class FlightRecorder:
                     pass
                 self._spill_f = None
             self._spill_path = path
+            self._spill_bytes = 0
+            self._spill_retry_at = 0.0
+
+    def _count_spill_drop(self) -> None:
+        """Caller holds the lock.  Best-effort catalog counter — the
+        registry import is lazy (this module must stay importable
+        standalone) and a broken registry must not fail the record."""
+        self.spill_dropped += 1
+        try:
+            from edl_tpu import telemetry
+
+            telemetry.get_registry().counter(
+                "edl_flight_spill_dropped_total"
+            ).inc()
+        except Exception:
+            pass
 
     def _spill(self, ev: FlightEvent) -> None:
         """Caller holds the lock.  Best-effort: a full/gone disk must
-        never fail the event that was being recorded."""
+        never fail the event that was being recorded.  A write failure
+        no longer disables the spill forever — the drop is counted
+        (``edl_flight_spill_dropped_total``) and the spill retries
+        after ``SPILL_RETRY_SECONDS``; on success the file rotates at
+        ``EDL_FLIGHT_RECORDER_MAX_MB`` (previous generation kept as
+        ``<path>.1``) so a long healthy run stays size-bounded."""
         if not self._spill_path:
+            return
+        if self._spill_retry_at and self._clock() < self._spill_retry_at:
+            self._count_spill_drop()
             return
         try:
             if self._spill_f is None:
                 self._spill_f = open(self._spill_path, "a", buffering=1)
-            self._spill_f.write(json.dumps(ev.to_dict()) + "\n")
+                try:
+                    self._spill_bytes = os.fstat(
+                        self._spill_f.fileno()
+                    ).st_size
+                except OSError:
+                    self._spill_bytes = 0
+            line = json.dumps(ev.to_dict()) + "\n"
+            if self._spill_bytes + len(line) > self._spill_max_bytes:
+                self._spill_f.close()
+                self._spill_f = None
+                os.replace(self._spill_path, self._spill_path + ".1")
+                self._spill_f = open(self._spill_path, "a", buffering=1)
+                self._spill_bytes = 0
+            self._spill_f.write(line)
+            self._spill_bytes += len(line)
+            self._spill_retry_at = 0.0
         except Exception:
-            self._spill_path = ""  # disable after first failure
+            if self._spill_f is not None:
+                try:
+                    self._spill_f.close()
+                except Exception:
+                    pass
+                self._spill_f = None
+            self._spill_retry_at = self._clock() + SPILL_RETRY_SECONDS
+            self._count_spill_drop()
 
     # -- recording ------------------------------------------------------------
     def record(
@@ -134,7 +229,14 @@ class FlightRecorder:
         step: Optional[int] = None,
         generation: Optional[int] = None,
         timing: Optional[Dict[str, Any]] = None,
+        trace: Optional[str] = None,
+        wall: Optional[float] = None,
     ) -> FlightEvent:
+        """``trace``: explicit causal-trace id (None = the ambient
+        ``set_trace`` context).  ``wall``: preserve another recorder's
+        original timestamp instead of stamping now (the ingest path —
+        re-stamping member events with the coordinator's clock would
+        destroy the merged timeline's causal ordering)."""
         ctx_step, ctx_gen = self._context
         with self._lock:
             self._seq += 1
@@ -144,8 +246,9 @@ class FlightRecorder:
                 generation=ctx_gen if generation is None else int(generation),
                 kind=kind,
                 data=json_safe(data or {}),
-                wall=self._clock(),
+                wall=self._clock() if wall is None else float(wall),
                 timing=json_safe(timing) if timing else None,
+                trace=self._trace if trace is None else str(trace),
             )
             self._ring.append(ev)
             self._spill(ev)
@@ -154,7 +257,9 @@ class FlightRecorder:
     def ingest(self, events: List[dict], origin: str = "") -> None:
         """Merge already-serialized events from another recorder (the
         coordinator ingests trainer-reported tails).  Stamps fresh
-        local seqs; the origin rides in the data."""
+        local seqs; the origin rides in the data, and the source's
+        wall/trace are preserved verbatim (timeline + causal-chain
+        fidelity)."""
         for d in events:
             data = dict(d.get("data") or {})
             if origin:
@@ -165,6 +270,8 @@ class FlightRecorder:
                 step=d.get("step", -1),
                 generation=d.get("generation", -1),
                 timing=d.get("timing"),
+                trace=d.get("trace", ""),
+                wall=d.get("wall"),
             )
 
     # -- reads ----------------------------------------------------------------

@@ -6,11 +6,20 @@ the compiled train step and the resize window — get first-class device
 traces:
 
 - Set ``EDL_PROFILE_DIR=/some/dir`` (or pass ``profile_dir``) and the
-  elastic runtime captures a TensorBoard-loadable trace of the first
-  ``EDL_PROFILE_STEPS`` (default 10) steps after startup, with each
-  step wrapped in a ``StepTraceAnnotation`` and each resize phase in a
+  elastic runtime captures a TensorBoard-loadable trace of a bounded
+  window of ``EDL_PROFILE_STEPS`` (default 10) steps, with each step
+  wrapped in a ``StepTraceAnnotation`` and each resize phase in a
   named ``TraceAnnotation`` so the trace viewer separates
   flush/re-mesh/restore from stepping.
+- The window opens at startup by default; ``EDL_PROFILE_AT_STEP=N``
+  defers it until the global step counter reaches N (capture a LATER
+  regression window, e.g. around a known-bad resize), and
+  ``EDL_PROFILE_EACH_RESIZE=1`` re-arms after every resize so a
+  bounded window opens around each new generation's first steps.
+  ``rearm()`` does the same programmatically.
+- Each window's open/close journals a ``profile.window`` flight event,
+  so the merged cluster timeline (``edl trace``) shows exactly which
+  steps the device trace covers — the two instruments align by step.
 - ``annotate(name)`` is a no-op-cheap context manager usable anywhere
   in the runtime (it only touches the profiler when a trace is live).
 
@@ -26,12 +35,14 @@ from typing import Optional
 
 
 class StepProfiler:
-    """Captures a bounded device trace of the training hot loop."""
+    """Captures bounded device-trace windows of the training hot loop."""
 
     def __init__(
         self,
         profile_dir: Optional[str] = None,
         max_steps: Optional[int] = None,
+        at_step: Optional[int] = None,
+        rearm_on_resize: Optional[bool] = None,
     ):
         self.profile_dir = profile_dir or os.environ.get("EDL_PROFILE_DIR", "")
         self.max_steps = (
@@ -39,8 +50,24 @@ class StepProfiler:
             if max_steps is not None
             else int(os.environ.get("EDL_PROFILE_STEPS", "10"))
         )
+        #: open the window only once the step counter reaches this
+        #: (-1 = immediately); consumed by the NEXT window to open
+        self.at_step = (
+            at_step
+            if at_step is not None
+            else int(os.environ.get("EDL_PROFILE_AT_STEP", "-1"))
+        )
+        self.rearm_on_resize = (
+            rearm_on_resize
+            if rearm_on_resize is not None
+            else os.environ.get("EDL_PROFILE_EACH_RESIZE", "0") == "1"
+        )
         self._live = False
         self._steps_seen = 0
+        #: windows opened so far (a closed window disarms the profiler
+        #: until rearm() — the pre-rearm behavior, kept as the default)
+        self._windows = 0
+        self._armed = True
 
     @property
     def enabled(self) -> bool:
@@ -54,14 +81,39 @@ class StepProfiler:
         work only inside this window."""
         return self._live
 
-    def maybe_start(self) -> None:
-        if not self.enabled or self._live or self._steps_seen > 0:
+    def rearm(self, at_step: Optional[int] = None) -> None:
+        """Allow a new bounded window to open (the original profiler
+        captured exactly one window per process, so a device trace
+        could never cover a LATER resize).  ``at_step``: defer the new
+        window until that global step (None = open at the next step)."""
+        self._armed = True
+        self._steps_seen = 0
+        self.at_step = -1 if at_step is None else int(at_step)
+
+    def note_resize(self) -> None:
+        """A resize completed: under ``EDL_PROFILE_EACH_RESIZE`` the
+        profiler re-arms so the new generation's first steps (the
+        post-resize window a regression hunt actually wants) get their
+        own bounded trace."""
+        if self.enabled and self.rearm_on_resize and not self._live:
+            self.rearm()
+
+    def maybe_start(self, step: Optional[int] = None) -> None:
+        """Open the window when armed (and, with ``at_step`` set, once
+        the step counter reaches it)."""
+        if not self.enabled or self._live or not self._armed:
+            return
+        if self._steps_seen > 0:
+            return
+        if self.at_step >= 0 and (step is None or step < self.at_step):
             return
         import jax
 
         os.makedirs(self.profile_dir, exist_ok=True)
         jax.profiler.start_trace(self.profile_dir)
         self._live = True
+        self._windows += 1
+        self._journal("open", step)
 
     def step(self, step_num: int):
         """Context for one train step; stops the trace after max_steps."""
@@ -85,6 +137,26 @@ class StepProfiler:
             jax.profiler.stop_trace()
         finally:
             self._live = False
+            self._armed = False  # one window per arm; rearm() re-opens
+            self._journal("close", None)
+
+    def _journal(self, phase: str, step: Optional[int]) -> None:
+        """Flight-event marker aligning this device-trace window with
+        the merged cluster timeline.  Best-effort and lazy — this
+        module must stay importable without the telemetry package."""
+        try:
+            from edl_tpu import telemetry
+
+            data = {
+                "phase": phase,
+                "window": self._windows,
+                "dir": self.profile_dir,
+            }
+            telemetry.get_recorder().record(
+                "profile.window", data, step=step
+            )
+        except Exception:
+            pass
 
 
 @contextmanager

@@ -1241,3 +1241,177 @@ def test_multipod_cross_pod_tensor_parallel_hold_and_recover(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+def test_multipod_merged_trace_one_id_decision_to_first_step(tmp_path):
+    """Acceptance walk of the causal-tracing tentpole over a REAL
+    2-process world: a trace-tagged scale-up (prewarm hint + retarget
+    under one minted id) and a trace-tagged scale-down (the consensus
+    stop path), then ONE merged clock-aligned timeline in which
+    plan rebuild -> consensus vote/stop -> quiesce -> resize
+    (flush/restore) -> first post-resize step all share the minted
+    trace id, with per-member lanes in causal order."""
+    from edl_tpu.runtime.coord_service import (
+        CoordinatorServer,
+        HTTPCoordinator,
+    )
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.telemetry import new_trace_id
+    from edl_tpu.telemetry.trace import (
+        chrome_trace,
+        load_journal,
+        merge_events,
+        trace_chains,
+    )
+
+    coord = LocalCoordinator(
+        target_world=1, max_world=2, heartbeat_timeout=60.0, legal_sizes=[1, 2]
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    hist = {w: tmp_path / f"{w}.jsonl" for w in ("m1", "m2")}
+    events = {w: tmp_path / f"{w}.events.jsonl" for w in ("m1", "m2")}
+    procs = []
+
+    def spawn(name, base_port):
+        return _spawn_worker(
+            procs, hist, name, base_port, caddr,
+            extra_env={
+                "EDL_FLIGHT_RECORDER_FILE": str(events[name]),
+                # tight cadence so clock offsets + event tails land at
+                # the coordinator well inside the waits below
+                "EDL_TELEMETRY_INTERVAL": "1.0",
+            },
+        )
+
+    try:
+        m1 = spawn("m1", 12300)
+        _wait_for(
+            lambda: len(_read_history(hist["m1"])) >= 5,
+            180, "m1 stepping at world 1", procs,
+        )
+        m2 = spawn("m2", 12360)
+        _wait_for(
+            lambda: "m2" in coord.members(), 60, "m2 registered", procs
+        )
+
+        # -- the autoscaler's actuation, in miniature ---------------------
+        client = HTTPCoordinator(caddr)
+        up = new_trace_id()
+        client.set_prewarm(2, trace_id=up)
+        client.set_target_world(2, trace_id=up)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 2 for r in _read_history(hist["m1"])
+            )
+            and any(r["world_size"] == 2 for r in _read_history(hist["m2"])),
+            240, "the 2-pod world to step", procs,
+        )
+
+        down_mark = len(_read_history(hist["m1"]))
+        down = new_trace_id()
+        client.set_prewarm(1, trace_id=down)
+        client.set_target_world(1, trace_id=down)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 1
+                for r in _read_history(hist["m1"])[down_mark:]
+            ),
+            240, "m1 back at world 1", procs,
+        )
+        # one more telemetry cadence so the tail (resize, step.first)
+        # reaches the coordinator too
+        time.sleep(2.5)
+        offsets = {
+            m: o
+            for m, o in coord.telemetry()["clock_offsets"].items()
+            if o is not None
+        }
+        for name, proc in (("m2", m2), ("m1", m1)):
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=60)
+
+        # -- merge the cluster's journals into one timeline ---------------
+        streams = {
+            "coordinator": [
+                e.to_dict() for e in coord.recorder().events()
+            ],
+            "m1": load_journal(str(events["m1"])),
+            "m2": load_journal(str(events["m2"])),
+        }
+        merged = merge_events(streams, offsets)
+        chains = trace_chains(merged)
+
+        def chain_kinds(trace_id, member=None):
+            return [
+                e["kind"]
+                for e in chains.get(trace_id, [])
+                if member is None or e["member"] == member
+            ]
+
+        # Scale-up: the hint-driven prewarm, both members' resizes and
+        # their first post-resize steps share the minted id.
+        up_m1 = chain_kinds(up, "m1")
+        up_m2 = chain_kinds(up, "m2")
+        assert "resize" in up_m1 and "resize" in up_m2, (up_m1, up_m2)
+        assert "step.first" in up_m1 and "step.first" in up_m2
+        assert "coord.plan" in chain_kinds(up, "coordinator")
+
+        # Scale-down: the full causal chain under ONE id — the plan
+        # rebuild, the data-plane stop agreement (vote on at least one
+        # member, the learned stop + quiesce on both), the survivor's
+        # resize, and its first post-resize step.
+        assert "coord.plan" in chain_kinds(down, "coordinator")
+        all_down = chain_kinds(down)
+        assert "consensus.vote" in all_down, all_down
+        for member in ("m1", "m2"):
+            kinds = chain_kinds(down, member)
+            assert "consensus.stop" in kinds, (member, kinds)
+            assert "consensus.quiesce" in kinds, (member, kinds)
+        down_m1 = chain_kinds(down, "m1")
+        assert "resize" in down_m1 and "step.first" in down_m1, down_m1
+        # checkpoint flush inside the traced window (graceful resize)
+        assert "checkpoint.save" in down_m1, down_m1
+
+        # Causal order after clock alignment: decision -> vote ->
+        # quiesce -> resize -> first step, strictly by aligned wall.
+        def first_t(trace_id, kind, member=None):
+            for e in chains[trace_id]:
+                if e["kind"] == kind and (
+                    member is None or e["member"] == member
+                ):
+                    return e["wall_aligned"]
+            raise AssertionError(f"{kind} missing from chain")
+
+        t_plan = first_t(down, "coord.plan", "coordinator")
+        t_vote = first_t(down, "consensus.vote")
+        t_quiesce = first_t(down, "consensus.quiesce", "m1")
+        t_resize = first_t(down, "resize", "m1")
+        t_first = first_t(down, "step.first", "m1")
+        assert t_plan <= t_vote <= t_quiesce <= t_resize <= t_first, (
+            t_plan, t_vote, t_quiesce, t_resize, t_first,
+        )
+
+        # The members really reported clock offsets (same host: ~0),
+        # and the Chrome-trace doc has one lane per member.
+        assert {"m1", "m2"} <= set(offsets)
+        assert all(abs(o) < 5.0 for o in offsets.values()), offsets
+        doc = chrome_trace(merged)
+        lanes = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert {"coordinator", "m1", "m2"} <= lanes
+        # the survivor's resize renders as a duration slice with its
+        # serial phases as children
+        slice_names = {
+            e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"
+        }
+        assert "resize" in slice_names
+        assert any(n.startswith("resize/") for n in slice_names)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

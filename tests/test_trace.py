@@ -1,0 +1,683 @@
+"""Cluster-wide causal tracing (edl_tpu.telemetry.trace) + the goodput
+ledger (edl_tpu.telemetry.ledger): clock-offset estimation, trace-id
+propagation through the coordinator, the merged Chrome-trace timeline,
+flight-recorder spill hardening, profiler re-arm, and the `edl trace`
+CLI.  The 2-process end-to-end merged-trace test (real workers, one
+trace id from retarget to first post-resize step) lives in
+``tests/test_multipod.py``.
+"""
+
+import json
+
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.telemetry.ledger import GoodputLedger, goodput_decomposition
+from edl_tpu.telemetry.recorder import FlightRecorder
+from edl_tpu.telemetry.trace import (
+    ClockOffsetEstimator,
+    chrome_trace,
+    load_journal,
+    member_streams,
+    merge_events,
+    trace_chains,
+)
+
+
+# ---- clock-offset estimation ----------------------------------------------
+def test_clock_offset_recovers_symmetric_skew():
+    """A member whose wall clock runs 3.2s AHEAD of the coordinator:
+    with symmetric network delay the classic NTP estimate recovers the
+    offset exactly (offset = what to ADD to member time to get
+    coordinator time = -3.2)."""
+    est = ClockOffsetEstimator()
+    skew = 3.2
+    for t in (100.0, 101.0, 102.0):
+        t0 = t + skew  # member stamps
+        t1 = t + 0.010 + skew
+        server = t + 0.005  # coordinator mid-handling
+        est.add(t0, server, t1)
+    assert est.offset() == pytest.approx(-skew, abs=1e-9)
+    assert est.rtt() == pytest.approx(0.010)
+
+
+def test_clock_offset_asymmetric_rtt_error_bounded():
+    """Asymmetric delay (slow request, instant response) biases a
+    single sample by at most RTT/2 — and the min-RTT filter prefers a
+    later tight sample over an earlier congested one."""
+    est = ClockOffsetEstimator()
+    skew = -1.5  # member clock BEHIND the coordinator by 1.5s
+    # congested, asymmetric sample: 0.4s to reach, instant back
+    t0 = 200.0 + skew
+    server = 200.4
+    t1 = 200.4 + skew
+    est.add(t0, server, t1)
+    assert est.offset() == pytest.approx(1.5 + 0.2, abs=1e-9)
+    assert abs(est.offset() - 1.5) <= est.rtt() / 2 + 1e-9
+    # a tight symmetric sample arrives: it wins the min-RTT filter
+    t0 = 300.0 + skew
+    server = 300.001
+    t1 = 300.002 + skew
+    est.add(t0, server, t1)
+    assert est.offset() == pytest.approx(1.5, abs=1e-6)
+
+
+def test_clock_offset_empty_and_window():
+    est = ClockOffsetEstimator(window=4)
+    assert est.offset() is None and est.rtt() is None
+    # a congested old sample eventually slides out of the window
+    est.add(0.0, 5.0, 10.0)  # rtt 10
+    for i in range(4):
+        base = 20.0 + i
+        est.add(base, base + 0.5 + 0.001, base + 0.002)
+    assert est.rtt() == pytest.approx(0.002)
+    assert est.sample_count() == 4
+
+
+# ---- recorder: trace is a non-identity field ------------------------------
+def test_trace_excluded_from_identity_and_digest():
+    a, b = FlightRecorder(), FlightRecorder()
+    a.record("resize", {"world_size": 2}, step=5, generation=1)
+    b.set_trace("feedc0de00112233")
+    b.record("resize", {"world_size": 2}, step=5, generation=1)
+    assert a.digest() == b.digest()
+    ev = b.events()[-1]
+    assert ev.trace == "feedc0de00112233"
+    assert ev.to_dict()["trace"] == "feedc0de00112233"
+    assert "trace" not in ev.identity()
+    # clearing the ambient trace stops stamping
+    b.set_trace("")
+    assert b.record("resize", {}, step=6, generation=1).trace == ""
+
+
+def test_ingest_preserves_wall_and_trace():
+    """The coordinator must NOT re-stamp member events with its own
+    clock or drop their trace ids — the merged timeline's ordering
+    and causal chains both depend on the originals."""
+    member = FlightRecorder(clock=lambda: 1234.5)
+    member.record("consensus.vote", {"proposed_stop": 9}, trace="abc123")
+    coord = FlightRecorder(clock=lambda: 9999.0)
+    coord.ingest([e.to_dict() for e in member.events()], origin="w1")
+    got = coord.events()[-1]
+    assert got.wall == pytest.approx(1234.5)
+    assert got.trace == "abc123"
+    assert got.data["origin"] == "w1"
+
+
+# ---- recorder: spill hardening --------------------------------------------
+def test_spill_rotation_bounds_file_size(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    rec = FlightRecorder(spill_path=path, spill_max_mb=0.001)  # ~1KB
+    for i in range(200):
+        rec.record("resize", {"world_size": i}, step=i, generation=0)
+    import os
+
+    live = os.path.getsize(path)
+    assert live <= 1200  # bounded (one line of slack over 1KB)
+    assert os.path.exists(path + ".1")  # rotated predecessor kept
+    assert os.path.getsize(path + ".1") <= 1200
+    # the ring still holds everything regardless of rotation
+    assert len(rec) == 200
+
+
+def test_spill_failure_counts_drops_and_recovers(tmp_path):
+    clock = [100.0]
+    with telemetry.scoped() as (reg, _):
+        rec = FlightRecorder(
+            spill_path=str(tmp_path / "nodir" / "x.jsonl"),
+            clock=lambda: clock[0],
+        )
+        rec.record("resize", {}, step=1, generation=0)  # open fails
+        rec.record("resize", {}, step=2, generation=0)  # in backoff
+        drops = reg.counter("edl_flight_spill_dropped_total").value()
+        assert drops == 2 and rec.spill_dropped == 2
+        # the directory appears and the backoff window passes: the
+        # spill recovers instead of staying disabled forever
+        (tmp_path / "nodir").mkdir()
+        clock[0] += 10.0
+        rec.record("resize", {}, step=3, generation=0)
+        spilled = load_journal(str(tmp_path / "nodir" / "x.jsonl"))
+        assert [e["step"] for e in spilled] == [3]
+        assert reg.counter("edl_flight_spill_dropped_total").value() == 2
+
+
+# ---- goodput ledger --------------------------------------------------------
+def test_goodput_ledger_transitions_and_decomposition():
+    clock = [0.0]
+    with telemetry.scoped() as (reg, _):
+        led = GoodputLedger(registry=reg, clock=lambda: clock[0])
+        led.transition("stepping")
+        clock[0] = 8.0
+        led.note_staging(2.0)  # 2 of the 8s were host batch stalls
+        led.transition("resizing")
+        clock[0] = 9.0
+        led.split_resize({"flush": 0.25, "restore": 0.5})
+        led.transition("replaying")
+        clock[0] = 10.0
+        led.transition("stepping")
+        clock[0] = 14.0
+        led.transition("holding")
+        gp = goodput_decomposition(reg.snapshot())
+    assert gp is not None
+    s = gp["seconds"]
+    assert s["stepping"] == pytest.approx(10.0)
+    assert s["staging_stalled"] == pytest.approx(2.0)
+    assert s["replaying"] == pytest.approx(1.0)
+    # the resize second decomposes into its measured phases + remainder
+    assert s["resizing:flush"] == pytest.approx(0.25)
+    assert s["resizing:restore"] == pytest.approx(0.5)
+    assert s["resizing"] == pytest.approx(0.25)
+    assert gp["total_s"] == pytest.approx(14.0)
+    assert gp["frac"] == pytest.approx(10.0 / 14.0)
+
+
+def test_goodput_ledger_touch_keeps_counters_fresh():
+    clock = [0.0]
+    with telemetry.scoped() as (reg, _):
+        led = GoodputLedger(registry=reg, clock=lambda: clock[0])
+        led.transition("stepping")
+        clock[0] = 5.0
+        led.touch()  # long steady state, no transition
+        gp = goodput_decomposition(reg.snapshot())
+        assert gp["seconds"]["stepping"] == pytest.approx(5.0)
+        assert reg.gauge("edl_goodput_frac").value() == pytest.approx(1.0)
+    assert goodput_decomposition({"counters": {}}) is None
+
+
+# ---- coordinator propagation ----------------------------------------------
+def test_plan_trace_rides_prewarm_and_retarget():
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=4)
+    coord.register("a")
+    coord.register("b")
+    join_trace = coord.plan().trace_id
+    assert join_trace  # membership churn mints its own
+    coord.set_prewarm(2, trace_id="aa11bb22cc33dd44")
+    plan = coord.plan()
+    assert plan.prewarm == 2
+    assert plan.prewarm_trace == "aa11bb22cc33dd44"
+    assert plan.trace_id == join_trace  # hint never changes the gen's id
+    coord.set_target_world(2, trace_id="aa11bb22cc33dd44")
+    plan = coord.plan()
+    assert plan.trace_id == "aa11bb22cc33dd44"
+    evs = coord.recorder().events()
+    retarget = [e for e in evs if e.data.get("reason") == "retarget"]
+    assert retarget and retarget[-1].trace == "aa11bb22cc33dd44"
+    # world_acked journals under the same chain
+    coord.ack_generation("a", plan.generation)
+    coord.ack_generation("b", plan.generation)
+    acked = [e for e in coord.recorder().events()
+             if e.kind == "coord.world_acked"]
+    assert acked and acked[-1].trace == "aa11bb22cc33dd44"
+
+
+def test_scale_up_joins_inherit_the_actuation_trace():
+    """Production scale-up order: the retarget lands BEFORE the new
+    pods exist (the PUT creates them).  The join rebuilds that grow
+    the world toward the target are that same decision landing — they
+    must journal under its id, not a fresh join-minted one; once the
+    target is reached (or an unrelated join arrives) minting resumes."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=4)
+    coord.register("a")
+    gen = coord.plan().generation
+    coord.set_target_world(3, trace_id="deadbeefdeadbeef")
+    # the active world is unchanged, so the retarget itself rebuilds
+    # nothing (no spurious resize barrier) ...
+    assert coord.plan().generation == gen
+    # ... the decision's pods register: each growth join IS the
+    # decision landing and continues its chain
+    coord.register("b")
+    assert coord.plan().trace_id == "deadbeefdeadbeef"
+    assert coord.plan().world_size == 2
+    coord.register("c")
+    assert coord.plan().trace_id == "deadbeefdeadbeef"
+    assert coord.plan().world_size == 3
+    # target reached: a later (standby-breaking) membership change
+    # mints its own id again
+    coord.register("d")
+    coord.deregister("c")
+    plan = coord.plan()
+    assert plan.trace_id and plan.trace_id != "deadbeefdeadbeef"
+    # a no-op retarget must not leave a stale pending trace behind for
+    # an unrelated later retarget to consume
+    coord.set_prewarm(3, trace_id="aaaaaaaaaaaaaaaa")
+    coord.set_target_world(3, trace_id="aaaaaaaaaaaaaaaa")  # no-op
+    coord.set_target_world(2)
+    assert coord.plan().trace_id != "aaaaaaaaaaaaaaaa"
+    # ...nor may a pending trace survive a retarget whose rebuild
+    # early-returned (active world unchanged — pods not yet
+    # registered): after the scale-up CONVERGES via joins, a later
+    # traceless retarget must not inherit the old decision's id
+    coord2 = LocalCoordinator(target_world=1, max_world=4)
+    coord2.register("x")
+    coord2.set_target_world(3, trace_id="bbbbbbbbbbbbbbbb")
+    coord2.register("y")
+    coord2.register("z")  # converged: world 3, all under B
+    assert coord2.plan().trace_id == "bbbbbbbbbbbbbbbb"
+    coord2.set_target_world(2)  # unrelated, traceless shrink
+    plan2 = coord2.plan()
+    assert plan2.world_size == 2
+    assert plan2.trace_id != "bbbbbbbbbbbbbbbb"
+    # ...and a trace staged by a prewarm whose retarget PUT never
+    # landed (conflict-storm give-up) must not bleed onto a later
+    # traceless retarget by a different actor (operator CLI / chaos)
+    coord3 = LocalCoordinator(target_world=2, max_world=4)
+    coord3.register("p")
+    coord3.register("q")
+    coord3.set_prewarm(4, trace_id="cccccccccccccccc")
+    coord3.set_target_world(1)  # different actor, traceless
+    assert coord3.plan().world_size == 1
+    assert coord3.plan().trace_id != "cccccccccccccccc"
+
+
+def test_http_heartbeat_feeds_clock_and_telemetry_offsets():
+    from edl_tpu.runtime.coord_service import (
+        CoordinatorServer,
+        HTTPCoordinator,
+    )
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=2)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    try:
+        client = HTTPCoordinator(f"127.0.0.1:{server.port}")
+        client.register("w1", address="127.0.0.1:9")
+        client.heartbeat("w1", step=3)
+        assert client.clock_estimator.sample_count() >= 1
+        # same machine: the estimated offset is ~0
+        assert abs(client.clock_estimator.offset()) < 1.0
+        client.report_telemetry("w1", snapshot={}, seq=1, boot="b1")
+        offs = coord.telemetry()["clock_offsets"]
+        assert "w1" in offs and abs(offs["w1"]) < 1.0
+        # the retargeted plan's trace id survives the HTTP round trip
+        client.set_target_world(2, trace_id="0123456789abcdef")
+        coord.register("w2")
+        assert client.plan().trace_id
+    finally:
+        server.stop()
+
+
+# ---- the merged timeline ---------------------------------------------------
+def _ev(member, kind, wall, trace="", timing=None, seq=1, **data):
+    d = {
+        "seq": seq,
+        "step": data.pop("step", 0),
+        "generation": 1,
+        "kind": kind,
+        "data": data,
+        "wall": wall,
+    }
+    if trace:
+        d["trace"] = trace
+    if timing:
+        d["timing"] = timing
+    return d
+
+
+def test_merge_events_aligns_skewed_member_clocks():
+    """w2's wall clock is 100s ahead; after applying its estimated
+    offset the causal order (coordinator plan -> w2 vote -> w1 resize)
+    is restored."""
+    streams = {
+        "coordinator": [_ev("c", "coord.plan", 1000.0, trace="t1")],
+        "w1": [_ev("w1", "resize", 1002.0, trace="t1")],
+        "w2": [_ev("w2", "consensus.vote", 1101.0, trace="t1")],
+    }
+    merged = merge_events(streams, offsets={"w2": -100.0})
+    assert [e["kind"] for e in merged] == [
+        "coord.plan",
+        "consensus.vote",
+        "resize",
+    ]
+    assert merged[1]["wall_aligned"] == pytest.approx(1001.0)
+    chains = trace_chains(merged)
+    assert set(chains) == {"t1"} and len(chains["t1"]) == 3
+
+
+def test_chrome_trace_lanes_slices_and_filter():
+    events = merge_events(
+        {
+            "w1": [
+                _ev(
+                    "w1",
+                    "resize",
+                    50.0,
+                    trace="tt",
+                    timing={
+                        "seconds": 2.0,
+                        "phases": {"flush": 0.5, "restore": 1.0,
+                                   "compile": 1.2},
+                    },
+                    step=7,
+                    world_size=2,
+                ),
+                _ev("w1", "step.first", 50.5, trace="tt", step=8),
+            ],
+            "w2": [_ev("w2", "consensus.quiesce", 49.5, trace="other")],
+        }
+    )
+    doc = chrome_trace(events)
+    evs = doc["traceEvents"]
+    procs = {e["args"]["name"] for e in evs if e["name"] == "process_name"}
+    assert procs == {"w1", "w2"}
+    threads = {e["args"]["name"] for e in evs if e["name"] == "thread_name"}
+    assert {"resize", "step", "consensus"} <= threads
+    slices = [e for e in evs if e.get("ph") == "X"]
+    names = {e["name"] for e in slices}
+    # the window slice + serial phase children + the overlapped compile
+    assert {"resize", "resize/flush", "resize/restore",
+            "resize/compile"} <= names
+    window = next(e for e in slices if e["name"] == "resize")
+    flush = next(e for e in slices if e["name"] == "resize/flush")
+    restore = next(e for e in slices if e["name"] == "resize/restore")
+    assert window["dur"] == pytest.approx(2e6)
+    # serial phases lay out back-to-back from the window start
+    assert flush["ts"] == pytest.approx(window["ts"])
+    assert restore["ts"] == pytest.approx(window["ts"] + 0.5e6)
+    instants = [e for e in evs if e.get("ph") == "i"]
+    assert {"step.first", "consensus.quiesce"} == {
+        e["name"] for e in instants
+    }
+    # filtering to one causal chain drops the other member's event
+    only = chrome_trace(events, trace_id="tt")
+    kinds = {e["name"] for e in only["traceEvents"]
+             if e["ph"] not in ("M",)}
+    assert "consensus.quiesce" not in kinds
+    assert "step.first" in kinds
+
+
+def test_member_streams_splits_coordinator_journal():
+    evs = [
+        _ev("c", "coord.plan", 1.0),
+        {**_ev("c", "resize", 2.0), "data": {"origin": "w1"}},
+    ]
+    streams = member_streams(evs)
+    assert set(streams) == {"coordinator", "w1"}
+
+
+# ---- in-process end-to-end: one trace id across a local resize -------------
+def test_local_resize_events_share_minted_trace():
+    import optax
+
+    from edl_tpu.models import get_model
+    from edl_tpu.runtime import ShardedDataIterator
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.data import synthetic_dataset
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    model = get_model("fit_a_line")
+    ds = synthetic_dataset(model.synth_batch, 512, seed=0)
+    with telemetry.scoped() as (reg, rec):
+        coord = LocalCoordinator(target_world=2, max_world=8)
+        for i in range(4):
+            coord.register(f"tr{i}")
+        et = ElasticTrainer(
+            model,
+            optax.adam(1e-2),
+            ShardedDataIterator(ds, global_batch_size=64, seed=0),
+            coord,
+            checkpoint_interval=5,
+        )
+        # Run past the step-5 interval save so the traced resize's
+        # flush is a FRESH flush (a step-5 resize would dedupe against
+        # the interval checkpoint and journal no flush of its own).
+        et.run(6)
+        # the autoscaler's half, in miniature: hint then retarget
+        # under one minted trace id
+        trace = "11fe11fe11fe11fe"
+        coord.set_prewarm(4, trace_id=trace)
+        et.run(8)  # steady state consumes the hint (background warm)
+        th = et._prewarm_threads.get(4)
+        if th is not None:
+            th.join(timeout=120)
+        coord.set_target_world(4, trace_id=trace)
+        et.run(12)
+        et.store.wait()
+        events = rec.events()
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e.kind, []).append(e)
+        # the resize into world 4 and its first step share the id
+        assert any(
+            e.trace == trace and e.data["world_size"] == 4
+            for e in by_kind["resize"]
+        )
+        assert any(e.trace == trace for e in by_kind["step.first"])
+        # the flush checkpoint journaled inside the window too
+        assert any(
+            e.trace == trace and e.data.get("kind") == "flush"
+            for e in by_kind.get("checkpoint.save", [])
+        )
+        # the background warm journaled under the hint's trace
+        assert any(
+            e.trace == trace for e in by_kind.get("prewarm.hint", [])
+        )
+        # steady-state events after step.first are NOT charged to it
+        last_first = max(
+            e.seq for e in by_kind["step.first"] if e.trace == trace
+        )
+        later = [e for e in events if e.seq > last_first]
+        assert all(e.trace != trace for e in later)
+        # the goodput ledger attributed the run
+        gp = goodput_decomposition(reg.snapshot())
+        assert gp is not None and gp["seconds"]["stepping"] > 0
+        assert 0.0 < gp["frac"] <= 1.0
+        assert et.ledger.totals.get("resizing") is not None
+
+
+# ---- profiler re-arm -------------------------------------------------------
+def _fake_profiler(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop", None))
+    )
+    return calls
+
+
+def test_profiler_at_step_defers_window(tmp_path, monkeypatch):
+    from edl_tpu.utils.profiling import StepProfiler
+
+    calls = _fake_profiler(monkeypatch)
+    p = StepProfiler(
+        profile_dir=str(tmp_path), max_steps=2, at_step=10
+    )
+    p.maybe_start(0)
+    assert not p.tracing and not calls
+    p.maybe_start(10)
+    assert p.tracing
+    with p.step(10):
+        pass
+    with p.step(11):
+        pass
+    p.maybe_stop()
+    assert not p.tracing
+    assert [c[0] for c in calls] == ["start", "stop"]
+    # window closed: no restart without a rearm
+    p.maybe_start(12)
+    assert not p.tracing
+
+
+def test_profiler_rearm_on_resize_opens_second_window(
+    tmp_path, monkeypatch
+):
+    from edl_tpu.utils.profiling import StepProfiler
+
+    calls = _fake_profiler(monkeypatch)
+    p = StepProfiler(
+        profile_dir=str(tmp_path), max_steps=1, rearm_on_resize=True
+    )
+    p.maybe_start(0)
+    with p.step(0):
+        pass
+    p.maybe_stop()
+    assert [c[0] for c in calls] == ["start", "stop"]
+    p.note_resize()  # the resize re-arms a fresh bounded window
+    p.maybe_start(5)
+    assert p.tracing
+    with p.step(5):
+        pass
+    p.maybe_stop()
+    assert [c[0] for c in calls] == ["start", "stop", "start", "stop"]
+
+
+def test_profiler_windows_journal_flight_events(tmp_path, monkeypatch):
+    from edl_tpu.utils.profiling import StepProfiler
+
+    _fake_profiler(monkeypatch)
+    with telemetry.scoped() as (_, rec):
+        p = StepProfiler(profile_dir=str(tmp_path), max_steps=1)
+        p.maybe_start(3)
+        with p.step(3):
+            pass
+        p.maybe_stop()
+        kinds = [
+            (e.kind, e.data.get("phase")) for e in rec.events()
+        ]
+    assert ("profile.window", "open") in kinds
+    assert ("profile.window", "close") in kinds
+
+
+# ---- lint: flight-event kinds are registry-checked ------------------------
+def test_lint_rejects_unregistered_event_kind(tmp_path):
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    try:
+        import lint
+    finally:
+        _sys.path.pop(0)
+
+    bad = tmp_path / "edl_tpu" / "mod.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(
+        'def f(rec, k):\n'
+        '    rec.record("resize.oops")\n'
+        '    rec.record(k)\n'
+        '    rec.record("resize")\n'
+    )
+    msgs = [m for _, m in lint.lint_file(bad)]
+    assert any("unregistered flight-event kind" in m for m in msgs)
+    assert any("free-form event kind" in m for m in msgs)
+    assert sum("event kind" in m for m in msgs) == 2
+
+
+def test_known_event_kinds_covers_every_recorded_kind():
+    """Every kind the runtime actually records must be cataloged (the
+    lint gate enforces literals; this guards the catalog's claim that
+    it is exhaustive for the in-tree writers)."""
+    from edl_tpu.telemetry import KNOWN_EVENT_KINDS
+
+    for kind in (
+        "resize",
+        "step.first",
+        "consensus.vote",
+        "consensus.stop",
+        "consensus.quiesce",
+        "coord.plan",
+        "coord.world_acked",
+        "autoscaler.decision",
+        "prewarm.hint",
+        "profile.window",
+    ):
+        assert kind in KNOWN_EVENT_KINDS
+
+
+# ---- edl trace CLI ---------------------------------------------------------
+def test_trace_cli_merges_journals_post_mortem(tmp_path, capsys):
+    from edl_tpu.cli import main
+
+    j1 = tmp_path / "w1.jsonl"
+    j2 = tmp_path / "w2.jsonl"
+    j1.write_text(
+        json.dumps(
+            _ev("w1", "resize", 10.0, trace="cafe", seq=1,
+                timing={"seconds": 1.0}, world_size=2)
+        )
+        + "\n"
+        + json.dumps(_ev("w1", "step.first", 10.2, trace="cafe", seq=2))
+        + "\n"
+    )
+    j2.write_text(
+        json.dumps(
+            _ev("w2", "consensus.quiesce", 9.8, trace="cafe", seq=1)
+        )
+        + "\n"
+    )
+    out = tmp_path / "merged.json"
+    rc = main(
+        [
+            "trace",
+            "--journal", f"w1={j1}",
+            "--journal", f"w2={j2}",
+            "--out", str(out),
+            "--summary",
+        ]
+    )
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "causal chains (1)" in printed
+    assert "cafe" in printed
+    assert "goodput" in printed
+    doc = json.loads(out.read_text())
+    procs = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["name"] == "process_name"
+    }
+    assert procs == {"w1", "w2"}
+    assert any(e.get("ph") == "X" for e in doc["traceEvents"])
+
+
+def test_trace_cli_summary_prints_goodput_from_live_coordinator(
+    tmp_path, capsys
+):
+    from edl_tpu.cli import main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.telemetry import MetricsRegistry
+
+    coord = LocalCoordinator(target_world=1, max_world=2)
+    coord.register("a")
+    reg = MetricsRegistry()
+    m = reg.counter("edl_goodput_seconds_total")
+    m.inc(9.0, state="stepping")
+    m.inc(1.0, state="resizing")
+    coord.report_telemetry(
+        "a",
+        snapshot=reg.snapshot(),
+        seq=1,
+        boot="b",
+        clock={"offset": 0.001, "rtt": 0.002},
+        events=[_ev("a", "resize", 5.0, trace="beef")],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    out = tmp_path / "t.json"
+    try:
+        rc = main(
+            [
+                "trace",
+                f"127.0.0.1:{server.port}",
+                "--out", str(out),
+                "--summary",
+            ]
+        )
+    finally:
+        server.stop()
+    assert rc == 0
+    printed = capsys.readouterr().out
+    assert "frac" in printed and "0.9000" in printed
+    assert "stepping" in printed
+    assert "clock offset a" in printed
+    assert out.exists()

@@ -24,6 +24,11 @@ Checks, all stdlib:
   point declared in ``edl_tpu/chaos/schedule.py``'s ``KNOWN_POINTS``
   — a typo'd point would otherwise silently never fire, turning a
   chaos test into a vacuous pass
+- unregistered flight-event kinds: every ``.record("...")`` call site
+  (outside tests/ and the recorder module itself, whose ingest path
+  legitimately passes computed kinds) must name an entry in
+  ``edl_tpu/telemetry/catalog.py``'s ``KNOWN_EVENT_KINDS`` — free-form
+  kinds are what make merged cluster timelines unreadable
 - blocking device fetches in the elastic hot loop: ``float(...)``,
   ``int(...)`` and ``.item()`` calls inside ``ElasticTrainer.run`` are
   rejected — the async step pipeline keeps metrics as device futures
@@ -56,6 +61,13 @@ CHAOS_METHODS = {"due", "maybe_raise", "roll", "rng"}
 #: computed point names (event delivery iterates the schedule)
 CHAOS_REGISTRY = ("edl_tpu", "chaos", "schedule.py")
 
+#: FlightRecorder methods whose first argument is an event kind
+EVENT_METHODS = {"record"}
+
+#: the recorder module itself — ``ingest`` re-records already
+#: serialized events under their (computed) original kinds
+EVENT_REGISTRY = ("edl_tpu", "telemetry", "recorder.py")
+
 #: (class, methods) whose bodies form the elastic hot loop: blocking
 #: device fetches are banned there (see _hot_loop_findings)
 HOT_LOOP_CLASS = "ElasticTrainer"
@@ -69,6 +81,74 @@ BLOCKING_CASTS = {"float", "int"}
 
 _CATALOG_CACHE = [False, None]  # [loaded, names-or-None]
 _CHAOS_CACHE = [False, None]  # [loaded, points-or-None]
+_KINDS_CACHE = [False, None]  # [loaded, kinds-or-None]
+
+
+def _literal_from(path: Path, var: str):
+    """The set of keys/items of a module-level pure-literal assignment
+    named ``var`` in ``path``; None when absent/unparseable."""
+    try:
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == var:
+                        return set(ast.literal_eval(node.value))
+    except (OSError, SyntaxError, ValueError):
+        pass
+    return None
+
+
+def _event_kind_registry():
+    """Event kinds declared in edl_tpu/telemetry/catalog.py's
+    KNOWN_EVENT_KINDS (a pure dict literal; the set of its keys).
+    None when absent/unparseable — the check then degrades to
+    literal-ness only."""
+    if not _KINDS_CACHE[0]:
+        _KINDS_CACHE[0] = True
+        _KINDS_CACHE[1] = _literal_from(
+            Path(__file__).resolve().parent.parent
+            / "edl_tpu"
+            / "telemetry"
+            / "catalog.py",
+            "KNOWN_EVENT_KINDS",
+        )
+    return _KINDS_CACHE[1]
+
+
+def _event_kind_findings(tree: ast.AST, path: Path):
+    """Reject unregistered / free-form flight-event kinds — the third
+    leg of the catalog-strict family (metrics, chaos points, event
+    kinds).  Free-form kinds don't fail at runtime; they just turn the
+    merged timeline into an accretion of strings nobody can lane."""
+    if "tests" in path.parts or path.parts[-3:] == EVENT_REGISTRY:
+        return
+    registry = _event_kind_registry()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute) and f.attr in EVENT_METHODS
+        ):
+            continue
+        if not node.args:
+            continue
+        a = node.args[0]
+        if not (isinstance(a, ast.Constant) and isinstance(a.value, str)):
+            if isinstance(a, ast.Constant):
+                continue  # not an event kind (e.g. some .record(5))
+            yield node.lineno, (
+                f"free-form event kind passed to .{f.attr}() — flight-"
+                "event kinds must be string literals from "
+                "telemetry/catalog.py KNOWN_EVENT_KINDS"
+            )
+            continue
+        if registry is not None and a.value not in registry:
+            yield node.lineno, (
+                f"unregistered flight-event kind {a.value!r} — declare "
+                "it in edl_tpu/telemetry/catalog.py KNOWN_EVENT_KINDS"
+            )
 
 
 def _metric_catalog():
@@ -78,23 +158,13 @@ def _metric_catalog():
     the check then degrades to literal-ness only."""
     if not _CATALOG_CACHE[0]:
         _CATALOG_CACHE[0] = True
-        path = (
+        _CATALOG_CACHE[1] = _literal_from(
             Path(__file__).resolve().parent.parent
             / "edl_tpu"
             / "telemetry"
-            / "catalog.py"
+            / "catalog.py",
+            "CATALOG",
         )
-        try:
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Assign):
-                    for t in node.targets:
-                        if isinstance(t, ast.Name) and t.id == "CATALOG":
-                            _CATALOG_CACHE[1] = set(
-                                ast.literal_eval(node.value)
-                            )
-        except (OSError, SyntaxError, ValueError):
-            pass
     return _CATALOG_CACHE[1]
 
 
@@ -105,23 +175,12 @@ def _chaos_registry():
     the check then degrades to literal-ness only."""
     if not _CHAOS_CACHE[0]:
         _CHAOS_CACHE[0] = True
-        path = Path(__file__).resolve().parent.parent.joinpath(
-            *CHAOS_REGISTRY
+        _CHAOS_CACHE[1] = _literal_from(
+            Path(__file__).resolve().parent.parent.joinpath(
+                *CHAOS_REGISTRY
+            ),
+            "KNOWN_POINTS",
         )
-        try:
-            tree = ast.parse(path.read_text())
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Assign):
-                    for t in node.targets:
-                        if (
-                            isinstance(t, ast.Name)
-                            and t.id == "KNOWN_POINTS"
-                        ):
-                            _CHAOS_CACHE[1] = set(
-                                ast.literal_eval(node.value)
-                            )
-        except (OSError, SyntaxError, ValueError):
-            pass
     return _CHAOS_CACHE[1]
 
 
@@ -293,6 +352,7 @@ def _ast_findings(tree: ast.AST, path: Path, sanctioned: set = frozenset()):
     yield from _unused_imports(tree, path)
     yield from _metric_name_findings(tree, path)
     yield from _chaos_point_findings(tree, path)
+    yield from _event_kind_findings(tree, path)
     yield from _hot_loop_findings(tree, path, sanctioned)
     # f-string format specs are themselves JoinedStr nodes with no
     # FormattedValue (f"{x:02d}" nests JoinedStr(['02d'])): exclude

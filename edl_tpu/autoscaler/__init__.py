@@ -14,8 +14,11 @@ from edl_tpu.autoscaler.algorithm import (
     scale_all_jobs_dry_run,
 )
 from edl_tpu.autoscaler.scaler import Autoscaler, ScalePlan
+from edl_tpu.autoscaler.serving import ServingLane, attach_serving_lane
 
 __all__ = [
+    "ServingLane",
+    "attach_serving_lane",
     "JobView",
     "PendingDemand",
     "fulfillment",

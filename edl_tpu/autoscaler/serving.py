@@ -1,0 +1,300 @@
+"""The autoscaler's serving lane: scale inference replicas on observed
+p95 latency and queue depth.
+
+The training lane (``scaler.Autoscaler``) scales on cluster headroom
+and goodput; serving load is a different signal with the same
+actuation shape.  Each tick reads the serving coordinator's merged
+``/telemetry`` (the PR 4/7 plumbing — replicas ship their registry
+snapshots on the heartbeat cadence), derives:
+
+- ``p95``: the 95th percentile of ``edl_serve_latency_seconds`` over a
+  sliding window of merged snapshots (cumulative histograms are
+  monotone, so the WINDOW DELTA is the recent-traffic histogram — a
+  cold morning's backlog must not pin p95 high all day),
+- ``queue_depth``: the max ``edl_serve_queue_depth`` gauge across
+  replicas,
+
+and actuates through the SAME handshake as training: mint a trace id,
+announce the incoming replica count via ``/prewarm`` (a joining
+replica warms its bucketed forwards before taking traffic —
+``ServingReplica.start``'s warm-before-register honors the hint's
+contract), then retarget.  Every decision journals into the bounded
+``decision_log`` and the flight recorder under the minted id, so
+``edl trace`` reconstructs decision -> plan -> replica-registered ->
+first-request chains exactly like training resizes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from edl_tpu.telemetry.aggregate import histogram_quantile
+
+
+class ServingLane:
+    """One serving fleet's scaling loop (drive ``run_once`` from the
+    controller tick, or ``run`` on a thread).
+
+    ``coordinator``: the serving world's coordinator client (Local or
+    HTTP — anything with ``telemetry``/``metrics``/``set_prewarm``/
+    ``set_target_world``).  ``on_scale``: optional hook called with
+    (old, new) after a successful retarget — the kube glue point where
+    a Deployment's replica count follows the coordinator target (tests
+    and local sim drive replica processes directly)."""
+
+    def __init__(
+        self,
+        coordinator,
+        min_replicas: int = 1,
+        max_replicas: int = 4,
+        p95_high_s: float = 0.5,
+        p95_low_s: float = 0.05,
+        queue_high: int = 8,
+        hold_ticks: int = 2,
+        on_scale=None,
+    ):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(
+                f"bad replica bounds [{min_replicas}, {max_replicas}]"
+            )
+        self.coordinator = coordinator
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.p95_high_s = p95_high_s
+        self.p95_low_s = p95_low_s
+        self.queue_high = queue_high
+        #: consecutive low-load ticks required before shedding a
+        #: replica (scale-down hysteresis: one quiet tick must not
+        #: thrash the fleet a request burst will want back)
+        self.hold_ticks = max(1, hold_ticks)
+        self.on_scale = on_scale
+        self._low_ticks = 0
+        #: cumulative rejected-request count at the previous tick: the
+        #: overload signal is the per-tick DELTA, not the lifetime
+        #: total (one historical 429 must not pin the fleet at max)
+        self._last_rejected: Optional[float] = None
+        #: sliding window of (requests_count, latency histogram) from
+        #: merged snapshots — p95 is computed over the window DELTA
+        self._hist_window: List[dict] = []
+        self.hist_window_len = 8
+        self.decision_log: List[dict] = []
+        self.decision_log_max = 256
+
+        from edl_tpu import telemetry
+
+        self._recorder = telemetry.get_recorder()
+        reg = telemetry.get_registry()
+        self._m_ticks = reg.counter("edl_autoscaler_ticks_total")
+        self._m_actuations = reg.counter("edl_autoscaler_actuations_total")
+
+    # -- observation --------------------------------------------------------
+    def _window_p95(self, hist: Optional[dict]) -> Optional[float]:
+        """p95 over the recent window: cumulative histogram now minus
+        the oldest snapshot in the window (falls back to the full
+        cumulative series until the window fills)."""
+        if not hist:
+            return None
+        merged = {"": hist} if "counts" in hist else hist
+        # Collapse label-keyed series into one (unlabeled in practice).
+        base = None
+        for h in merged.values():
+            if base is None:
+                base = {
+                    "buckets": list(h["buckets"]),
+                    "counts": list(h["counts"]),
+                    "count": h["count"],
+                }
+            elif list(h["buckets"]) == base["buckets"]:
+                base["counts"] = [
+                    a + b for a, b in zip(base["counts"], h["counts"])
+                ]
+                base["count"] += h["count"]
+        if base is None:
+            return None
+        self._hist_window.append(base)
+        del self._hist_window[: -self.hist_window_len]
+        oldest = self._hist_window[0]
+        if oldest is base or list(oldest["buckets"]) != base["buckets"]:
+            return histogram_quantile(base, 0.95)
+        delta = {
+            "buckets": base["buckets"],
+            "counts": [
+                max(0.0, a - b)
+                for a, b in zip(base["counts"], oldest["counts"])
+            ],
+        }
+        delta["count"] = sum(delta["counts"])
+        if not delta["count"]:
+            return None  # no recent traffic: latency says nothing
+        return histogram_quantile(delta, 0.95)
+
+    def observe(self) -> Dict[str, Optional[float]]:
+        """One read of the serving coordinator's merged telemetry."""
+        tel = self.coordinator.telemetry() or {}
+        merged = tel.get("merged") or {}
+        hists = merged.get("histograms") or {}
+        gauges = merged.get("gauges") or {}
+        counters = merged.get("counters") or {}
+        depth_series = gauges.get("edl_serve_queue_depth") or {}
+        req_series = counters.get("edl_serve_requests_total") or {}
+        rejected_cum = sum(
+            v for k, v in req_series.items() if "status=rejected" in k
+        )
+        # Rejections since the LAST tick: the cumulative counter only
+        # grows, so its lifetime value says nothing about load NOW.
+        # The FIRST tick only records the baseline (a restarted lane
+        # reading a fleet's lifetime total must not actuate a spurious
+        # scale-up for a burst that happened hours ago).
+        rejected_new = (
+            max(0.0, rejected_cum - self._last_rejected)
+            if self._last_rejected is not None
+            else 0.0
+        )
+        self._last_rejected = rejected_cum
+        return {
+            "p95_latency_s": self._window_p95(
+                hists.get("edl_serve_latency_seconds")
+            ),
+            "queue_depth": (
+                max(depth_series.values()) if depth_series else None
+            ),
+            "requests_total": sum(req_series.values()) or None,
+            "rejected_total": rejected_new or None,
+        }
+
+    # -- one decision cycle -------------------------------------------------
+    def run_once(self) -> Optional[dict]:
+        """Observe -> propose -> actuate -> journal.  Returns the
+        decision entry (None when the coordinator is unreachable)."""
+        try:
+            obs = self.observe()
+            snap = self.coordinator.metrics() or {}
+        except Exception:
+            return None
+        self._m_ticks.inc()
+        current = int(
+            snap.get("target_world") or snap.get("world_size") or 0
+        ) or self.min_replicas
+        p95 = obs.get("p95_latency_s")
+        depth = obs.get("queue_depth") or 0
+        rejected = obs.get("rejected_total")
+        overloaded = (
+            (p95 is not None and p95 > self.p95_high_s)
+            or depth >= self.queue_high
+            or bool(rejected)
+        )
+        idle = (
+            not overloaded
+            and depth == 0
+            and (p95 is None or p95 < self.p95_low_s)
+        )
+        proposed = current
+        if overloaded:
+            proposed = min(current + 1, self.max_replicas)
+            self._low_ticks = 0
+            reason = (
+                f"overloaded (p95={p95 if p95 is None else round(p95, 4)}s"
+                f" queue={depth} rejected={rejected or 0})"
+            )
+        elif idle:
+            self._low_ticks += 1
+            if self._low_ticks >= self.hold_ticks:
+                proposed = max(current - 1, self.min_replicas)
+                reason = (
+                    f"idle {self._low_ticks} ticks "
+                    f"(p95={p95 if p95 is None else round(p95, 4)}s)"
+                )
+            else:
+                reason = (
+                    f"idle tick {self._low_ticks}/{self.hold_ticks} "
+                    "(hysteresis hold)"
+                )
+        else:
+            self._low_ticks = 0
+            reason = "within band"
+        actuated = False
+        trace_id = ""
+        if proposed != current:
+            from edl_tpu import telemetry
+
+            trace_id = telemetry.new_trace_id()
+            # Prewarm FIRST (same ordering as the training lane's
+            # zero-stall handshake): a joining replica warms its
+            # bucketed forwards before the retarget routes traffic.
+            try:
+                self.coordinator.set_prewarm(proposed, trace_id=trace_id)
+            except Exception:
+                pass  # advisory; the retarget still scales
+            try:
+                self.coordinator.set_target_world(
+                    proposed, trace_id=trace_id
+                )
+                actuated = True
+                self._m_actuations.inc(
+                    direction="up" if proposed > current else "down"
+                )
+                if self.on_scale is not None:
+                    try:
+                        self.on_scale(current, proposed)
+                    except Exception:
+                        pass  # kube glue is best-effort; journal stands
+            except Exception as e:
+                reason += f"; retarget failed ({e})"
+        entry = {
+            "lane": "serving",
+            "dry_run": {
+                "current": current,
+                "proposed": proposed,
+                "diff": proposed - current,
+            },
+            "observed": obs,
+            "actuated": actuated,
+            "reason": reason,
+            "trace_id": trace_id,
+        }
+        self.decision_log.append(entry)
+        del self.decision_log[: -self.decision_log_max]
+        data = {k: v for k, v in entry.items() if k != "trace_id"}
+        self._recorder.record("autoscaler.decision", data, trace=trace_id)
+        return entry
+
+    def run(self, stop_event, loop_seconds: float = 5.0) -> None:
+        """Tick until ``stop_event`` is set (thread entry)."""
+        while not stop_event.wait(loop_seconds):
+            try:
+                self.run_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+
+def attach_serving_lane(autoscaler, lane: ServingLane) -> ServingLane:
+    """Ride a ServingLane on a training ``Autoscaler``'s tick: every
+    ``run_once`` of the training lane also ticks the serving lane, so
+    one control loop owns both workloads (the Pathways posture —
+    training and serving as one substrate).  Decisions flow into the
+    AUTOSCALER's decision log too, so ``edl trace`` and operators read
+    one journal."""
+    lanes = getattr(autoscaler, "serving_lanes", None)
+    if lanes is None:
+        lanes = autoscaler.serving_lanes = []
+        orig = autoscaler.run_once
+
+        def run_once(*args, **kwargs):
+            plan = orig(*args, **kwargs)
+            for sl in list(autoscaler.serving_lanes):
+                try:
+                    entry = sl.run_once()
+                except Exception:
+                    entry = None
+                if entry is not None:
+                    autoscaler.decision_log.append(entry)
+                    del autoscaler.decision_log[
+                        : -autoscaler.decision_log_max
+                    ]
+            return plan
+
+        autoscaler.run_once = run_once
+    lanes.append(lane)
+    return lane

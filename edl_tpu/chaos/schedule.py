@@ -72,6 +72,16 @@ KNOWN_POINTS = (
     "consensus.watchdog.trip",   # next guarded device fetch treated as
                                  # a wedged collective (deadline expiry
                                  # without the wait)
+    # (8) elastic inference serving (edl_tpu.serving)
+    "serve.swap.torn",           # corrupt the hot-swap candidate's bytes
+                                 # (latest_verified must reject it and
+                                 # the engine keep serving old weights)
+    "serve.request.slow",        # batcher worker stalls arg s before a
+                                 # dispatch (latency-histogram / p95
+                                 # scale-up signal under test control)
+    "serve.queue.full",          # force one admission rejection (the
+                                 # reject-with-retry-after backpressure
+                                 # path, independent of real depth)
 )
 
 

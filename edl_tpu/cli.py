@@ -69,11 +69,16 @@ def cmd_submit(args) -> int:
 def cmd_manifests(args) -> int:
     from edl_tpu.controller.jobparser import (
         parse_to_coordinator,
+        parse_to_serving_manifests,
         parse_to_trainer_manifests,
     )
 
     job = _load_job(args.spec)
-    objs = parse_to_trainer_manifests(job) + parse_to_coordinator(job)
+    objs = (
+        parse_to_trainer_manifests(job)
+        + parse_to_coordinator(job)
+        + parse_to_serving_manifests(job)
+    )
     print(_dump_yaml(objs))
     return 0
 
@@ -305,7 +310,42 @@ def cmd_metrics(args) -> int:
           f"{f'{rate:.3f} steps/s' if rate is not None else 'n/a'}")
     print(f"  {'resize_cost_seconds':<24} "
           f"{f'{cost:.3f}' if cost is not None else 'n/a'}")
-    counters = merged.get("counters") or {}
+    hists_all = merged.get("histograms") or {}
+    gauges_all = merged.get("gauges") or {}
+    counters_all = merged.get("counters") or {}
+    if any(
+        name.startswith("edl_serve_")
+        for section in (hists_all, gauges_all, counters_all)
+        for name in section
+    ):
+        # Serving fleet summary: the request-side signals the serving
+        # lane scales on, pre-digested (p50/p95 from the merged
+        # latency histogram, occupancy mean, requests by status).
+        from edl_tpu.telemetry.aggregate import histogram_quantile
+
+        print("serving")
+        lat = hists_all.get("edl_serve_latency_seconds")
+        for q, tag in ((0.5, "latency_p50"), (0.95, "latency_p95")):
+            v = histogram_quantile(lat, q) if lat else None
+            print(
+                f"  {tag:<24} "
+                f"{f'{v * 1000:.1f} ms' if v is not None else 'n/a'}"
+            )
+        occ = hists_all.get("edl_serve_batch_occupancy") or {}
+        tot = sum(h["count"] for h in occ.values())
+        if tot:
+            mean = sum(h["sum"] for h in occ.values()) / tot
+            print(f"  {'batch_occupancy_mean':<24} {mean:.3f}")
+        depth = gauges_all.get("edl_serve_queue_depth") or {}
+        if depth:
+            print(f"  {'queue_depth_max':<24} {max(depth.values()):g}")
+        wstep = gauges_all.get("edl_serve_weights_step") or {}
+        if wstep:
+            print(f"  {'weights_step':<24} {max(wstep.values()):g}")
+        req = counters_all.get("edl_serve_requests_total") or {}
+        for key in sorted(req):
+            print(f"  requests{{{key}}}{'':<10} {req[key]:g}")
+    counters = counters_all
     if counters:
         print("counters (merged across trainers)")
         for name in sorted(counters):
@@ -332,6 +372,74 @@ def cmd_metrics(args) -> int:
                 f"  step={ev.get('step'):<7} gen={ev.get('generation'):<4} "
                 f"{ev.get('kind'):<20} {data}"
             )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    """Run an elastic inference-serving replica (`edl serve --spec
+    job.yaml` or `edl serve --entrypoint mnist --checkpoint-dir d/`):
+    load the newest verified checkpoint, AOT-warm the padded-bucket
+    forwards, open the HTTP front (/predict /healthz /metrics), and —
+    when a serving coordinator is given — register into the serving
+    world the autoscaler's serving lane scales."""
+    if getattr(args, "platform", ""):
+        from edl_tpu.launcher import force_platform
+
+        force_platform(args.platform)
+    entrypoint = args.entrypoint
+    checkpoint_dir = args.checkpoint_dir
+    port = args.port
+    max_batch = args.max_batch
+    queue_limit = 0
+    deadline_ms = args.deadline_ms
+    if args.spec:
+        job = _load_job(args.spec)
+        entrypoint = entrypoint or job.spec.trainer.entrypoint
+        checkpoint_dir = checkpoint_dir or job.spec.checkpoint_dir
+        sv = job.spec.serving
+        if sv is not None:
+            # The WHOLE serving section applies locally, same as the
+            # deployed path's serving_pod_env — one spec, one behavior.
+            port = port or sv.port
+            max_batch = max_batch or sv.max_batch
+            queue_limit = sv.queue_limit
+            deadline_ms = deadline_ms or sv.deadline_ms
+    from edl_tpu.serving import serve_run
+
+    replica = serve_run(
+        entrypoint=entrypoint,
+        coordinator_addr=args.coordinator,
+        checkpoint_dir=checkpoint_dir,
+        port=port,
+        max_batch=max_batch,
+        queue_limit=queue_limit,
+        deadline_ms=deadline_ms,
+    )
+    engine = replica.engine
+    print(
+        json.dumps(
+            {
+                "replica": replica.replica_id,
+                "model": engine.model.name,
+                "port": replica.server.port if replica.server else None,
+                "weights_step": engine.weights_step,
+                "warm_buckets": list(engine.warm_buckets),
+            }
+        )
+    )
+    try:
+        if args.duration > 0:
+            import time
+
+            time.sleep(args.duration)
+        else:
+            import threading
+
+            threading.Event().wait()  # serve until killed
+    except KeyboardInterrupt:
+        pass
+    finally:
+        replica.stop()
     return 0
 
 
@@ -604,6 +712,34 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--timeout", type=float, default=5.0)
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "serve",
+        help="run an inference-serving replica (checkpoint-backed, "
+        "continuous-batched, hot-swapping)",
+    )
+    s.add_argument("--spec", default="", help="TrainingJob YAML (serving "
+                   "defaults come from its spec.serving section)")
+    s.add_argument("--entrypoint", default="", help="registered model name")
+    s.add_argument(
+        "--coordinator", default="", help="serving-world coordinator address"
+    )
+    s.add_argument(
+        "--checkpoint-dir",
+        default="",
+        help="durable checkpoint dir to serve from (training spills here)",
+    )
+    s.add_argument("--port", type=int, default=0)
+    s.add_argument("--max-batch", type=int, default=0)
+    s.add_argument("--deadline-ms", type=int, default=0)
+    s.add_argument(
+        "--duration",
+        type=float,
+        default=0.0,
+        help="serve for N seconds then exit (0 = forever)",
+    )
+    s.add_argument("--platform", default="", help="force a JAX platform")
+    s.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser(
         "trace",

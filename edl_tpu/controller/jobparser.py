@@ -393,6 +393,168 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
     return [deployment, service]
 
 
+def serving_pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
+    """Serving-replica pod environment: the ``EDL_SERVE_*`` contract
+    (``edl_tpu.serving.server.serve_run`` reads it) plus the shared
+    facts serving inherits from the job — entrypoint (the model to
+    serve), the durable checkpoint dir (the weights source training
+    spills into), and the compile cache (a restarted replica
+    deserializes its bucketed forwards instead of recompiling)."""
+    sv = job.spec.serving
+    t = job.spec.trainer
+    return [
+        {"name": "EDL_JOB_NAME", "value": job.name},
+        {
+            "name": "EDL_COORDINATOR_ADDR",
+            "value": f"{job.serving_coordinator_name()}:{job.spec.port}",
+        },
+        {"name": "EDL_ENTRYPOINT", "value": t.entrypoint},
+        {"name": "EDL_WORKSPACE", "value": t.workspace},
+        {"name": "EDL_CHECKPOINT_DIR", "value": job.spec.checkpoint_dir},
+        {"name": "EDL_COMPILE_CACHE_DIR", "value": job.spec.compile_cache_dir},
+        {"name": "EDL_SERVE_PORT", "value": str(sv.port)},
+        {"name": "EDL_SERVE_MAX_BATCH", "value": str(sv.max_batch)},
+        {"name": "EDL_SERVE_QUEUE_LIMIT", "value": str(sv.queue_limit)},
+        {"name": "EDL_SERVE_DEADLINE_MS", "value": str(sv.deadline_ms)},
+        {
+            "name": "EDL_POD_NAME",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
+        },
+    ]
+
+
+def parse_to_serving_manifests(job: TrainingJob) -> List[Dict[str, Any]]:
+    """Serving fleet manifests (empty when ``spec.serving`` is unset):
+
+    - a SEPARATE serving coordinator Deployment-of-1 + Service — the
+      serving world's membership/telemetry truth.  Separate on purpose:
+      a serving replica registering with the *training* coordinator
+      would join the training plan's rank order and drag inference pods
+      through training resize barriers;
+    - the replica Deployment (``min_replicas``; the autoscaler's
+      serving lane drives the coordinator target between min and max,
+      and the Deployment's replica count follows via the lane's kube
+      glue) + the front Service routing ``/predict``.
+    """
+    if job.spec.serving is None:
+        return []
+    sv = job.spec.serving
+    coord_labels = {OWNER_LABEL: job.name, ROLE_LABEL: "serve-coordinator"}
+    refs = owner_references(job)
+
+    def meta(name: str, labels: Dict[str, str]) -> Dict[str, Any]:
+        m: Dict[str, Any] = {
+            "name": name,
+            "namespace": job.namespace,
+            "labels": dict(labels),
+        }
+        if refs:
+            m["ownerReferences"] = refs
+        return m
+
+    coord = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": meta(job.serving_coordinator_name(), coord_labels),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": dict(coord_labels)},
+            "template": {
+                "metadata": {"labels": dict(coord_labels)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "coordinator",
+                            "image": job.spec.image,
+                            "command": [
+                                "python",
+                                "-m",
+                                "edl_tpu.runtime.coord_service",
+                                "--port",
+                                str(job.spec.port),
+                                "--min-world",
+                                str(sv.min_replicas),
+                                "--max-world",
+                                str(sv.max_replicas),
+                                "--heartbeat-timeout",
+                                "30",
+                            ],
+                            "env": [
+                                {"name": "EDL_JOB_NAME", "value": job.name}
+                            ],
+                            "ports": [
+                                {
+                                    "name": "coord",
+                                    "containerPort": job.spec.port,
+                                }
+                            ],
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    coord_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta(job.serving_coordinator_name(), coord_labels),
+        "spec": {
+            "selector": dict(coord_labels),
+            "ports": [{"name": "coord", "port": job.spec.port}],
+        },
+    }
+    labels = {OWNER_LABEL: job.name, ROLE_LABEL: "server"}
+    res = sv.resources
+    resources = {
+        "requests": dict(res.requests) or {"cpu": "1", "memory": "2Gi"},
+        "limits": dict(res.limits) or {"cpu": "2", "memory": "4Gi"},
+    }
+    deployment = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": meta(job.serving_name(), labels),
+        "spec": {
+            "replicas": sv.min_replicas,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "server",
+                            "image": job.spec.image,
+                            "command": [
+                                "python",
+                                "-m",
+                                "edl_tpu.serving.server",
+                            ],
+                            "env": serving_pod_env(job),
+                            "resources": resources,
+                            "ports": [
+                                {
+                                    "name": "predict",
+                                    "containerPort": sv.port,
+                                }
+                            ],
+                        }
+                    ],
+                    "volumes": list(job.spec.volumes),
+                },
+            },
+        },
+    }
+    front = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta(job.serving_name(), labels),
+        "spec": {
+            "selector": dict(labels),
+            "ports": [{"name": "predict", "port": sv.port}],
+        },
+    }
+    return [coord, coord_svc, deployment, front]
+
+
 class JobParser:
     """ref ``JobParser`` interface (``pkg/jobparser.go:36-41``), minus
     ``ParseToPserver`` (no pservers on TPU).  ``validate`` lives on the

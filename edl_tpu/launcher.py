@@ -86,6 +86,13 @@ def env_config() -> dict:
         "telemetry_interval": float(
             e.get("EDL_TELEMETRY_INTERVAL", "5.0")
         ),
+        # Serving-replica pod contract (edl_tpu.serving.server.serve_run
+        # reads these; jobparser's serving manifests set them from
+        # spec.serving).
+        "serve_port": int(e.get("EDL_SERVE_PORT", "7180")),
+        "serve_max_batch": int(e.get("EDL_SERVE_MAX_BATCH", "64")),
+        "serve_queue_limit": int(e.get("EDL_SERVE_QUEUE_LIMIT", "256")),
+        "serve_deadline_ms": int(e.get("EDL_SERVE_DEADLINE_MS", "2000")),
         # Multi-host slice placement: replica index from the per-replica
         # Job's env; host index from the Indexed Job's completion index
         # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides

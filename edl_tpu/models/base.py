@@ -31,6 +31,8 @@ class ModelDef:
     - ``synth_batch(rng, n)``         -> host-side numpy batch of size n
     - ``param_partition(params)``     -> optional PartitionSpec pytree for
       model-sharded (tp/fsdp) training; None means replicate.
+    - ``predict_fn(params, inputs)``  -> optional forward-only apply
+      (no loss, no labels, no grads) for the inference-serving path.
     """
 
     name: str
@@ -44,6 +46,17 @@ class ModelDef:
     #: accounting; 0 = not a token model.  Kept on the model so
     #: benchmarks cannot drift from the model's actual shape (ADVICE r3)
     tokens_per_example: int = 0
+    #: forward-only apply: ``predict_fn(params, inputs) -> outputs
+    #: dict`` where ``inputs`` holds exactly the ``predict_inputs``
+    #: keys of a host batch (labels never cross the serving wire).
+    #: Pure and jit-traceable like ``loss_fn``; None = the model family
+    #: has no serving path (``pipeline_lm``'s 1F1B schedule weaves the
+    #: backward into the schedule itself — its ModelDef routes serving
+    #: through the GPipe forward instead, see models/pipeline_lm.py)
+    predict_fn: Optional[Callable[[Params, Batch], Dict[str, Any]]] = None
+    #: batch keys ``predict_fn`` consumes (the serving request schema;
+    #: a strict subset of ``synth_batch``'s keys)
+    predict_inputs: Tuple[str, ...] = ()
 
 
 def divisor_at_most(n: int, want: int) -> int:

@@ -38,6 +38,9 @@ def fit_a_line(features: int = FEATURES, noise: float = 0.01) -> ModelDef:
         loss = jnp.mean((pred - batch["y"]) ** 2)
         return loss, {"mse": loss}
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        return {"pred": inputs["x"] @ params["w"] + params["b"]}
+
     def synth_batch(rng: np.random.RandomState, n: int):
         x = rng.randn(n, features).astype(np.float32)
         y = x @ true_w + true_b + noise * rng.randn(n).astype(np.float32)
@@ -49,4 +52,6 @@ def fit_a_line(features: int = FEATURES, noise: float = 0.01) -> ModelDef:
         loss_fn=loss_fn,
         synth_batch=synth_batch,
         flops_per_example=6 * features,  # fwd 2F + bwd 4F
+        predict_fn=predict_fn,
+        predict_inputs=("x",),
     )

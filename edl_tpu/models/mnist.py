@@ -90,6 +90,10 @@ def mnist() -> ModelDef:
         acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
         return loss, {"loss": loss, "accuracy": acc}
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        logits = module.apply({"params": params}, inputs["image"])
+        return {"logits": logits, "label": jnp.argmax(logits, -1)}
+
     def synth_batch(rng: np.random.RandomState, n: int):
         label = rng.randint(0, NUM_CLASSES, size=(n,))
         # Digit-dependent blob: mean brightness pattern per class.
@@ -113,4 +117,6 @@ def mnist() -> ModelDef:
         synth_batch=synth_batch,
         param_partition=_partition_rules,
         flops_per_example=3 * flops_fwd,
+        predict_fn=predict_fn,
+        predict_inputs=("image",),
     )

@@ -354,6 +354,22 @@ def moe_lm(
             "moe_drop_rate": drop,
         }
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        """Forward-only routed prediction (same top-1 routing as the
+        train step; the router's aux/drop intermediates are discarded
+        — serving reads tokens, not load-balance diagnostics)."""
+        tokens = inputs["tokens"][:, :L]
+        x, _ = module.apply(
+            {"params": params}, tokens, mutable=["intermediates"]
+        )
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(jnp.bfloat16),
+            params["embed"]["embedding"].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return {"tokens": jnp.argmax(logits, -1)}
+
     def synth_batch(rng: np.random.RandomState, n: int):
         start = rng.randint(3, vocab - 8, size=(n, 1))
         t = np.arange(L + 1)[None, :]
@@ -383,4 +399,6 @@ def moe_lm(
         param_partition=_partition_rules,
         flops_per_example=flops,
         tokens_per_example=L,
+        predict_fn=predict_fn,
+        predict_inputs=("tokens",),
     )

@@ -233,6 +233,26 @@ def pipeline_lm(
         ]
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        """Forward-only serving path: ALWAYS the GPipe forward
+        (``features``), never the 1F1B schedule.  1F1B weaves the
+        backward sub-ticks into the schedule itself (the ADVICE r5
+        caveat at the ``schedule`` flag above): a grad-free caller
+        still pays every stage vjp and grad accumulator, ~3x the
+        forward FLOPs.  ``features`` runs the identical stacked stage
+        params through ``pipeline_apply`` (pipelined over ``pp`` when
+        the mesh carries the axis, sequentially otherwise), so a
+        1F1B-trained checkpoint serves grad-free with no re-export."""
+        tokens = inputs["tokens"][:, :L]
+        x = features(params, tokens)
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(jnp.bfloat16),
+            params["outer"]["embed"]["embedding"].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return {"tokens": jnp.argmax(logits, -1)}
+
     flops = lm_flops(vocab, d_model, d_ff, layers, L)
     return ModelDef(
         name="pipeline_lm",
@@ -242,4 +262,6 @@ def pipeline_lm(
         param_partition=param_partition,
         flops_per_example=flops,
         tokens_per_example=L,
+        predict_fn=predict_fn,
+        predict_inputs=("tokens",),
     )

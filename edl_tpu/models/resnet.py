@@ -99,6 +99,10 @@ def _make(image_size: int, num_classes: int, stage_sizes, width, name) -> ModelD
         acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
         return loss, {"loss": loss, "accuracy": acc}
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        logits = module.apply({"params": params}, inputs["image"])
+        return {"logits": logits, "label": jnp.argmax(logits, -1)}
+
     def synth_batch(rng: np.random.RandomState, n: int):
         """Class-dependent spatial stripes (a brightness-only signal
         would be erased by normalization; spatial structure survives)."""
@@ -120,6 +124,8 @@ def _make(image_size: int, num_classes: int, stage_sizes, width, name) -> ModelD
         loss_fn=loss_fn,
         synth_batch=synth_batch,
         flops_per_example=flops,
+        predict_fn=predict_fn,
+        predict_inputs=("image",),
     )
 
 

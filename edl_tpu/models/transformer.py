@@ -309,6 +309,24 @@ def _make(
         )
         return loss, {"loss": loss, "token_accuracy": acc}
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        """Forward-only translation scoring: decoder features -> tied
+        vocab logits -> greedy next-token ids (logits stay on device;
+        only the argmax ids cross the serving wire)."""
+        y = module.apply(
+            {"params": params},
+            inputs["src"],
+            inputs["tgt"],
+            method=Transformer.features,
+        )
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            y.astype(jnp.bfloat16),
+            params["embed"]["embedding"].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return {"tokens": jnp.argmax(logits, -1)}
+
     def synth_batch(rng: np.random.RandomState, n: int):
         """Synthetic translation task: tgt is a deterministic function
         of src (reversal with vocab offset), so the model can actually
@@ -351,6 +369,8 @@ def _make(
         param_partition=_partition_rules,
         flops_per_example=flops,
         tokens_per_example=seq_len,
+        predict_fn=predict_fn,
+        predict_inputs=("src", "tgt"),
     )
 
 

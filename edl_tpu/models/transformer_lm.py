@@ -216,6 +216,22 @@ def transformer_lm(
         )
         return loss, {"loss": loss}
 
+    def predict_fn(params, inputs) -> Dict[str, jax.Array]:
+        """Forward-only next-token prediction.  Request token rows may
+        carry the training corpus's L+1 layout (context + shifted
+        label); the static slice keeps the positional table in range
+        either way.  Greedy ids only — the [B, T, vocab] logits never
+        leave the device."""
+        tokens = inputs["tokens"][:, :L]
+        x = module.apply({"params": params}, tokens, return_features=True)
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(jnp.bfloat16),
+            params["embed"]["embedding"].astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return {"tokens": jnp.argmax(logits, -1)}
+
     synth_batch = lm_synth_batch(vocab, L)
     flops = lm_flops(vocab, d_model, d_ff, layers, L)
     return ModelDef(
@@ -226,4 +242,6 @@ def transformer_lm(
         param_partition=_partition_rules,
         flops_per_example=flops,
         tokens_per_example=L,
+        predict_fn=predict_fn,
+        predict_inputs=("tokens",),
     )

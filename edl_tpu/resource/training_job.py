@@ -262,6 +262,39 @@ class CoordinatorSpec:
 
 
 @dataclass
+class ServingSpec:
+    """Elastic inference serving attached to a TrainingJob: a fleet of
+    checkpoint-backed replicas (``edl_tpu.serving``) scaled between
+    ``[min_replicas, max_replicas]`` by the autoscaler's serving lane
+    on observed p95 latency / queue depth.  Replicas serve the newest
+    *verified* checkpoint from ``spec.checkpoint_dir`` and hot-swap as
+    training spills fresher ones — train and serve as one substrate
+    (Pathways, PAPERS.md), sharing image, volumes, and control plane."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    port: int = 7180
+    max_batch: int = 64
+    queue_limit: int = 256
+    deadline_ms: int = 2000
+    resources: ResourceSpec = field(default_factory=ResourceSpec)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping[str, Any]]) -> Optional["ServingSpec"]:
+        if not d:
+            return None
+        return ServingSpec(
+            min_replicas=int(d.get("min_replicas", d.get("minReplicas", 1))),
+            max_replicas=int(d.get("max_replicas", d.get("maxReplicas", 1))),
+            port=int(d.get("port", 7180)),
+            max_batch=int(d.get("max_batch", d.get("maxBatch", 64))),
+            queue_limit=int(d.get("queue_limit", d.get("queueLimit", 256))),
+            deadline_ms=int(d.get("deadline_ms", d.get("deadlineMs", 2000))),
+            resources=ResourceSpec.from_dict(d.get("resources")),
+        )
+
+
+@dataclass
 class TrainingJobSpec:
     """ref TrainingJobSpec (pkg/resource/training_job.go:110-124).
 
@@ -305,11 +338,18 @@ class TrainingJobSpec:
     #: zero-stall resize (the AOT prewarmer removes compiles from warm
     #: resizes; this removes them from cold ones); "" = no cache.
     compile_cache_dir: str = ""
+    #: elastic inference serving attached to this job (None = train
+    #: only).  Serving replicas load the newest verified checkpoint
+    #: from ``checkpoint_dir`` and hot-swap as training writes fresher
+    #: ones; the autoscaler's serving lane scales them on p95/queue
+    #: depth read from the serving coordinator's merged telemetry.
+    serving: Optional["ServingSpec"] = None
 
     @staticmethod
     def from_dict(d: Optional[Mapping[str, Any]]) -> "TrainingJobSpec":
         d = d or {}
         return TrainingJobSpec(
+            serving=ServingSpec.from_dict(d.get("serving")),
             dataset_dir=str(d.get("dataset_dir", d.get("datasetDir", "")) or ""),
             checkpoint_dir=str(
                 d.get("checkpoint_dir", d.get("checkpointDir", "")) or ""
@@ -425,6 +465,17 @@ class TrainingJob:
     def coordinator_name(self) -> str:
         return f"{self.name}-coordinator"
 
+    def serving_name(self) -> str:
+        """Name of the serving-replica Deployment/Service:
+        ``<job>-serve``."""
+        return f"{self.name}-serve"
+
+    def serving_coordinator_name(self) -> str:
+        """The SERVING world's coordinator (separate from the training
+        coordinator: serving replicas must never join the training
+        plan's rank order)."""
+        return f"{self.name}-serve-coordinator"
+
     # -- validation + defaulting (ref DefaultJobParser.Validate,
     #    pkg/jobparser.go:47-71) --------------------------------------------
     def validate(self) -> "TrainingJob":
@@ -490,6 +541,24 @@ class TrainingJob:
             )
         if s.global_batch_size < 0:
             raise ValidationError("global_batch_size must be >= 0")
+        if s.serving is not None:
+            sv = s.serving
+            if sv.min_replicas < 1 or sv.max_replicas < sv.min_replicas:
+                raise ValidationError(
+                    "serving replica bounds must satisfy 1 <= min <= max "
+                    f"(got [{sv.min_replicas}, {sv.max_replicas}])"
+                )
+            if sv.max_batch < 1 or sv.queue_limit < 1 or sv.deadline_ms < 1:
+                raise ValidationError(
+                    "serving max_batch / queue_limit / deadline_ms must "
+                    "be >= 1"
+                )
+            if not s.checkpoint_dir:
+                raise ValidationError(
+                    "spec.serving requires spec.checkpoint_dir: replicas "
+                    "serve the newest verified durable checkpoint (a "
+                    "DRAM-only training fleet leaves them nothing to load)"
+                )
         par = t.parallelism
         for a in LAYOUT_AXES:
             if int(getattr(par, a)) < 1:

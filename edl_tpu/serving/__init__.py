@@ -1,0 +1,34 @@
+"""``edl_tpu.serving`` — elastic inference serving.
+
+Continuous-batched, checkpoint-hot-swapping replicas driven by the
+SAME control plane that scales training (coordinator membership +
+autoscaler): an ``InferenceEngine`` serves the latest *verified*
+checkpoint through AOT-warmed padded-bucket forwards (zero XLA
+compiles on the request path), a ``ContinuousBatcher`` turns a bounded
+admission queue into occupancy-maximizing micro-batches (Orca,
+OSDI '22), and ``ServingServer``/``ServingReplica`` put an HTTP front
+on it and register it into a serving world the autoscaler's
+``ServingLane`` (edl_tpu.autoscaler.serving) scales on p95 latency and
+queue depth.
+"""
+
+from edl_tpu.serving.batcher import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    QueueFullError,
+    Ticket,
+)
+from edl_tpu.serving.engine import InferenceEngine, NotReadyError
+from edl_tpu.serving.server import ServingReplica, ServingServer, serve_run
+
+__all__ = [
+    "ContinuousBatcher",
+    "DeadlineExceededError",
+    "InferenceEngine",
+    "NotReadyError",
+    "QueueFullError",
+    "ServingReplica",
+    "ServingServer",
+    "Ticket",
+    "serve_run",
+]

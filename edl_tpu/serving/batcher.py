@@ -1,0 +1,278 @@
+"""Continuous micro-batcher: bounded queue, deadline-aware admission,
+bucket padding, backpressure.
+
+Orca-style continuous batching (Yu et al., OSDI '22 — PAPERS.md): the
+batch boundary is the scheduling boundary.  The worker takes whatever
+is queued the moment the previous micro-batch retires (up to the
+engine's largest bucket), so a request arriving mid-computation joins
+the *next* dispatch instead of waiting out a fixed batching window —
+the compute time itself is the batching window, and occupancy rises
+with load instead of being configured.  (Our unit of continuity is the
+request/forward pass, not Orca's per-token iteration: the model zoo's
+forwards are single-shot, so "iteration-level" and "request-level"
+coincide.)
+
+Admission is where backpressure lives: a full queue rejects
+immediately with a retry-after hint (the HTTP front maps it to 429)
+rather than buffering unboundedly — shedding at admission keeps p95
+bounded for the requests that ARE admitted, and the queue-depth gauge
+plus the latency histogram are exactly the signals the autoscaler's
+serving lane scales replicas on.  Requests carry deadlines; one whose
+deadline passed while queued is expired, not computed (its caller has
+already given up — computing it would only tax its neighbors).
+
+The checkpoint hot-swap moment lives HERE, between batches
+(``engine.refresh()``): a micro-batch in flight bound its weights at
+dispatch, so no request ever observes mixed-generation outputs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Admission rejected: the bounded queue is full.  ``retry_after``
+    is the backoff hint (seconds) the HTTP front surfaces as a
+    Retry-After header."""
+
+    def __init__(self, msg: str, retry_after: float = 0.05):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before its batch dispatched."""
+
+
+class Ticket:
+    """One admitted request's future: resolved by the batcher worker
+    with (outputs, meta) or an error."""
+
+    __slots__ = (
+        "inputs", "rows", "deadline", "enqueued", "_done",
+        "_result", "_error",
+    )
+
+    def __init__(self, inputs: Dict[str, np.ndarray], rows: int, deadline: float):
+        self.inputs = inputs
+        self.rows = rows
+        self.deadline = deadline
+        self.enqueued = time.monotonic()
+        self._done = threading.Event()
+        self._result: Optional[tuple] = None
+        self._error: Optional[BaseException] = None
+
+    def _resolve(self, outputs, meta) -> None:
+        self._result = (outputs, meta)
+        self._done.set()
+
+    def _reject(self, err: BaseException) -> None:
+        self._error = err
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> tuple:
+        """Block for (outputs, meta); raises the worker's error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ContinuousBatcher:
+    """Background worker turning admitted requests into padded-bucket
+    forward passes on an ``InferenceEngine``."""
+
+    def __init__(
+        self,
+        engine,
+        queue_limit: int = 256,
+        default_deadline_s: float = 2.0,
+        chaos=None,
+    ):
+        self.engine = engine
+        self.queue_limit = int(queue_limit)
+        self.default_deadline_s = float(default_deadline_s)
+        self.chaos = chaos if chaos is not None else engine.chaos
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"batches": 0, "swaps": 0}
+
+        from edl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_requests = reg.counter("edl_serve_requests_total")
+        self._m_batches = reg.counter("edl_serve_batches_total")
+        self._m_examples = reg.counter("edl_serve_examples_total")
+        self._g_depth = reg.gauge("edl_serve_queue_depth")
+        self._m_latency = reg.histogram("edl_serve_latency_seconds")
+        self._m_occupancy = reg.histogram("edl_serve_batch_occupancy")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._work, daemon=True, name="edl-serve-batcher"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        # Nothing queued survives a stop: resolve, don't strand callers.
+        with self._cv:
+            pending = list(self._queue)
+            self._queue.clear()
+            self._g_depth.set(0)
+        for t in pending:
+            self._m_requests.inc(status="error")
+            t._reject(RuntimeError("batcher stopped"))
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    # -- admission ----------------------------------------------------------
+    def submit(
+        self,
+        inputs: Dict[str, Any],
+        deadline_s: Optional[float] = None,
+    ) -> Ticket:
+        """Admit one request (1..max_batch rows).  Raises
+        ``QueueFullError`` on backpressure and ``ValueError`` on a
+        schema mismatch — both BEFORE the request costs any compute."""
+        arrays, rows = self.engine.coerce_inputs(inputs)
+        if rows < 1:
+            raise ValueError("empty request (0 rows)")
+        if rows > self.engine.max_batch:
+            raise ValueError(
+                f"request of {rows} rows exceeds max_batch "
+                f"{self.engine.max_batch}; split it client-side"
+            )
+        budget = (
+            deadline_s if deadline_s is not None else self.default_deadline_s
+        )
+        ticket = Ticket(arrays, rows, time.monotonic() + budget)
+        with self._cv:
+            forced = self.chaos is not None and bool(
+                self.chaos.due("serve.queue.full")
+            )
+            if forced or len(self._queue) >= self.queue_limit:
+                # chaos[serve.queue.full] forces this branch so the
+                # 429/Retry-After path is testable without a real storm.
+                self._m_requests.inc(status="rejected")
+                raise QueueFullError(
+                    f"admission queue full ({self.queue_limit}); retry",
+                    retry_after=max(0.01, budget / 4),
+                )
+            self._queue.append(ticket)
+            self._g_depth.set(len(self._queue))
+            self._cv.notify()
+        return ticket
+
+    # -- the worker ---------------------------------------------------------
+    def _take_batch(self) -> List[Ticket]:
+        """Pop whatever is queued up to the largest bucket (continuous
+        batching: no artificial wait — the previous batch's compute WAS
+        the window), expiring dead requests on the way."""
+        taken: List[Ticket] = []
+        now = time.monotonic()
+        cap = self.engine.max_batch
+        rows = 0
+        with self._cv:
+            while self._queue:
+                t = self._queue[0]
+                if t.deadline <= now:
+                    self._queue.popleft()
+                    self._m_requests.inc(status="expired")
+                    t._reject(
+                        DeadlineExceededError(
+                            "deadline passed while queued"
+                        )
+                    )
+                    continue
+                if rows + t.rows > cap:
+                    break
+                self._queue.popleft()
+                taken.append(t)
+                rows += t.rows
+            self._g_depth.set(len(self._queue))
+        return taken
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(timeout=0.5)
+                if self._stop:
+                    return
+            # Hot-swap moment: between batches, never mid-batch.  A
+            # rejected candidate (torn checkpoint) leaves the current
+            # weights serving.  Guarded: even an unexpected swap-path
+            # failure (device OOM placing a grown checkpoint, a
+            # mismatched tree from a misconfigured trainer) must cost
+            # the SWAP, never the worker — a dead worker strands every
+            # queued and future request until its timeout.
+            try:
+                if self.engine.refresh():
+                    self.stats["swaps"] += 1
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            batch = self._take_batch()
+            if not batch:
+                continue
+            if self.chaos is not None:
+                for ev in self.chaos.due("serve.request.slow"):
+                    # chaos[serve.request.slow]: a slow dispatch (GC
+                    # pause, contended device) inflates the latency
+                    # histogram — the p95 signal the serving lane
+                    # scales on, under test control.
+                    time.sleep(float(ev.arg or 0.05))
+            rows = sum(t.rows for t in batch)
+            merged = {
+                k: np.concatenate([t.inputs[k] for t in batch], axis=0)
+                for k in batch[0].inputs
+            }
+            try:
+                outputs, meta = self.engine.predict(merged)
+            except BaseException as e:
+                for t in batch:
+                    self._m_requests.inc(status="error")
+                    t._reject(e)
+                continue
+            self._m_batches.inc()
+            self._m_examples.inc(rows)
+            self._m_occupancy.observe(rows / meta["bucket"])
+            self.stats["batches"] += 1
+            now = time.monotonic()
+            lo = 0
+            for t in batch:
+                sl = jax_tree_slice(outputs, lo, lo + t.rows)
+                lo += t.rows
+                self._m_requests.inc(status="ok")
+                self._m_latency.observe(now - t.enqueued)
+                t._resolve(sl, dict(meta))
+
+
+def jax_tree_slice(outputs: Dict[str, np.ndarray], lo: int, hi: int):
+    """Row-slice every output array (outputs are host numpy by the time
+    the batcher splits them back per request)."""
+    return {k: v[lo:hi] for k, v in outputs.items()}

@@ -1,0 +1,501 @@
+"""Checkpoint-backed inference engine: forward-only, bucket-compiled,
+hot-swapping.
+
+The serving half of the elastic story (ROADMAP north star: "serves
+heavy traffic from millions of users") reuses every layer the training
+stack already paid for instead of inventing a parallel one:
+
+- **Weights** come from the SAME checkpoint machinery training writes:
+  ``HostDRAMStore.latest_verified`` (CRC-verified DRAM snapshots) with
+  the durable-dir spill as the cold-start source (``load_from_disk``).
+  A corrupted candidate is *rejected*, never served — the engine keeps
+  the old weights and counts ``edl_serve_swap_rejected_total``.
+- **Compilation** follows ``Trainer.warm_step``'s AOT discipline: one
+  forward executable per padded batch bucket (power-of-2 rows), lowered
+  from ABSTRACT shapes and HELD — on this jax ``.lower().compile()``
+  does not warm the jit dispatch cache, so holding the executable is
+  what makes the request path perform ZERO XLA compiles after warmup
+  (the same seam bench.py asserts warm resizes at).
+- **Hot swap** is generation-keyed like ``BatchStager``: ``_weights``
+  is one immutable record swapped atomically between batches; a batch
+  in flight bound its params reference at dispatch, so it can never
+  observe torn (mixed-generation) weights, and no request is dropped
+  during a swap.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.checkpoint.hostdram import HostCheckpoint, leaf_placer
+from edl_tpu.models.base import ModelDef
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+class NotReadyError(RuntimeError):
+    """No verified checkpoint has been loaded yet (the /healthz 503)."""
+
+
+@dataclass(frozen=True)
+class _Weights:
+    """One installed weight set.  Immutable and swapped atomically:
+    a predict call reads the record ONCE, so the params it binds are
+    consistent even if a swap lands mid-batch."""
+
+    generation: int  # engine-local swap counter (monotonic)
+    step: int        # training step of the source checkpoint
+    digest: int      # checkpoint content fingerprint
+    params: Any      # device params, replicated over the serving mesh
+
+
+class InferenceEngine:
+    """Forward-only engine over one model + one checkpoint store.
+
+    ``model`` must declare ``predict_fn`` (the forward-only apply path;
+    every built-in family does — ``pipeline_lm`` routes through its
+    GPipe forward).  ``optimizer`` is needed ONLY to reconstruct the
+    TrainState treedef for durable-dir cold loads (the spill format is
+    positional); it must match the training job's optimizer family.
+    """
+
+    def __init__(
+        self,
+        model: ModelDef,
+        store: Optional[HostDRAMStore] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        max_batch: int = 64,
+        seed: int = 0,
+        optimizer=None,
+        chaos=None,
+    ):
+        if model.predict_fn is None:
+            raise ValueError(
+                f"model {model.name!r} declares no predict_fn (forward-"
+                "only apply path); it cannot serve"
+            )
+        if not model.predict_inputs:
+            raise ValueError(
+                f"model {model.name!r} declares predict_fn but no "
+                "predict_inputs (the request schema)"
+            )
+        self.model = model
+        self.store = store if store is not None else HostDRAMStore()
+        self.seed = seed
+        self.optimizer = optimizer
+        self.chaos = chaos if chaos is not None else getattr(
+            self.store, "chaos", None
+        )
+        devs = list(devices) if devices is not None else jax.devices()
+        self.mesh: Mesh = build_mesh(MeshSpec.create(dp=len(devs)), devs)
+        dp = len(devs)
+        if max_batch < dp:
+            raise ValueError(
+                f"max_batch {max_batch} < {dp} devices (the smallest "
+                "bucket must shard over the replica's dp extent)"
+            )
+        #: padded batch buckets: dp, 2*dp, 4*dp ... plus max_batch
+        #: itself as the final bucket — power-of-2 growth keeps the
+        #: executable count logarithmic while the exact top bucket
+        #: honors the CONFIGURED cap (a spec-validated max_batch must
+        #: not silently shrink to the nearest power of two).  Only a
+        #: cap not divisible by the device count narrows, and that is
+        #: said out loud.
+        eff = (max_batch // dp) * dp
+        if eff != max_batch:
+            import sys
+
+            print(
+                f"[edl-serve] max_batch {max_batch} rounded down to "
+                f"{eff} (must be a multiple of the replica's {dp} "
+                "devices)",
+                file=sys.stderr,
+            )
+        buckets: List[int] = []
+        b = dp
+        while b < eff:
+            buckets.append(b)
+            b *= 2
+        buckets.append(eff)
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+        self.max_batch = eff
+
+        #: how often (seconds) refresh() may rescan the durable spill
+        #: dir.  The DRAM step comparison runs every batch (cheap);
+        #: the os.listdir of a possibly network-backed checkpoint
+        #: volume must not sit between every micro-batch.
+        self.spill_poll_interval: float = 1.0
+        self._last_spill_poll = 0.0
+
+        self._jit = jax.jit(model.predict_fn)
+        #: bucket -> held AOT executable (the zero-compile request path)
+        self._compiled: Dict[int, Any] = {}
+        self._weights: Optional[_Weights] = None
+        self._swap_lock = threading.Lock()
+        #: request schema: input key -> (trailing shape, dtype), probed
+        #: from the model's own synthetic batch so serving cannot drift
+        #: from the model's actual shapes
+        probe = model.synth_batch(np.random.RandomState(0), 1)
+        self.input_schema: Dict[str, Tuple[tuple, Any]] = {
+            k: (tuple(probe[k].shape[1:]), probe[k].dtype)
+            for k in model.predict_inputs
+        }
+        self._batch_sharding = {
+            k: NamedSharding(
+                self.mesh, P("dp", *([None] * len(shape)))
+            )
+            for k, (shape, _) in self.input_schema.items()
+        }
+        self._abstract_params = jax.eval_shape(
+            model.init_params, jax.random.key(seed)
+        )
+
+        from edl_tpu import telemetry
+
+        self.telemetry = telemetry.get_registry()
+        self.recorder = telemetry.get_recorder()
+        self._m_swaps = self.telemetry.counter("edl_serve_hot_swaps_total")
+        self._m_swap_rejected = self.telemetry.counter(
+            "edl_serve_swap_rejected_total"
+        )
+        self._m_weights_step = self.telemetry.gauge("edl_serve_weights_step")
+        self._m_compile_seconds = self.telemetry.histogram(
+            "edl_compile_seconds"
+        )
+
+    # -- weights ------------------------------------------------------------
+    @property
+    def weights_step(self) -> int:
+        w = self._weights
+        return w.step if w is not None else -1
+
+    @property
+    def weights_generation(self) -> int:
+        w = self._weights
+        return w.generation if w is not None else 0
+
+    @property
+    def ready(self) -> bool:
+        return self._weights is not None
+
+    def _template_state(self):
+        """Abstract TrainState schema for positional durable-dir loads
+        (treedef + leaf count only; no allocation).  Lazy: DRAM
+        checkpoints carry their own treedef and never need it."""
+        import optax
+
+        from edl_tpu.runtime.train import TrainState
+
+        opt = self.optimizer if self.optimizer is not None else optax.adam(
+            1e-3
+        )
+
+        def init_fn(rng):
+            import jax.numpy as jnp
+
+            params = self.model.init_params(rng)
+            return TrainState(
+                step=jnp.zeros((), jnp.int32),
+                params=params,
+                opt_state=opt.init(params),
+            )
+
+        return jax.eval_shape(init_fn, jax.random.key(self.seed))
+
+    def _install(self, ckpt: HostCheckpoint) -> None:
+        """Place ``ckpt``'s params on the serving mesh (replicated) and
+        publish them as the next weight generation.  ONLY the params
+        leave the host — serving never pays the optimizer state's
+        placement or memory."""
+        state_host = ckpt.unflatten()
+        params_host = getattr(state_host, "params", state_host)
+        place = leaf_placer(self.mesh)
+        sharding = NamedSharding(self.mesh, P())
+        params = jax.tree_util.tree_map(
+            lambda x: place(x, sharding), params_host
+        )
+        with self._swap_lock:
+            gen = (self._weights.generation + 1) if self._weights else 1
+            self._weights = _Weights(
+                generation=gen,
+                step=int(ckpt.step),
+                digest=ckpt.digest(),
+                params=params,
+            )
+        self._m_weights_step.set(int(ckpt.step))
+
+    def load(self) -> bool:
+        """Initial load: newest verified DRAM checkpoint, falling back
+        to the durable spill dir (the launcher's EDL_CHECKPOINT_DIR).
+        Returns False when neither holds a restorable checkpoint."""
+        ckpt = self.store.latest_verified()
+        if ckpt is None and self.store.spill_dir:
+            try:
+                ckpt = self.store.load_from_disk(self._template_state())
+            except FileNotFoundError:
+                ckpt = None
+        if ckpt is None:
+            return False
+        self._install(ckpt)
+        self.recorder.record(
+            "serve.swap",
+            {"step": int(ckpt.step), "initial": True},
+            step=int(ckpt.step),
+        )
+        return True
+
+    def refresh(self) -> bool:
+        """Hot-swap to a newer *verified* checkpoint if one appeared —
+        called by the batcher BETWEEN batches, never mid-batch.  A
+        candidate that fails CRC verification (``latest_verified``
+        drops it) or an unreadable durable spill is rejected and the
+        engine keeps serving the current weights; no request is ever
+        dropped for a swap.  Cheap when nothing changed: one step
+        comparison, no hash pass."""
+        current = self.weights_step
+        if self.chaos is not None:
+            for _ in self.chaos.due("serve.swap.torn"):
+                # chaos[serve.swap.torn]: the newest DRAM candidate's
+                # bytes rot before verification — latest_verified must
+                # reject it (falling back past it), and the engine must
+                # keep answering from the old weights.
+                newest = self.store.latest()
+                if newest is not None and newest.leaves:
+                    newest.leaves[0] = newest.leaves[0].copy()
+                    newest.leaves[0].reshape(-1).view(np.uint8)[0] ^= 0xFF
+        now = time.monotonic()
+        if self.store.spill_dir and (
+            now - self._last_spill_poll >= self.spill_poll_interval
+        ):
+            # Durable-dir poll: a TRAINING fleet spills here; a serving
+            # replica in another process sees new steps only on disk.
+            # Throttled — a listdir on a network-backed volume must not
+            # run between every micro-batch.
+            self._last_spill_poll = now
+            try:
+                self._poll_spill_dir(current)
+            except Exception:
+                self._m_swap_rejected.inc()
+                self.recorder.record(
+                    "serve.swap.rejected",
+                    {"source": "disk", "serving_step": current},
+                    step=max(0, current),
+                )
+        newest = self.store.latest()
+        if newest is None or int(newest.step) <= current:
+            return False
+        ckpt = self.store.latest_verified()
+        if ckpt is None or int(ckpt.step) <= current:
+            # The newer candidate failed verification (torn/corrupt):
+            # latest_verified discarded it and whatever remains is not
+            # newer than what we serve.  Keep the old weights.
+            self._m_swap_rejected.inc()
+            self.recorder.record(
+                "serve.swap.rejected",
+                {"source": "dram", "serving_step": current},
+                step=max(0, current),
+            )
+            return False
+        self._install(ckpt)
+        self._m_swaps.inc()
+        self.recorder.record(
+            "serve.swap",
+            {"step": int(ckpt.step), "from_step": current},
+            step=int(ckpt.step),
+        )
+        return True
+
+    def _poll_spill_dir(self, current: int) -> None:
+        """Pull a newer durable spill into the store (so the normal
+        DRAM verify/swap path below picks it up).  Manifest scan only —
+        bytes load (and CRC-verify) once per NEW step, not per poll."""
+        import os
+
+        dram = self.store.latest()
+        dram_step = int(dram.step) if dram is not None else -1
+        best = -1
+        for name in os.listdir(self.store.spill_dir):
+            if name.endswith(".json") and ".tmp." not in name:
+                try:
+                    best = max(best, int(name[len("ckpt-"):-len(".json")]))
+                except ValueError:
+                    continue
+        if best > max(current, dram_step):
+            self.store.load_from_disk(self._template_state(), step=best)
+
+    # -- compilation --------------------------------------------------------
+    def _abstract_batch(self, bucket: int) -> Dict[str, Any]:
+        return {
+            k: jax.ShapeDtypeStruct(
+                (bucket,) + shape, dtype, sharding=self._batch_sharding[k]
+            )
+            for k, (shape, dtype) in self.input_schema.items()
+        }
+
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
+        """AOT-compile the forward for every bucket (abstract shapes —
+        zero device allocation) and HOLD the executables.  Idempotent;
+        returns how many compiles happened.  A replica warms BEFORE
+        taking traffic (ServingReplica.start / the scale-up contract),
+        so its first request dispatches a held executable."""
+        # The hot-swap path's per-leaf CPU staging conversions compile
+        # tiny programs too (leaf_placer's jnp.array, same as restore):
+        # warm them here so even the FIRST swap stages zero compiles.
+        from edl_tpu.checkpoint.hostdram import warm_leaf_conversions
+
+        warm_leaf_conversions(
+            jax.tree_util.tree_leaves(self._abstract_params)
+        )
+        replicated = NamedSharding(self.mesh, P())
+        abs_params = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=replicated
+            ),
+            self._abstract_params,
+        )
+        warmed = 0
+        for b in buckets if buckets is not None else self.buckets:
+            if b in self._compiled:
+                continue
+            t0 = time.perf_counter()
+            with self.mesh:
+                self._compiled[b] = self._jit.lower(
+                    abs_params, self._abstract_batch(b)
+                ).compile()
+            dt = time.perf_counter() - t0
+            self._m_compile_seconds.observe(dt)
+            self.recorder.record(
+                "serve.warm",
+                {"bucket": b, "model": self.model.name},
+                timing={"seconds": round(dt, 6)},
+            )
+            warmed += 1
+        return warmed
+
+    @property
+    def warm_buckets(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._compiled))
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the largest bucket "
+            f"{self.buckets[-1]} (max_batch)"
+        )
+
+    # -- the request path ---------------------------------------------------
+    def _pad(self, inputs: Dict[str, np.ndarray], n: int, bucket: int):
+        if n == bucket:
+            return inputs
+        out = {}
+        for k, v in inputs.items():
+            pad = np.broadcast_to(
+                v[-1:], (bucket - n,) + tuple(v.shape[1:])
+            )
+            out[k] = np.concatenate([v, pad], axis=0)
+        return out
+
+    def coerce_inputs(
+        self, inputs: Dict[str, Any]
+    ) -> Tuple[Dict[str, np.ndarray], int]:
+        """Validate a request's inputs against the model schema and
+        coerce to the schema dtypes.  Returns (arrays, rows)."""
+        missing = [k for k in self.input_schema if k not in inputs]
+        if missing:
+            raise ValueError(
+                f"request missing input(s) {missing}; model "
+                f"{self.model.name!r} expects {sorted(self.input_schema)}"
+            )
+        arrays: Dict[str, np.ndarray] = {}
+        n = None
+        for k, (shape, dtype) in self.input_schema.items():
+            a = np.asarray(inputs[k], dtype=dtype)
+            if a.ndim == len(shape):  # single example: add the batch dim
+                a = a[None]
+            if (
+                tuple(a.shape[1:]) != shape
+                and len(shape) == 1
+                and a.ndim == 2
+                and np.issubdtype(np.dtype(dtype), np.integer)
+                and a.shape[1] < shape[0]
+            ):
+                # Token-like rows shorter than the schema (the schema
+                # is probed from the training corpus, whose rows carry
+                # the shifted-label extra position): right-pad with 0 —
+                # the LM families' pad id — so a natural L-token
+                # next-token request serves without a dummy position.
+                a = np.concatenate(
+                    [
+                        a,
+                        np.zeros(
+                            (a.shape[0], shape[0] - a.shape[1]), dtype
+                        ),
+                    ],
+                    axis=1,
+                )
+            if tuple(a.shape[1:]) != shape:
+                raise ValueError(
+                    f"input {k!r} rows have shape {tuple(a.shape[1:])}, "
+                    f"expected {shape}"
+                )
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise ValueError(
+                    "request inputs disagree on row count "
+                    f"({k!r}: {a.shape[0]} vs {n})"
+                )
+            arrays[k] = a
+        return arrays, int(n or 0)
+
+    def predict(
+        self, inputs: Dict[str, np.ndarray]
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        """Run the forward on ``inputs`` (schema-shaped numpy arrays,
+        leading dim = rows).  Pads to the smallest warmed bucket,
+        dispatches the HELD executable (zero compiles on the steady
+        path), and returns (host outputs sliced to the real rows,
+        meta).  ``meta`` carries the weight generation/step the batch
+        was computed with — the hot-swap consistency receipt the soak
+        tests assert on (every row of one batch = one generation)."""
+        w = self._weights  # ONE read: the whole batch binds this record
+        if w is None:
+            raise NotReadyError(
+                "no verified checkpoint loaded (engine.load() found "
+                "nothing to serve)"
+            )
+        n = next(iter(inputs.values())).shape[0]
+        bucket = self.bucket_for(n)
+        padded = self._pad(inputs, n, bucket)
+        dev_batch = {
+            k: jax.device_put(v, self._batch_sharding[k])
+            for k, v in padded.items()
+        }
+        fn = self._compiled.get(bucket)
+        with self.mesh:
+            if fn is not None:
+                out = fn(w.params, dev_batch)
+            else:
+                # Cold bucket: the jit path compiles (counted at the
+                # backend_compile seam) — steady state never lands here
+                # once warm() ran.
+                out = self._jit(w.params, dev_batch)
+        host = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x))[:n], out
+        )
+        meta = {
+            "weights_step": w.step,
+            "weights_generation": w.generation,
+            "bucket": bucket,
+            "rows": n,
+        }
+        return host, meta

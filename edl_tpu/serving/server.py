@@ -1,0 +1,416 @@
+"""HTTP serving front + replica driver (the ``coord_service`` idiom).
+
+One stdlib ``ThreadingHTTPServer`` per replica:
+
+- ``POST /predict``  — ``{"inputs": {...}, "deadline_ms": 500}`` ->
+  ``{"outputs": {...}, "weights_step": N, ...}``; 429 + ``Retry-After``
+  on admission backpressure, 503 before weights load, 504 past
+  deadline.
+- ``GET /healthz``   — readiness: weights step, warmed buckets, depth.
+- ``GET /metrics``   — Prometheus exposition of the process registry
+  (the serving counters/histograms live there, so one scrape config
+  covers trainers and servers alike).
+
+``ServingReplica`` closes the control loop: it warms the engine's
+bucketed forwards BEFORE registering with the job coordinator (a
+replica in the serving world is a replica that answers its first
+request on a held executable — the /prewarm contract's serving
+analog), then heartbeats and ships telemetry snapshots on the training
+stack's exact cadence machinery, so the coordinator's merged
+``/telemetry`` carries the latency/queue-depth series the autoscaler's
+serving lane scales on.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from edl_tpu.serving.batcher import (
+    ContinuousBatcher,
+    DeadlineExceededError,
+    QueueFullError,
+)
+from edl_tpu.serving.engine import InferenceEngine, NotReadyError
+
+
+class ServingServer:
+    """Serve one ContinuousBatcher over HTTP."""
+
+    def __init__(
+        self,
+        batcher: ContinuousBatcher,
+        host: str = "0.0.0.0",
+        port: int = 0,
+    ):
+        self.batcher = batcher
+        engine = batcher.engine
+        from edl_tpu import telemetry
+
+        registry = telemetry.get_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, obj, code=200, headers=()):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(
+                        {
+                            "ok": engine.ready,
+                            "model": engine.model.name,
+                            "weights_step": engine.weights_step,
+                            "weights_generation": engine.weights_generation,
+                            "warm_buckets": list(engine.warm_buckets),
+                            "queue_depth": self.server_batcher.depth,
+                        },
+                        200 if engine.ready else 503,
+                    )
+                elif self.path == "/metrics":
+                    body = registry.render().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply({"error": "not found"}, 404)
+
+            @property
+            def server_batcher(self):
+                return batcher
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply({"error": "not found"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply({"error": "bad json"}, 400)
+                    return
+                deadline_ms = req.get("deadline_ms")
+                deadline_s = (
+                    float(deadline_ms) / 1000.0
+                    if deadline_ms is not None
+                    else None
+                )
+                t0 = time.monotonic()
+                try:
+                    ticket = batcher.submit(
+                        req.get("inputs") or {}, deadline_s=deadline_s
+                    )
+                    outputs, meta = ticket.result(
+                        timeout=(deadline_s or batcher.default_deadline_s)
+                        + 1.0
+                    )
+                except QueueFullError as e:
+                    self._reply(
+                        {"error": str(e), "retry_after_s": e.retry_after},
+                        429,
+                        headers=(
+                            ("Retry-After", f"{e.retry_after:.3f}"),
+                        ),
+                    )
+                    return
+                except (DeadlineExceededError, TimeoutError) as e:
+                    self._reply({"error": str(e)}, 504)
+                    return
+                except NotReadyError as e:
+                    self._reply({"error": str(e)}, 503)
+                    return
+                except ValueError as e:
+                    self._reply({"error": str(e)}, 400)
+                    return
+                except Exception as e:
+                    self._reply({"error": str(e)}, 500)
+                    return
+                self._reply(
+                    {
+                        "outputs": {
+                            k: v.tolist() for k, v in outputs.items()
+                        },
+                        "weights_step": meta["weights_step"],
+                        "weights_generation": meta["weights_generation"],
+                        "latency_ms": round(
+                            (time.monotonic() - t0) * 1000.0, 3
+                        ),
+                    }
+                )
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "ServingServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="edl-serve"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class ServingReplica:
+    """One serving replica's control-plane driver: warm -> register ->
+    serve -> heartbeat/report until stopped.
+
+    ``coordinator`` is the SERVING world's coordinator (Local or HTTP —
+    the same membership/generation/telemetry machinery the training
+    world runs; a serving fleet is just another replica set the
+    autoscaler scales between [min, max]).  Warm-before-register is the
+    scale-up contract: by the time this replica appears in the plan
+    (and a load balancer could route to it), every bucketed forward is
+    a held executable — its first request performs zero XLA compiles.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        batcher: Optional[ContinuousBatcher] = None,
+        server: Optional[ServingServer] = None,
+        coordinator=None,
+        replica_id: str = "",
+        address: str = "",
+        heartbeat_interval: float = 2.0,
+        telemetry_interval: float = 5.0,
+    ):
+        self.engine = engine
+        self.batcher = batcher or ContinuousBatcher(engine)
+        self.server = server
+        self.coordinator = coordinator
+        self.replica_id = replica_id or f"serve-{uuid.uuid4().hex[:8]}"
+        self.address = address
+        self.heartbeat_interval = heartbeat_interval
+        self.telemetry_interval = telemetry_interval
+        self._stop_evt: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+        self._seq = 0
+        self._events_sent_seq = 0
+        self._boot = uuid.uuid4().hex[:12]
+        from edl_tpu import telemetry
+
+        self.telemetry = telemetry.get_registry()
+        self.recorder = telemetry.get_recorder()
+        self._m_reports = self.telemetry.counter(
+            "edl_telemetry_reports_total"
+        )
+
+    def start(self) -> "ServingReplica":
+        loaded = self.engine.load()
+        # Warm BEFORE register: see the class doc (the prewarm/scale-up
+        # contract).  Warming needs no weights — it lowers from
+        # abstract shapes — so even a not-yet-ready replica boots hot.
+        self.engine.warm()
+        self.batcher.start()
+        if self.server is not None:
+            self.server.start()
+        if self.coordinator is not None:
+            self.coordinator.register(self.replica_id, address=self.address)
+            self._start_background()
+        self.recorder.record(
+            "serve.replica",
+            {
+                "replica": self.replica_id,
+                "model": self.engine.model.name,
+                "loaded": bool(loaded),
+                "warm_buckets": list(self.engine.warm_buckets),
+            },
+            step=max(0, self.engine.weights_step),
+        )
+        return self
+
+    def stop(self) -> None:
+        if self._stop_evt is not None:
+            self._stop_evt.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=10)
+        if self.coordinator is not None:
+            try:
+                self.coordinator.deregister(self.replica_id)
+            except Exception:
+                pass
+        self.batcher.stop()
+        if self.server is not None:
+            self.server.stop()
+
+    # -- heartbeat + telemetry cadence (the training stack's shape) ---------
+    def _start_background(self) -> None:
+        self._stop_evt = threading.Event()
+
+        def loop():
+            last_report = 0.0
+            while not self._stop_evt.wait(
+                max(self.heartbeat_interval, 0.05)
+            ):
+                self._beat_once()
+                now = time.monotonic()
+                if (
+                    self.telemetry_interval > 0
+                    and now - last_report >= self.telemetry_interval
+                ):
+                    last_report = now
+                    self._report_telemetry()
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="edl-serve-heartbeat"
+        )
+        self._thread.start()
+
+    def _beat_once(self) -> None:
+        try:
+            self.coordinator.heartbeat(self.replica_id)
+        except KeyError:
+            # Evicted while alive (long GC/compile outlived the lease):
+            # rejoin, same as a trainer (elastic._beat_once).
+            try:
+                self.coordinator.register(
+                    self.replica_id, address=self.address
+                )
+            except Exception:
+                pass
+        except Exception:
+            pass  # coordinator unreachable; retry next beat
+
+    def _report_telemetry(self) -> None:
+        rep = getattr(self.coordinator, "report_telemetry", None)
+        if rep is None:
+            return
+        events = self.recorder.events_since(self._events_sent_seq)[:64]
+        self._seq += 1
+        try:
+            rep(
+                self.replica_id,
+                snapshot=self.telemetry.snapshot(),
+                seq=self._seq,
+                events=[e.to_dict() for e in events],
+                boot=self._boot,
+            )
+        except Exception:
+            return  # best effort, like the trainer's cadence
+        if events:
+            self._events_sent_seq = events[-1].seq
+        self._m_reports.inc()
+
+    def tick(self) -> None:
+        """Synchronous heartbeat+report (tests / single-threaded
+        drivers that don't want the background thread)."""
+        self._beat_once()
+        self._report_telemetry()
+
+
+def serve_run(
+    entrypoint: str = "",
+    coordinator_addr: str = "",
+    checkpoint_dir: str = "",
+    port: int = 0,
+    max_batch: int = 0,
+    queue_limit: int = 0,
+    deadline_ms: int = 0,
+    pod_address: str = "",
+    replica_id: str = "",
+) -> ServingReplica:
+    """Build a serving replica from args + the ``EDL_SERVE_*`` pod env
+    contract (the launcher analog for the serving workload).  Returns
+    the started replica; the caller owns its lifetime."""
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.launcher import configure_compile_cache, env_config
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coord_service import HTTPCoordinator
+
+    cfg = env_config()
+    configure_compile_cache(cfg["compile_cache_dir"])
+    model = get_model(
+        entrypoint or cfg["entrypoint"] or "mnist",
+        workspace=cfg["workspace"],
+    )
+    spill = checkpoint_dir or cfg["checkpoint_dir"]
+    store = HostDRAMStore(spill_dir=spill or None)
+    engine = InferenceEngine(
+        model,
+        store,
+        max_batch=max_batch or cfg["serve_max_batch"],
+    )
+    batcher = ContinuousBatcher(
+        engine,
+        queue_limit=queue_limit or cfg["serve_queue_limit"],
+        default_deadline_s=(deadline_ms or cfg["serve_deadline_ms"])
+        / 1000.0,
+    )
+    server = ServingServer(batcher, port=port or cfg["serve_port"])
+    coordinator = None
+    if coordinator_addr or cfg["coordinator_addr"]:
+        coordinator = HTTPCoordinator(
+            coordinator_addr or cfg["coordinator_addr"]
+        )
+    replica = ServingReplica(
+        engine,
+        batcher,
+        server,
+        coordinator=coordinator,
+        replica_id=replica_id or cfg["pod_name"],
+        address=pod_address or cfg["pod_address"],
+        telemetry_interval=cfg["telemetry_interval"],
+    )
+    return replica.start()
+
+
+def main(argv=None):  # pragma: no cover - pod entrypoint
+    import argparse
+
+    p = argparse.ArgumentParser(description="EDL-TPU serving replica")
+    p.add_argument("--entrypoint", default="", help="registered model name")
+    p.add_argument("--coordinator", default="", help="serving coordinator")
+    p.add_argument("--checkpoint-dir", default="")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--max-batch", type=int, default=0)
+    p.add_argument("--queue-limit", type=int, default=0)
+    p.add_argument("--deadline-ms", type=int, default=0)
+    p.add_argument("--platform", default="")
+    args = p.parse_args(argv)
+    if args.platform:
+        from edl_tpu.launcher import force_platform
+
+        force_platform(args.platform)
+    replica = serve_run(
+        entrypoint=args.entrypoint,
+        coordinator_addr=args.coordinator,
+        checkpoint_dir=args.checkpoint_dir,
+        port=args.port,
+        max_batch=args.max_batch,
+        queue_limit=args.queue_limit,
+        deadline_ms=args.deadline_ms,
+    )
+    print(
+        f"edl-tpu serving replica {replica.replica_id} "
+        f"({replica.engine.model.name}) on port "
+        f"{replica.server.port if replica.server else '-'}"
+    )
+    threading.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

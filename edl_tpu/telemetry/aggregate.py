@@ -53,6 +53,45 @@ def coord_snapshot_gauges(metrics: dict) -> dict:
     return {"counters": {}, "gauges": gauges, "histograms": {}}
 
 
+def histogram_quantile(hist: Optional[dict], q: float) -> Optional[float]:
+    """Prometheus-style quantile estimate from one snapshot-shaped
+    histogram series ``{"buckets", "counts", "sum", "count"}`` (or a
+    dict of label-keyed series, which are merged first — the serving
+    latency histogram is unlabeled, but a merged snapshot may carry an
+    overflow series).  Linear interpolation within the winning bucket;
+    observations in the +Inf bucket clamp to the largest finite bound
+    (the standard histogram_quantile() behavior).  None when empty."""
+    if not hist:
+        return None
+    if "counts" not in hist:  # label-keyed dict of series: merge
+        series = [h for h in hist.values() if h and h.get("count")]
+        if not series:
+            return None
+        buckets = list(series[0]["buckets"])
+        counts = [0.0] * (len(buckets) + 1)
+        for h in series:
+            if list(h["buckets"]) != buckets:
+                continue  # bucket-schema skew: skip (rolling upgrade)
+            for i, c in enumerate(h["counts"]):
+                counts[i] += c
+        hist = {"buckets": buckets, "counts": counts,
+                "count": sum(counts)}
+    total = hist.get("count") or sum(hist["counts"])
+    if not total:
+        return None
+    rank = max(0.0, min(1.0, q)) * total
+    cum = 0.0
+    buckets = hist["buckets"]
+    for i, c in enumerate(hist["counts"][: len(buckets)]):
+        prev_cum = cum
+        cum += c
+        if cum >= rank and c > 0:
+            lo = buckets[i - 1] if i > 0 else 0.0
+            hi = buckets[i]
+            return lo + (hi - lo) * ((rank - prev_cum) / c)
+    return float(buckets[-1]) if buckets else None
+
+
 class TelemetryAggregator:
     """Latest-cumulative-snapshot-per-source merge (see module doc)."""
 

@@ -310,6 +310,61 @@ CATALOG = {
         "actually stepping (stepping / total ledger seconds).",
         "labels": (),
     },
+    # -- elastic inference serving (edl_tpu.serving) -------------------------
+    "edl_serve_requests_total": {
+        "type": "counter",
+        "help": "Serving requests by terminal status (ok / rejected "
+        "on backpressure / expired past deadline / error).",
+        "labels": ("status",),
+    },
+    "edl_serve_batches_total": {
+        "type": "counter",
+        "help": "Micro-batches the continuous batcher dispatched.",
+        "labels": (),
+    },
+    "edl_serve_examples_total": {
+        "type": "counter",
+        "help": "Examples served (request rows, padding excluded).",
+        "labels": (),
+    },
+    "edl_serve_queue_depth": {
+        "type": "gauge",
+        "help": "Requests waiting in the admission queue (the "
+        "backpressure / autoscaling signal).",
+        "labels": (),
+    },
+    "edl_serve_latency_seconds": {
+        "type": "histogram",
+        "help": "End-to-end request latency (admission to response; "
+        "the serving lane reads its p95 from the merged telemetry).",
+        "labels": (),
+    },
+    "edl_serve_batch_occupancy": {
+        "type": "histogram",
+        "help": "Real rows / padded bucket rows per dispatched "
+        "micro-batch (1.0 = no padding waste).",
+        "buckets": (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        "labels": (),
+    },
+    "edl_serve_hot_swaps_total": {
+        "type": "counter",
+        "help": "Checkpoint hot-swaps installed between batches "
+        "(generation-keyed; an in-flight batch never sees torn "
+        "weights).",
+        "labels": (),
+    },
+    "edl_serve_swap_rejected_total": {
+        "type": "counter",
+        "help": "Candidate checkpoints rejected at a hot-swap attempt "
+        "(CRC verification failed / unreadable durable spill) — the "
+        "engine keeps serving the old weights.",
+        "labels": (),
+    },
+    "edl_serve_weights_step": {
+        "type": "gauge",
+        "help": "Training step of the checkpoint currently serving.",
+        "labels": (),
+    },
     # -- tracing / flight-recorder plumbing ----------------------------------
     "edl_flight_spill_dropped_total": {
         "type": "counter",
@@ -358,6 +413,11 @@ KNOWN_EVENT_KINDS = {
     "chaos": "a scheduled fault was actually delivered",
     # autoscaler
     "autoscaler.decision": "one goodput-annotated decision-log entry",
+    # elastic inference serving (edl_tpu.serving)
+    "serve.warm": "a padded-bucket forward executable AOT-compiled",
+    "serve.swap": "a newer verified checkpoint hot-swapped in",
+    "serve.swap.rejected": "a hot-swap candidate failed verification",
+    "serve.replica": "a serving replica registered / took traffic",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

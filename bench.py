@@ -391,223 +391,25 @@ def bench_mnist_throughput(steps: int = 20) -> dict:
 
 
 def bench_serving() -> dict:
-    """Elastic inference serving (edl_tpu.serving): offered-load sweep
-    (p50/p95 latency, examples/s, batch occupancy), the ZERO-compile
-    steady-state request path (asserted at the backend_compile seam,
-    same as warm resizes), a checkpoint hot-swap with zero
-    failed/dropped requests (+ the swap pause), and a scale-up replica
-    answering its FIRST request on a pre-warmed executable."""
-    import threading
-    import time
+    """Elastic inference serving — moved to ``bench_lib.serving`` (the
+    ROADMAP-item-5 per-section split; the sweep now rides the shared
+    OPEN-LOOP arrival generator in ``bench_lib.load``)."""
+    from bench_lib.serving import bench_serving as _bench_serving
 
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
+    return _bench_serving()
 
-    from edl_tpu import telemetry
-    from edl_tpu.checkpoint import HostDRAMStore
-    from edl_tpu.models.base import get_model
-    from edl_tpu.runtime.train import TrainState
-    from edl_tpu.serving import ContinuousBatcher, InferenceEngine
-    from edl_tpu.telemetry.aggregate import histogram_quantile
 
-    model = get_model("mnist")
-    params = model.init_params(jax.random.key(0))
-    opt = optax.adam(1e-3)
+def bench_fleet() -> dict:
+    """Multi-job fleet market under a scripted traffic storm
+    (``bench_lib.fleet``): REAL launcher pods, one chip inventory, a
+    serving p95 spike that preempts the lowest-priority trainer via a
+    consensus-clean scale-down and gives the chips back on recovery —
+    cluster-wide goodput decomposition, chips-over-time, SLO
+    attainment, stop-step skew (asserted 0), and the storm's
+    warm-resize true-compile count (from real worker journals)."""
+    from bench_lib.fleet import bench_fleet as _bench_fleet
 
-    def state_at(step: int) -> TrainState:
-        return TrainState(
-            step=jnp.asarray(step, jnp.int32),
-            params=params,
-            opt_state=opt.init(params),
-        )
-
-    store = HostDRAMStore()
-    store.save_async(state_at(0))
-    store.wait()
-    engine = InferenceEngine(
-        model, store, devices=jax.devices()[:1], max_batch=32
-    )
-    engine.load()
-    engine.warm()
-    reg = telemetry.get_registry()
-    m_requests = reg.counter("edl_serve_requests_total")
-    h_latency = reg.histogram("edl_serve_latency_seconds")
-    h_occupancy = reg.histogram("edl_serve_batch_occupancy")
-    batcher = ContinuousBatcher(
-        engine, queue_limit=8192, default_deadline_s=60.0
-    ).start()
-
-    def _hist_delta(after, before):
-        if after is None:
-            return None
-        if before is None:
-            return after
-        return {
-            "buckets": list(after["buckets"]),
-            "counts": [
-                a - b for a, b in zip(after["counts"], before["counts"])
-            ],
-            "sum": after["sum"] - before["sum"],
-            "count": after["count"] - before["count"],
-        }
-
-    rng = np.random.RandomState(0)
-    pool = model.synth_batch(rng, 64)["image"]
-
-    # Everything below the seam must be compile-free: the sweep, the
-    # hot swap, and the pre-warmed scale-up replica's first request.
-    import jax._src.compiler as _compiler
-
-    m_compiles = telemetry.get_registry().counter("edl_xla_compiles_total")
-    compiles_before = m_compiles.value()
-    _real_bc = _compiler.backend_compile
-
-    def _counting_bc(*args, **kwargs):
-        m_compiles.inc()
-        return _real_bc(*args, **kwargs)
-
-    _compiler.backend_compile = _counting_bc
-    try:
-        # -- offered-load sweep ------------------------------------------
-        sweep = []
-        for offered_rps in (50, 200, 800):
-            lat0 = h_latency.series()
-            occ0 = h_occupancy.series()
-            n_req = max(32, min(256, offered_rps))
-            tickets = []
-            t0 = time.perf_counter()
-            for i in range(n_req):
-                row = pool[i % len(pool)][None]
-                tickets.append(batcher.submit({"image": row}))
-                time.sleep(1.0 / offered_rps)
-            for t in tickets:
-                t.result(timeout=120)
-            elapsed = time.perf_counter() - t0
-            lat = _hist_delta(h_latency.series(), lat0)
-            occ = _hist_delta(h_occupancy.series(), occ0)
-            p50 = histogram_quantile(lat, 0.5)
-            p95 = histogram_quantile(lat, 0.95)
-            sweep.append(
-                {
-                    "offered_rps": offered_rps,
-                    "achieved_rps": round(n_req / elapsed, 1),
-                    "examples_per_s": round(n_req / elapsed, 1),
-                    "p50_ms": round(p50 * 1000, 3) if p50 else None,
-                    "p95_ms": round(p95 * 1000, 3) if p95 else None,
-                    "occupancy_mean": (
-                        round(occ["sum"] / occ["count"], 4)
-                        if occ and occ["count"]
-                        else None
-                    ),
-                }
-            )
-
-        # -- hot swap under load -----------------------------------------
-        ok0 = m_requests.value(status="ok")
-        err0 = m_requests.value(status="error") + m_requests.value(
-            status="expired"
-        ) + m_requests.value(status="rejected")
-        gen0 = engine.weights_generation
-        lat0 = h_latency.series()
-        stop = threading.Event()
-        swap_tickets = []
-
-        def stream():
-            i = 0
-            while not stop.is_set():
-                swap_tickets.append(
-                    batcher.submit({"image": pool[i % len(pool)][None]})
-                )
-                i += 1
-                time.sleep(0.002)
-
-        th = threading.Thread(target=stream, daemon=True)
-        th.start()
-        time.sleep(0.1)
-        store.save_async(state_at(100))
-        store.wait()
-        t_swap = time.perf_counter()
-        while engine.weights_generation == gen0:
-            if time.perf_counter() - t_swap > 30:
-                break
-            time.sleep(0.002)
-        swap_latency_s = time.perf_counter() - t_swap
-        time.sleep(0.1)
-        stop.set()
-        th.join(timeout=10)
-        for t in swap_tickets:
-            t.result(timeout=120)
-        failed = (
-            m_requests.value(status="error")
-            + m_requests.value(status="expired")
-            + m_requests.value(status="rejected")
-            - err0
-        )
-        swap_lat = _hist_delta(h_latency.series(), lat0)
-        swap_p95 = histogram_quantile(swap_lat, 0.95)
-        hot_swap = {
-            "swapped": engine.weights_generation > gen0,
-            "to_step": engine.weights_step,
-            # submission->install observed from the request stream's
-            # side: the serving gap a swap can add at worst
-            "swap_latency_ms": round(swap_latency_s * 1000, 3),
-            "requests_during_swap": len(swap_tickets),
-            "completed": int(m_requests.value(status="ok") - ok0),
-            "failed_or_dropped": int(failed),
-            "p95_ms_during_swap": (
-                round(swap_p95 * 1000, 3) if swap_p95 else None
-            ),
-        }
-        assert hot_swap["swapped"], "hot swap never installed"
-        assert failed == 0, f"{failed} requests failed/dropped in the swap"
-
-        # Steady state = the sweep + the hot swap: both must have
-        # performed ZERO true compiles (the warmed executables carried
-        # every bucket, and the swap re-binds params, not programs).
-        steady_compiles = int(m_compiles.value() - compiles_before)
-        assert steady_compiles == 0, (
-            f"{steady_compiles} XLA compiles on the steady request path"
-        )
-
-        # -- scale-up replica: first request on a pre-warmed executable --
-        engine2 = InferenceEngine(
-            model, store, devices=jax.devices()[:1], max_batch=32
-        )
-        engine2.load()
-        warm_t0 = time.perf_counter()
-        engine2.warm()  # before taking traffic (the /prewarm contract);
-        warm_s = time.perf_counter() - warm_t0
-        compiles_mark = m_compiles.value()
-        t0 = time.perf_counter()
-        out, meta = engine2.predict(
-            engine2.coerce_inputs({"image": pool[:1]})[0]
-        )
-        first_request_s = time.perf_counter() - t0
-        scale_up = {
-            "warm_buckets": list(engine2.warm_buckets),
-            "warm_s": round(warm_s, 4),
-            "first_request_ms": round(first_request_s * 1000, 3),
-            "first_request_xla_compiles": int(
-                m_compiles.value() - compiles_mark
-            ),
-            "weights_step": meta["weights_step"],
-        }
-        assert scale_up["first_request_xla_compiles"] == 0
-    finally:
-        batcher.stop()
-        _compiler.backend_compile = _real_bc
-
-    return {
-        "model": "mnist",
-        "buckets": list(engine.buckets),
-        "sweep": sweep,
-        "p95_latency_ms": sweep[-1]["p95_ms"],
-        "steady_state_xla_compiles": steady_compiles,
-        "hot_swap": hot_swap,
-        "scale_up": scale_up,
-    }
+    return _bench_fleet()
 
 
 def bench_steady_state(steps: int = 30) -> dict:
@@ -1136,6 +938,12 @@ def _attempt(fn, label: str, retries: int = 1):
     return {"error": err[:500]}
 
 
+def _platform() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
 def _lm_summary(r: dict) -> dict:
     """Per-model bench summary (one shape for every LM section); error
     and skipped records pass through untouched.  Model-specific quality
@@ -1188,6 +996,7 @@ def main():
     restore = _attempt(bench_restore_paths, "restore_paths", retries=0)
     scale_down = _attempt(bench_scale_down, "scale_down", retries=0)
     serving = _attempt(bench_serving, "serving", retries=0)
+    fleet = _attempt(bench_fleet, "fleet", retries=0)
     if "error" in r:
         # The headline section itself died: emit an explicit error record
         # rather than nothing (the driver still gets one JSON line).
@@ -1208,7 +1017,8 @@ def main():
                                "cpu_cross_size": cross,
                                "restore_paths": restore,
                                "scale_down": scale_down,
-                               "serving": serving},
+                               "serving": serving,
+                               "fleet": fleet},
                 }
             )
         )
@@ -1269,6 +1079,14 @@ def main():
                     # hot-swap with zero failed/dropped requests,
                     # pre-warmed scale-up first request
                     "serving": serving,
+                    # multi-job fleet market: scripted storm on real
+                    # processes — spike -> consensus-clean preemption
+                    # of the lowest-priority trainer -> recovery, with
+                    # per-job goodput, chips-over-time, SLO attainment
+                    "fleet": fleet,
+                    # platform honesty: TPU rounds and CPU-box rounds
+                    # must not be compared line to line
+                    "platform": _platform(),
                 },
             }
         )

@@ -1,0 +1,11 @@
+"""bench_lib — per-section benchmark modules.
+
+ROADMAP item 5's split of the monolithic ``bench.py``: each bench
+section that outgrows a screenful moves into its own module here, and
+``bench.py`` stays the driver that composes sections into the ONE JSON
+round record.  Sections land here as they grow — serving and the fleet
+storm first (this round), the remaining sections as they next change.
+
+Shared harness pieces (the open-loop load generator) live here too so
+every "heavy traffic" claim in the record is measured the same way.
+"""

@@ -1,0 +1,469 @@
+"""Bench section: the fleet arbiter under a scripted traffic storm.
+
+REAL processes, the whole market loop: a low-priority elastic trainer
+(two launcher pods through an HTTP coordinator), a high-priority
+protected trainer (one pod, its own coordinator), and a serving fleet
+whose SLO signals are SCRIPTED (the storm: calm → p95 spike → clear).
+The ``FleetArbiter`` ticks against one chip inventory sized so the
+calm state is exactly full — the spike can only be absorbed by
+preempting the lowest-priority trainer, and the recovery must give the
+chips back.
+
+What the record publishes (and the tier-1 test asserts):
+
+- the preemption is a CONSENSUS-CLEAN scale-down: both members of the
+  victim world leave at one agreed stop step (skew 0 across their
+  journals), and the serving grant lands only after the victim-drain
+  ack;
+- every transition carries its own minted trace id from the fleet
+  decision through vote/quiesce/resize to the first post-resize step;
+- warm resizes perform ZERO true XLA compiles (the launcher's
+  ``EDL_COUNT_XLA_COMPILES`` seam journals the per-window count into
+  each member's ``step.first`` event);
+- cluster-wide goodput decomposition per job (PR 7's ledger, read from
+  each coordinator's merged telemetry), chips-over-time, and SLO
+  attainment (the fraction of storm ticks whose serving requirement
+  the market covered).
+
+``run_fleet_storm`` is the shared driver: ``bench.py fleet`` publishes
+its summary; ``tests/test_fleet_process.py`` asserts its invariants.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STEPS = 200_000  # workers stop by SIGTERM, never by running out
+
+
+def _read_lines(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # partially written tail line
+    return out
+
+
+def _history(path):
+    return [r for r in _read_lines(path) if "step" in r]
+
+
+def _resizes(path):
+    return [r["resize"] for r in _read_lines(path) if "resize" in r]
+
+
+def _steps_at(path, world):
+    return [
+        r["step"] for r in _history(path) if r.get("world_size") == world
+    ]
+
+
+def _wait_for(pred, timeout, what, procs):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        for p in procs:
+            if p.poll() is not None and p.returncode != 0:
+                out = p.stdout.read() if p.stdout else ""
+                raise RuntimeError(
+                    f"fleet worker died (rc={p.returncode}) waiting for "
+                    f"{what}:\n{out[-3000:]}"
+                )
+        time.sleep(0.25)
+    dumps = []
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            try:
+                p.wait(timeout=10)
+            except Exception:
+                pass
+        out = p.stdout.read() if p.stdout else ""
+        dumps.append(f"--- worker rc={p.returncode} ---\n{out[-2000:]}")
+    raise RuntimeError(
+        f"fleet storm timed out waiting for {what}\n" + "\n".join(dumps)
+    )
+
+
+def _spawn(procs, name, caddr, base_port, workdir, cache_dir):
+    env = dict(os.environ)
+    env["EDL_POD_NAME"] = name
+    env["EDL_FLIGHT_RECORDER_FILE"] = os.path.join(
+        workdir, f"{name}.events.jsonl"
+    )
+    # The compile-count seam: each resize window's TRUE-compile delta
+    # journals into the member's step.first events, which is what lets
+    # the zero-compile warm-resize claim hold for REAL processes.
+    env["EDL_COUNT_XLA_COMPILES"] = "1"
+    # Shared persistent XLA cache: a size compiled ONCE (by any pod,
+    # any generation) deserializes ever after — the deployed-pod
+    # contract (spec.compile_cache_dir), required for the storm's
+    # warm-resize zero-compile invariant.
+    env["EDL_COMPILE_CACHE_DIR"] = cache_dir
+    # Tight telemetry cadence so goodput/clock reports land between
+    # storm phases.
+    env["EDL_TELEMETRY_INTERVAL"] = "1.0"
+    env["XLA_FLAGS"] = " ".join(
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
+    p = subprocess.Popen(
+        [
+            sys.executable, "-u", "-m", "edl_tpu.launcher",
+            "--entrypoint", "fit_a_line",
+            "--steps", str(STEPS),
+            "--coordinator", caddr,
+            "--address", f"127.0.0.1:{base_port}",
+            "--platform", "cpu",
+            "--global-batch-size", "8",
+            "--checkpoint-interval", "25",
+            "--history-file", os.path.join(workdir, f"{name}.jsonl"),
+            "--lr", "1e-2",
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    procs.append(p)
+    return p
+
+
+def run_fleet_storm(
+    workdir: str,
+    base_port: int = 13500,
+    calm_ticks: int = 2,
+    settle_s: float = 240.0,
+) -> dict:
+    """Drive the storm; returns the full record (see module doc)."""
+    from edl_tpu.autoscaler.serving import ServingLane
+    from edl_tpu.fleet import FleetArbiter, ServingBidder, TrainingBidder
+    from edl_tpu.runtime.coord_service import (
+        CoordinatorServer,
+        HTTPCoordinator,
+    )
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    os.makedirs(workdir, exist_ok=True)
+    cache_dir = os.environ.get("EDL_COMPILE_CACHE_DIR") or os.path.join(
+        workdir, "xla-cache"
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+
+    # One chip inventory: lo(2) + hi(1) + serve(1) == 4 — calm is full.
+    total_chips = 4
+    lo_coord = LocalCoordinator(
+        target_world=2, max_world=2, heartbeat_timeout=60.0,
+        legal_sizes=[1, 2],
+    )
+    hi_coord = LocalCoordinator(
+        target_world=1, max_world=1, heartbeat_timeout=60.0,
+        legal_sizes=[1],
+    )
+    serve_coord = LocalCoordinator(target_world=1, max_world=2)
+    lo_server = CoordinatorServer(lo_coord, host="127.0.0.1", port=0).start()
+    hi_server = CoordinatorServer(hi_coord, host="127.0.0.1", port=0).start()
+    lo_addr = f"127.0.0.1:{lo_server.port}"
+    hi_addr = f"127.0.0.1:{hi_server.port}"
+
+    hist = {
+        n: os.path.join(workdir, f"{n}.jsonl")
+        for n in ("lo-a", "lo-b", "hi-a")
+    }
+    events = {
+        n: os.path.join(workdir, f"{n}.events.jsonl")
+        for n in ("lo-a", "lo-b", "hi-a")
+    }
+    procs = []
+    timeline = []
+    t_start = time.monotonic()
+
+    def tick(arbiter, phase):
+        rec = arbiter.run_once()
+        timeline.append(
+            {
+                "t_s": round(time.monotonic() - t_start, 3),
+                "phase": phase,
+                "record": rec,
+            }
+        )
+        return rec
+
+    try:
+        # -- phase A: form the calm fleet (warming every size) -----------
+        _spawn(procs, "lo-a", lo_addr, base_port, workdir, cache_dir)
+        _wait_for(
+            lambda: len(_steps_at(hist["lo-a"], 1)) >= 3,
+            settle_s, "lo-a stepping at world 1", procs,
+        )
+        _spawn(procs, "lo-b", lo_addr, base_port + 100, workdir, cache_dir)
+        _wait_for(
+            lambda: all(
+                len(_steps_at(hist[n], 2)) >= 3 for n in ("lo-a", "lo-b")
+            ),
+            settle_s, "the lo world to step at 2", procs,
+        )
+        _spawn(procs, "hi-a", hi_addr, base_port + 200, workdir, cache_dir)
+        _wait_for(
+            lambda: len(_steps_at(hist["hi-a"], 1)) >= 3,
+            settle_s, "hi-a stepping at world 1", procs,
+        )
+
+        # -- the market -------------------------------------------------
+        scripted = {
+            "p95_latency_s": 0.01,
+            "queue_depth": 0,
+            "rejected_total": None,
+        }
+        lane = ServingLane(
+            serve_coord, min_replicas=1, max_replicas=2, hold_ticks=2
+        )
+        arbiter = FleetArbiter(
+            total_chips,
+            trainers=[
+                TrainingBidder(
+                    "lo", HTTPCoordinator(lo_addr), priority=0,
+                    min_units=1, max_units=2, legal_units=[1, 2],
+                ),
+                TrainingBidder(
+                    "hi", HTTPCoordinator(hi_addr), priority=10,
+                    min_units=1, max_units=1,
+                ),
+            ],
+            fleets=[
+                ServingBidder(
+                    "api", lane, signals=lambda: dict(scripted)
+                )
+            ],
+            victim_drain_timeout=60.0,
+        )
+
+        # -- phase B: calm — the market is at its fixed point ------------
+        calm = [tick(arbiter, "calm") for _ in range(calm_ticks)]
+        calm_diffs = sum(
+            abs(d["dry_run"]["diff"])
+            for rec in calm
+            if rec
+            for d in rec["decisions"]
+        )
+
+        # -- phase C: spike — serving p95 blows the SLO ------------------
+        scripted["p95_latency_s"] = 2.0
+        t_spike = time.monotonic()
+        spike = tick(arbiter, "spike")
+        hi_gen_at_spike = hi_coord.generation()
+        _wait_for(
+            lambda: any(
+                s > max(_steps_at(hist["lo-a"], 2) or [0])
+                for s in _steps_at(hist["lo-a"], 1)
+            ),
+            settle_s, "the lo survivor stepping at world 1", procs,
+        )
+        spike_to_preempted_s = time.monotonic() - t_spike
+        spike_hold = [tick(arbiter, "spike-hold") for _ in range(2)]
+
+        # Stop-step skew: both lo members' last world-2 steps must be
+        # the SAME boundary (the consensus agreement's claim).
+        last_old = {
+            n: max(_steps_at(hist[n], 2)) for n in ("lo-a", "lo-b")
+        }
+        skew = max(last_old.values()) - min(last_old.values())
+        down = [
+            r for r in _resizes(hist["lo-a"]) if r["world_size"] == 1
+        ]
+        stop_step = down[-1]["stop_step"] if down else -1
+        assert skew == 0, f"stop-step skew {skew}: {last_old}"
+        assert serve_coord.target_world() == 2, "serving fleet never grew"
+
+        # -- phase D: clear — chips must come back -----------------------
+        scripted["p95_latency_s"] = 0.001
+        t_clear = time.monotonic()
+        recover = []
+        for i in range(4):  # hysteresis holds, then sheds + restores
+            recover.append(tick(arbiter, "recover"))
+            if serve_coord.target_world() == 1:
+                break
+        down_mark = len(_history(hist["lo-a"]))
+        _wait_for(
+            lambda: any(
+                r.get("world_size") == 2
+                for r in _history(hist["lo-a"])[down_mark:]
+            ),
+            settle_s, "lo restored to world 2", procs,
+        )
+        recover_to_restored_s = time.monotonic() - t_clear
+        assert serve_coord.target_world() == 1, "serving never shed"
+
+        # One more telemetry cadence so tails/goodput reach coordinators.
+        time.sleep(2.5)
+        goodput = {}
+        for name, addr in (("lo", lo_addr), ("hi", hi_addr)):
+            try:
+                goodput[name] = HTTPCoordinator(addr).telemetry().get(
+                    "goodput"
+                )
+            except Exception:
+                goodput[name] = None
+        # Before the SIGTERMs: a graceful leave bumps the generation.
+        hi_generation_stable = hi_coord.generation() == hi_gen_at_spike
+
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=60)
+
+        # -- reduce ------------------------------------------------------
+        ticks = [t for t in timeline if t["record"]]
+        preemptions = [
+            p
+            for t in ticks
+            for p in t["record"]["preemptions"]
+        ]
+        storm_ticks = [
+            t for t in ticks if t["phase"] in ("spike", "spike-hold", "recover")
+        ]
+        covered = 0
+        for t in storm_ticks:
+            serving = [
+                d
+                for d in t["record"]["decisions"]
+                if d["kind"] == "serving"
+            ]
+            if all(
+                (d["required_units"] or 0) <= d["dry_run"]["proposed"]
+                for d in serving
+            ):
+                covered += 1
+        slo_attainment = covered / max(1, len(storm_ticks))
+
+        def entry(rec, job):
+            for d in rec["decisions"]:
+                if d["job"] == job:
+                    return d
+            return None
+
+        traces = {
+            "preempt_down": (entry(spike, "lo") or {}).get("trace_id"),
+            "preempt_serve_up": (entry(spike, "api") or {}).get("trace_id"),
+        }
+        for rec in recover:
+            if rec and entry(rec, "lo") and entry(rec, "lo")["dry_run"]["diff"] > 0:
+                traces["restore_up"] = entry(rec, "lo")["trace_id"]
+                traces["restore_serve_down"] = (
+                    entry(rec, "api") or {}
+                ).get("trace_id")
+
+        member_events = {n: _read_lines(events[n]) for n in events}
+        hi_resizes = _resizes(hist["hi-a"])
+        record = {
+            "chips_total": total_chips,
+            "processes": 3,
+            "calm_tick_diffs": calm_diffs,
+            "preemptions": preemptions,
+            "victim": preemptions[0]["victim"] if preemptions else None,
+            "stop_step": stop_step,
+            "stop_skew_steps": skew,
+            "spike_to_preempted_s": round(spike_to_preempted_s, 3),
+            "recover_to_restored_s": round(recover_to_restored_s, 3),
+            "slo_attainment": round(slo_attainment, 4),
+            "goodput": goodput,
+            "chips_over_time": [
+                {
+                    "t_s": t["t_s"],
+                    "phase": t["phase"],
+                    "free": t["record"]["free_chips"],
+                    "holdings": t["record"]["inventory"]["holdings"],
+                }
+                for t in ticks
+            ],
+            "traces": traces,
+            "ticks": ticks,
+            "member_events": member_events,
+            "histories": {n: _history(hist[n]) for n in hist},
+            "hi_resize_worlds": sorted(
+                {r["world_size"] for r in hi_resizes}
+            ),
+            "hi_generation_stable": hi_generation_stable,
+            "spike_record": spike,
+            "spike_hold": spike_hold,
+        }
+        assert record["victim"] == "lo", record["victim"]
+        return record
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        lo_server.stop()
+        hi_server.stop()
+
+
+def bench_fleet(workdir: str = "") -> dict:
+    """The publishable summary (the full record's journals stay out of
+    the round JSON)."""
+    import tempfile
+
+    workdir = workdir or tempfile.mkdtemp(prefix="edl-bench-fleet-")
+    r = run_fleet_storm(workdir, base_port=13900)
+    resize_compiles = _storm_resize_compiles(r)
+    return {
+        "chips_total": r["chips_total"],
+        "processes": r["processes"],
+        "victim": r["victim"],
+        "preemption_steps": len(r["preemptions"]),
+        "stop_step": r["stop_step"],
+        "stop_skew_steps": r["stop_skew_steps"],
+        "spike_to_preempted_s": r["spike_to_preempted_s"],
+        "recover_to_restored_s": r["recover_to_restored_s"],
+        "slo_attainment": r["slo_attainment"],
+        "storm_resize_xla_compiles": resize_compiles,
+        "goodput": r["goodput"],
+        "chips_over_time": r["chips_over_time"],
+        "hi_generation_stable": r["hi_generation_stable"],
+    }
+
+
+def _storm_resize_compiles(record: dict) -> int:
+    """Worst per-window TRUE-compile count across the storm's traced
+    transitions (preempt + restore), read from the members' step.first
+    journals: the warm-resize zero-compile bar, measured on real
+    processes.  Raises when ANY traced transition produced no counted
+    step.first — a journal that stopped carrying the evidence must
+    fail the section, not publish a vacuous 0 the ci gate waves
+    through (the 'gate that silently stops measuring' class)."""
+    worst = 0
+    for key in ("preempt_down", "restore_up"):
+        trace = record["traces"].get(key)
+        if not trace:
+            raise RuntimeError(f"storm transition {key} has no trace id")
+        matched = 0
+        for evs in record["member_events"].values():
+            for ev in evs:
+                if (
+                    ev.get("kind") == "step.first"
+                    and ev.get("trace") == trace
+                    and "xla_compiles" in (ev.get("data") or {})
+                ):
+                    matched += 1
+                    worst = max(worst, int(ev["data"]["xla_compiles"]))
+        if matched == 0:
+            raise RuntimeError(
+                f"no counted step.first journaled for {key} "
+                f"(trace {trace}): compile evidence missing"
+            )
+    return worst

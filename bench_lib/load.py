@@ -1,0 +1,79 @@
+"""Open-loop load generation: a fixed arrival process, not clients.
+
+Every serving/fleet "heavy traffic" figure in the round record must
+come from the SAME arrival discipline, and that discipline must be
+**open-loop**: arrivals fire at pre-scheduled instants regardless of
+how fast the system answers.  Closed-loop clients (submit, wait,
+submit) self-throttle exactly when the system degrades — they hide
+queueing collapse and flatter p95 under overload, which is the
+opposite of what an SLO bench is for.  (The pre-split serving sweep
+slept ``1/rate`` AFTER each submit, so its offered rate silently sank
+by the submit latency; this module schedules absolute arrival times.)
+
+``arrival_offsets`` is pure and seeded — deterministic schedules make
+sweep figures comparable across rounds.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, List, Sequence
+
+
+def arrival_offsets(
+    rate_rps: float,
+    n: int,
+    process: str = "uniform",
+    seed: int = 0,
+) -> List[float]:
+    """Scheduled arrival offsets (seconds from start) for ``n``
+    requests at ``rate_rps``: ``"uniform"`` = deterministic fixed
+    interarrival (the sweep default — lowest-variance estimate of a
+    rate's latency); ``"poisson"`` = seeded exponential interarrivals
+    of the same mean (burstier, for storm sections)."""
+    if rate_rps <= 0:
+        raise ValueError(f"rate_rps must be > 0, got {rate_rps}")
+    if process == "uniform":
+        return [i / rate_rps for i in range(n)]
+    if process == "poisson":
+        rng = random.Random(seed)
+        t, out = 0.0, []
+        for _ in range(n):
+            out.append(t)
+            t += rng.expovariate(rate_rps)
+        return out
+    raise ValueError(f"unknown arrival process {process!r}")
+
+
+def run_open_loop(
+    submit: Callable[[int], object],
+    offsets: Sequence[float],
+) -> tuple:
+    """Fire ``submit(i)`` at each scheduled offset (sleeping to the
+    absolute deadline, never adding per-request pacing on top of the
+    submit's own latency).  Returns ``(tickets, stats)`` where stats
+    records the offered vs achieved rate and the worst scheduler lag —
+    a lag comparable to the interarrival gap means the generator
+    itself became the bottleneck and the section should say so rather
+    than publish a fake "achieved" rate."""
+    tickets = []
+    lag_max = 0.0
+    t0 = time.perf_counter()
+    for i, off in enumerate(offsets):
+        now = time.perf_counter() - t0
+        if now < off:
+            time.sleep(off - now)
+        else:
+            lag_max = max(lag_max, now - off)
+        tickets.append(submit(i))
+    elapsed = time.perf_counter() - t0
+    n = len(offsets)
+    span = max(offsets[-1], 1e-9) if n else 1e-9
+    stats = {
+        "n": n,
+        "offered_rps": round((n - 1) / span, 1) if n > 1 else None,
+        "submit_elapsed_s": round(elapsed, 4),
+        "scheduler_lag_max_s": round(lag_max, 4),
+    }
+    return tickets, stats

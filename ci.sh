@@ -19,6 +19,16 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 python tools/lint.py
 
+# Structural bench-regression gates (ROADMAP item 5): assert the
+# per-section invariants — scale-down stop-step skew == 0, serving
+# steady-state XLA compiles == 0, warm-resize compiles == 0, fleet
+# SLO attainment, latency ceilings — against the checked-in
+# thresholds, over the committed BENCH snapshot (or a fresh record
+# via EDL_BENCH_RECORD=path).  Milliseconds; a violated baseline
+# fails before the suite spends its budget.
+python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r06.json}" \
+  --thresholds bench_thresholds.json
+
 # Stress lane (EDL_STRESS=1): rerun the multipod elastic scale-down
 # tests N times under the tier-1 timeout — the reproducer that hung
 # 2/5 runs on a loaded box before the consensus step bus (data-plane

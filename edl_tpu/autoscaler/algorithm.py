@@ -114,6 +114,11 @@ class JobView:
     #: ascending legal world sizes within [min, max]; empty = every size
     legal_sizes: List[int] = field(default_factory=list)
     elastic: bool = True
+    #: fleet-arbiter scheduling priority (``TrainingJobSpec.priority``,
+    #: higher = more important); the single-cluster fixed point here
+    #: ignores it, the multi-job market (``edl_tpu.fleet``) orders
+    #: growth by it and preempts the lowest tier first
+    priority: int = 0
     #: host pods per replica (>1 for multi-host slices: the replica's
     #: pods land on `hosts` DISTINCT nodes of the slice's pool, each
     #: consuming per-pod cpu/mem and chips-per-host)
@@ -154,6 +159,7 @@ class JobView:
             slice_topology=t.slice_topology if job.tpu_per_trainer() else "",
             legal_sizes=job.legal_world_sizes(),
             elastic=job.elastic(),
+            priority=job.spec.priority,
             hosts=job.hosts_per_replica(),
         )
 

@@ -36,6 +36,35 @@ DEFAULT_LOOP_SECONDS = 5.0  # ref defaultLoopDur (pkg/autoscaler.go:30-32)
 DEFAULT_MAX_LOAD_DESIRED = 0.97  # ref cmd/edl/edl.go:19-20
 
 
+def wait_for_world_ack(client, timeout: float) -> bool:
+    """Bounded wait for a retargeted world to re-form — the consensus
+    stop agreement's actuation-side half: until every surviving member
+    acks the new generation, the victims may still be stepping toward
+    the agreed stop boundary, and a SIGTERM (pod deletion) or a chip
+    reallocation mid-quiesce yanks them out of a live world.  Shared by
+    the training lane's victim deletion and the fleet arbiter's
+    preemption path (a preempted trainer's chips move to a serving
+    fleet only after its world drained).  Best effort: coordinators
+    without the signal (test doubles, pre-consensus versions) and
+    worlds with no live trainers (``acked_members`` 0) skip the wait;
+    returns False on timeout (the broken-world machinery still
+    recovers, it just pays a replay)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            m = client.metrics()
+        except Exception:
+            return True
+        if not isinstance(m, dict) or "world_acked" not in m:
+            return True  # pre-consensus coordinator: nothing to wait on
+        if m.get("world_acked") or not m.get("acked_members"):
+            return True
+        time.sleep(0.5)
+    return False
+
+
 @dataclass
 class _Event:
     type: str  # "add" | "update" | "del"  (ref eventType, :141-147)
@@ -461,30 +490,9 @@ class Autoscaler:
             return None
 
     def _wait_for_quiesce(self, client) -> None:
-        """Bounded wait for the retargeted world to re-form — the
-        consensus stop agreement's actuation-side half: until every
-        surviving member acks the new generation, the victims may
-        still be stepping toward the agreed stop boundary, and a
-        SIGTERM (pod deletion) mid-quiesce yanks them out of a live
-        world — exactly the teardown race the step bus closes.  Best
-        effort: coordinators without the signal (test doubles,
-        pre-consensus versions) and worlds with no live trainers
-        (``acked_members`` 0 — control-plane-only tests) skip the
-        wait, and a timeout proceeds to deletion (the broken-world
-        machinery still recovers, it just pays a replay)."""
-        import time
-
-        deadline = time.monotonic() + self.victim_drain_timeout
-        while time.monotonic() < deadline:
-            try:
-                m = client.metrics()
-            except Exception:
-                return
-            if not isinstance(m, dict) or "world_acked" not in m:
-                return  # pre-consensus coordinator: nothing to wait on
-            if m.get("world_acked") or not m.get("acked_members"):
-                return
-            time.sleep(0.5)
+        """See ``wait_for_world_ack`` (module level, shared with the
+        fleet arbiter); a timeout proceeds to deletion."""
+        wait_for_world_ack(client, self.victim_drain_timeout)
 
     def _delete_dropped_members(
         self, job: TrainingJob, client, plan=None

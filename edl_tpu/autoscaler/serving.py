@@ -162,19 +162,13 @@ class ServingLane:
             "rejected_total": rejected_new or None,
         }
 
-    # -- one decision cycle -------------------------------------------------
-    def run_once(self) -> Optional[dict]:
-        """Observe -> propose -> actuate -> journal.  Returns the
-        decision entry (None when the coordinator is unreachable)."""
-        try:
-            obs = self.observe()
-            snap = self.coordinator.metrics() or {}
-        except Exception:
-            return None
-        self._m_ticks.inc()
-        current = int(
-            snap.get("target_world") or snap.get("world_size") or 0
-        ) or self.min_replicas
+    def desired_replicas(self, obs, current: int) -> tuple:
+        """The band decision — (proposed, reason) from one observation.
+        Mutates only the hysteresis counter.  Factored out of
+        ``run_once`` so the fleet market can run the SAME p95-window-
+        delta / queue / rejection signals as a bidder's hard
+        requirement (``edl_tpu.fleet.bidders.ServingBidder``) while the
+        arbiter owns the actuation."""
         p95 = obs.get("p95_latency_s")
         depth = obs.get("queue_depth") or 0
         rejected = obs.get("rejected_total")
@@ -212,6 +206,26 @@ class ServingLane:
         else:
             self._low_ticks = 0
             reason = "within band"
+        return proposed, reason
+
+    def current_replicas(self) -> int:
+        """The fleet's actuated replica target (coordinator view)."""
+        snap = self.coordinator.metrics() or {}
+        return int(
+            snap.get("target_world") or snap.get("world_size") or 0
+        ) or self.min_replicas
+
+    # -- one decision cycle -------------------------------------------------
+    def run_once(self) -> Optional[dict]:
+        """Observe -> propose -> actuate -> journal.  Returns the
+        decision entry (None when the coordinator is unreachable)."""
+        try:
+            obs = self.observe()
+            current = self.current_replicas()
+        except Exception:
+            return None
+        self._m_ticks.inc()
+        proposed, reason = self.desired_replicas(obs, current)
         actuated = False
         trace_id = ""
         if proposed != current:

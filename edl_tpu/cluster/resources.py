@@ -79,3 +79,9 @@ class ClusterResource:
         if self.tpu_total <= 0:
             return 1.0
         return self.tpu_limit / self.tpu_total
+
+    def free_chips(self) -> int:
+        """Unclaimed TPU chips (total minus the scheduled pods'
+        limits) — the single number the fleet arbiter's chip market
+        opens each tick with (``edl_tpu.fleet.inventory``)."""
+        return max(0, self.tpu_total - self.tpu_limit)

@@ -1,0 +1,29 @@
+"""edl_tpu.fleet — the multi-job cluster arbiter.
+
+N elastic trainers + M serving fleets bidding for ONE TPU inventory:
+the reference autoscaler's cluster-wide dry-run fixed point
+(``pkg/autoscaler.go:296-337``) generalized with per-job priorities,
+serving SLOs as hard constraints, observed goodput-per-chip as the
+objective, and consensus-clean preemption of the lowest-priority
+trainer to absorb serving spikes (chips return when the spike clears).
+
+- ``inventory.ChipInventory`` — the market's chip ledger
+- ``bidders.TrainingBidder`` / ``bidders.ServingBidder`` — per-job
+  observation + actuation adapters (``Bid`` is the tick's message)
+- ``arbiter.arbitrate`` — the pure fixed point;
+  ``arbiter.FleetArbiter`` — the tick driver;
+  ``arbiter.attach_fleet`` — ride the training autoscaler's 5s tick
+"""
+
+from edl_tpu.fleet.arbiter import (  # noqa: F401
+    Arbitration,
+    FleetArbiter,
+    arbitrate,
+    attach_fleet,
+)
+from edl_tpu.fleet.bidders import (  # noqa: F401
+    Bid,
+    ServingBidder,
+    TrainingBidder,
+)
+from edl_tpu.fleet.inventory import ChipInventory  # noqa: F401

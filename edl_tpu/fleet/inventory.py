@@ -1,0 +1,70 @@
+"""One TPU chip inventory, N holders — the market's ledger.
+
+The reference's dry run simulated a whole ``ClusterResource`` because
+GPU pods also fought over CPU/memory.  The fleet market deliberately
+reduces to the one axis every bidder actually contends on — TPU chips
+— because serving replicas and trainer slices on a TPU cluster are
+chip-bounded (their CPU/memory requests ride along with the slice) and
+the per-axis machinery already lives in ``autoscaler/algorithm.py`` for
+the intra-job fixed point.  Keeping the arbiter's ledger scalar keeps
+the cross-job fixed point provably convergent (see
+``arbiter.arbitrate``).
+
+``ChipInventory`` is a plain mutable value type like
+``ClusterResource``: the arbiter mutates a copy per dry run and tests
+fabricate inventories as literals.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+from edl_tpu.cluster.resources import ClusterResource
+
+
+@dataclass
+class ChipInventory:
+    """Chip totals plus per-holder allocations (name -> chips).
+
+    ``holdings`` tracks what the market has ALLOCATED, which on a live
+    cluster equals scheduled pods' chip limits; chips outside any
+    holding (e.g. non-fleet workloads) are modeled by seeding a
+    holding the arbiter never owns."""
+
+    total_chips: int = 0
+    holdings: Dict[str, int] = field(default_factory=dict)
+
+    def allocated(self) -> int:
+        return sum(self.holdings.values())
+
+    def free(self) -> int:
+        return self.total_chips - self.allocated()
+
+    def set_holding(self, name: str, chips: int) -> None:
+        if chips < 0:
+            raise ValueError(f"holding must be >= 0: {name}={chips}")
+        if chips == 0:
+            self.holdings.pop(name, None)
+        else:
+            self.holdings[name] = chips
+
+    def snapshot(self) -> dict:
+        """JSON-safe view (the ``edl fleet`` table + bench chips-over-
+        time series read this shape)."""
+        return {
+            "total_chips": self.total_chips,
+            "free_chips": self.free(),
+            "holdings": dict(sorted(self.holdings.items())),
+        }
+
+    @staticmethod
+    def from_cluster_resource(r: ClusterResource) -> "ChipInventory":
+        """Seed the ledger from a live inventory inquiry: everything
+        already scheduled outside the fleet's bidders is parked under
+        one opaque holding so the market can never hand it out."""
+        inv = ChipInventory(total_chips=r.tpu_total)
+        used = r.tpu_total - r.free_chips()
+        if used > 0:
+            inv.set_holding("(scheduled)", used)
+        return inv

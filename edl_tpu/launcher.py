@@ -112,6 +112,37 @@ def env_config() -> dict:
     }
 
 
+_compile_counting_on = False
+
+
+def enable_compile_counting() -> None:
+    """Count TRUE XLA backend compiles into the shared registry
+    (``edl_xla_compiles_total``) by wrapping the ``backend_compile``
+    seam — persistent-cache hits bypass it, so the counter moves only
+    when XLA really compiled.  This is the same seam ``bench.py``
+    patches ad hoc; behind ``EDL_COUNT_XLA_COMPILES=1`` a deployed pod
+    gets it too, which is what lets the fleet real-process tests
+    assert "this warm resize performed ZERO compiles" from a worker's
+    journal instead of only in-process.  Idempotent; the ~100ns
+    counter inc per compile is noise against any real compile."""
+    global _compile_counting_on
+    if _compile_counting_on:
+        return
+    import jax._src.compiler as _compiler
+
+    from edl_tpu import telemetry
+
+    m = telemetry.get_registry().counter("edl_xla_compiles_total")
+    real = _compiler.backend_compile
+
+    def counting_backend_compile(*args, **kwargs):
+        m.inc()
+        return real(*args, **kwargs)
+
+    _compiler.backend_compile = counting_backend_compile
+    _compile_counting_on = True
+
+
 def configure_compile_cache(cache_dir: str) -> None:
     """Wire the persistent XLA compilation cache at ``cache_dir``
     (EDL_COMPILE_CACHE_DIR, from the TrainingJob's
@@ -146,6 +177,56 @@ def configure_compile_cache(cache_dir: str) -> None:
                 "jax; persistent cache keeps that knob's default",
                 file=sys.stderr,
             )
+    _enable_all_rank_cache_writes()
+
+
+def _enable_all_rank_cache_writes() -> None:
+    """Make rank>0 members benefit from the persistent cache at all.
+
+    This jax (0.4.37) only WRITES persistent-cache entries from
+    process 0 (``_cache_write``'s gate — its stated reason is write
+    contention on shared filesystems like GCS), while its cache KEYS
+    are process-dependent on this backend — so the key a rank-1 member
+    looks up is one only a rank-1 member could have written, and
+    nobody ever writes it.  Measured on a 2-process gloo CPU world:
+    across two identical runs sharing one cache dir, rank 0's second
+    run pays 0 backend compiles, rank 1 re-pays EVERY compile — and
+    the same asymmetry makes a standby member re-pay its whole world's
+    compiles on every rejoin (the fleet storm's restore transition
+    measured 7 true compiles on the rejoining member vs 0 on the
+    survivor).  Letting every rank persist its own keys removes the
+    asymmetry: keys are per-rank distinct, so there is no cross-rank
+    write contention, and a local/PV cache dir has none of the GCS
+    concern anyway.  Version-pinned monkeypatch like the gloo
+    collectives flip; a future jax that restructures the seam simply
+    keeps upstream behavior."""
+    try:
+        import jax._src.compiler as _compiler
+        from jax._src import compilation_cache as _cc
+
+        def cache_write_all_ranks(
+            cache_key, compile_time_secs, module_name, backend,
+            executable, host_callbacks,
+        ):
+            if host_callbacks:
+                return  # baked into the HLO, unshareable (upstream rule)
+            try:
+                _cc.put_executable_and_time(
+                    cache_key, module_name, executable, backend,
+                    int(compile_time_secs),
+                )
+            except Exception:
+                pass  # a cache-write failure must never fail a step
+
+        _compiler._cache_write = cache_write_all_ranks
+    except Exception:  # pragma: no cover - seam moved upstream
+        import sys
+
+        print(
+            "[edl] per-rank compile-cache writes unavailable on this "
+            "jax; rank>0 members keep paying formation compiles",
+            file=sys.stderr,
+        )
 
 
 def force_platform(platform: str) -> None:
@@ -597,6 +678,8 @@ def run(
     # Before any compile: every generation's step executable lands in /
     # loads from the shared cache (joiners and cold starts skip XLA).
     configure_compile_cache(compile_cache_dir or cfg["compile_cache_dir"])
+    if os.environ.get("EDL_COUNT_XLA_COMPILES", "0") == "1":
+        enable_compile_counting()
     if cfg["flight_recorder_file"]:
         # Durable flight-recorder journal: the ring buffer's events
         # also append to this JSONL so a crashed pod leaves its last

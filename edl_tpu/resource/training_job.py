@@ -307,6 +307,14 @@ class TrainingJobSpec:
     port: int = 0
     fault_tolerant: bool = False
     passes: int = 0
+    #: Fleet-arbiter scheduling priority (higher = more important).
+    #: When multiple jobs bid for one TPU inventory
+    #: (``edl_tpu.fleet``), serving spikes preempt the LOWEST-priority
+    #: elastic trainer first, and growth goes to higher priorities
+    #: first.  0 is the default tier; the reference had no notion of
+    #: cross-job priority (its fixed point ordered purely by
+    #: fulfillment, ref ``pkg/autoscaler.go:97-129``).
+    priority: int = 0
     trainer: TrainerSpec = field(default_factory=TrainerSpec)
     coordinator: CoordinatorSpec = field(default_factory=CoordinatorSpec)
     volumes: List[Dict[str, Any]] = field(default_factory=list)
@@ -359,6 +367,7 @@ class TrainingJobSpec:
             ),
             image=d.get("image", ""),
             port=int(d.get("port", 0)),
+            priority=int(d.get("priority", 0)),
             fault_tolerant=bool(d.get("fault_tolerant", d.get("faultTolerant", False))),
             passes=int(d.get("passes", 0)),
             trainer=TrainerSpec.from_dict(d.get("trainer")),
@@ -541,6 +550,8 @@ class TrainingJob:
             )
         if s.global_batch_size < 0:
             raise ValidationError("global_batch_size must be >= 0")
+        if s.priority < 0:
+            raise ValidationError("priority must be >= 0")
         if s.serving is not None:
             sv = s.serving
             if sv.min_replicas < 1 or sv.max_replicas < sv.min_replicas:

@@ -116,6 +116,14 @@ class ResizeEvent:
     #: immediate (no live multi-member world to agree with — the
     #: coordinator's advisory stamp lives in its own journal)
     stop_step: int = -1
+    #: TRUE XLA compiles inside the resize barrier (backend_compile
+    #: seam delta; persistent-cache hits don't count).  -1 = the seam
+    #: wasn't instrumented (``EDL_COUNT_XLA_COMPILES`` off) — only a
+    #: counted 0 is evidence of a zero-compile warm resize.  The count
+    #: through the FIRST post-resize step lands on the ``step.first``
+    #: flight event (the dispatch of that step is where a failed warm
+    #: would pay its compile).
+    xla_compiles: int = -1
 
 
 @dataclass
@@ -352,6 +360,18 @@ class ElasticTrainer:
 
         self.telemetry = telemetry.get_registry()
         self.recorder = telemetry.get_recorder()
+        # Compile accounting: moves only when the backend_compile seam
+        # is instrumented (bench.py's ad-hoc patch or the launcher's
+        # EDL_COUNT_XLA_COMPILES); the env flag additionally journals
+        # each resize window's delta so REAL-process tests can assert
+        # zero-compile warm resizes from worker journals.
+        import os as _os
+
+        self._m_xla = self.telemetry.counter("edl_xla_compiles_total")
+        self._count_compiles = (
+            _os.environ.get("EDL_COUNT_XLA_COMPILES", "0") == "1"
+        )
+        self._compiles_at_resize = 0.0
         self._m_steps = self.telemetry.counter("edl_steps_total")
         self._m_step_seconds = self.telemetry.histogram("edl_step_seconds")
         self._m_resizes = self.telemetry.counter("edl_resizes_total")
@@ -872,6 +892,7 @@ class ElasticTrainer:
 
         self.ledger.transition("resizing")
         t0 = time.perf_counter()
+        self._compiles_at_resize = self._m_xla.value()
         phases: Dict[str, float] = {}
 
         def _mark(name: str, since: float) -> float:
@@ -1095,6 +1116,11 @@ class ElasticTrainer:
             phase_seconds=phases,
             transfer=transfer_stats,
             stop_step=stop_step,
+            xla_compiles=(
+                int(self._m_xla.value() - self._compiles_at_resize)
+                if self._count_compiles
+                else -1
+            ),
         )
         self.resize_events.append(event)
         # Telemetry: counters/histograms for the merged cluster view,
@@ -1856,9 +1882,19 @@ class ElasticTrainer:
             # journal under the newer plan's just-installed trace and
             # clear it mid-resize.
             self._first_step_trace_gen = None
+            first_data = {"world_size": rec.world_size}
+            if self._count_compiles:
+                # Barrier entry -> first post-resize step harvested:
+                # the whole window the zero-compile warm-resize claim
+                # is about, journaled so a REAL-process test reads the
+                # count from the member's spill (bench measures the
+                # same delta at the same seam in-process).
+                first_data["xla_compiles"] = int(
+                    self._m_xla.value() - self._compiles_at_resize
+                )
             self.recorder.record(
                 "step.first",
-                {"world_size": rec.world_size},
+                first_data,
                 step=rec.step,
                 generation=rec.generation,
                 trace=self._first_step_trace,

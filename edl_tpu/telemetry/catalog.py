@@ -365,6 +365,50 @@ CATALOG = {
         "help": "Training step of the checkpoint currently serving.",
         "labels": (),
     },
+    # -- multi-job fleet market (edl_tpu.fleet) ------------------------------
+    "edl_fleet_chips_total": {
+        "type": "gauge",
+        "help": "TPU chips in the fleet arbiter's inventory.",
+        "labels": (),
+    },
+    "edl_fleet_chips_free": {
+        "type": "gauge",
+        "help": "Chips left unallocated after the last market fixed "
+        "point.",
+        "labels": (),
+    },
+    "edl_fleet_chips_allocated": {
+        "type": "gauge",
+        "help": "Chips the market currently allocates to each bidder "
+        "(training job or serving fleet).",
+        "labels": ("job",),
+    },
+    "edl_fleet_target_units": {
+        "type": "gauge",
+        "help": "Decided unit count (trainer replicas / serving "
+        "replicas) per bidder after the last fixed point.",
+        "labels": ("job",),
+    },
+    "edl_fleet_decisions_total": {
+        "type": "counter",
+        "help": "Per-job fleet decision entries journaled (one per "
+        "bidder per arbiter tick).",
+        "labels": (),
+    },
+    "edl_fleet_preemptions_total": {
+        "type": "counter",
+        "help": "Preemption steps the arbiter took (a trainer shed "
+        "one legal size to cover a serving SLO requirement), by "
+        "victim job.",
+        "labels": ("job",),
+    },
+    "edl_fleet_unmet_demand_chips": {
+        "type": "gauge",
+        "help": "Chips a serving fleet's SLO requirement is short "
+        "even after exhausting every preemptible trainer (0 = SLO "
+        "demand covered).",
+        "labels": ("job",),
+    },
     # -- tracing / flight-recorder plumbing ----------------------------------
     "edl_flight_spill_dropped_total": {
         "type": "counter",
@@ -413,6 +457,9 @@ KNOWN_EVENT_KINDS = {
     "chaos": "a scheduled fault was actually delivered",
     # autoscaler
     "autoscaler.decision": "one goodput-annotated decision-log entry",
+    # multi-job fleet market (edl_tpu.fleet)
+    "fleet.decision": "one per-job fleet-arbiter decision entry",
+    "fleet.preempt": "a trainer stepped down to cover a serving SLO",
     # elastic inference serving (edl_tpu.serving)
     "serve.warm": "a padded-bucket forward executable AOT-compiled",
     "serve.swap": "a newer verified checkpoint hot-swapped in",

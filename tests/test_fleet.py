@@ -1,0 +1,631 @@
+"""Fleet-market tests: golden arbiter scenarios (the multi-job analog
+of the reference's scaling suite, ``pkg/autoscaler_internal_test.go``
+— starved low-priority job, max-capped job, inventory exhaustion,
+oscillation-free convergence), the decision-log schema, actuation
+ordering (prewarm→retarget per transition, downs-before-ups, victim
+drain), bidder adapters, and the ``edl fleet`` CLI."""
+
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.fleet import (
+    Bid,
+    ChipInventory,
+    FleetArbiter,
+    ServingBidder,
+    TrainingBidder,
+    arbitrate,
+    attach_fleet,
+)
+
+
+def tbid(
+    name,
+    pri=0,
+    cur=1,
+    mn=1,
+    mx=4,
+    chips=1,
+    util=None,
+    legal=None,
+):
+    return Bid(
+        name=name,
+        kind="training",
+        priority=pri,
+        chips_per_unit=chips,
+        min_units=mn,
+        max_units=mx,
+        current_units=cur,
+        legal_units=list(legal) if legal else [],
+        utility=util,
+        elastic=mn < mx,
+    )
+
+
+def sbid(name, cur=1, req=1, mn=1, mx=4, chips=1):
+    return Bid(
+        name=name,
+        kind="serving",
+        priority=100,
+        chips_per_unit=chips,
+        min_units=mn,
+        max_units=mx,
+        current_units=cur,
+        required_units=req,
+        elastic=mn < mx,
+    )
+
+
+# ---- golden fixed-point scenarios -------------------------------------------
+
+
+def test_calm_full_inventory_is_a_fixed_point():
+    r = arbitrate(
+        [tbid("lo", 0, 2, mx=2), tbid("hi", 10, 1, mx=1), sbid("s", 1, 1, mx=2)],
+        4,
+    )
+    assert r.targets == {"lo": 2, "hi": 1, "s": 1}
+    assert r.free_chips == 0 and not r.preemptions and not r.unmet
+    assert r.iterations == 1
+
+
+def test_starved_low_priority_job_pins_at_min():
+    """Higher tier takes every marginal chip; the low tier holds its
+    floor (the reference's 'starved' variant, now cross-job)."""
+    r = arbitrate([tbid("lo", 0, 1, mx=4), tbid("hi", 10, 1, mx=4)], 5)
+    assert r.targets == {"hi": 4, "lo": 1}
+    assert r.free_chips == 0
+
+
+def test_max_capped_job_leaves_chips_free():
+    r = arbitrate([tbid("a", 0, 1, mx=3)], 8)
+    assert r.targets == {"a": 3}
+    assert r.free_chips == 5  # never grown past max
+
+
+def test_inventory_exhaustion_never_overcommits():
+    bids = [
+        tbid("a", 5, 1, mx=8, chips=2),
+        tbid("b", 3, 1, mx=8, chips=2),
+        tbid("c", 0, 1, mx=8, chips=2),
+    ]
+    r = arbitrate(bids, 9)
+    used = sum(r.targets[b.name] * b.chips_per_unit for b in bids)
+    assert used <= 9 and r.free_chips == 9 - used
+    # priority order got the marginal chips
+    assert r.targets["a"] >= r.targets["b"] >= r.targets["c"]
+    assert r.targets["c"] == 1  # floor held
+
+
+def test_serving_spike_preempts_lowest_priority_trainer():
+    """THE preemption contract: among preemptible trainers the LOWEST
+    priority sheds first, one legal step, and the serving requirement
+    is covered exactly."""
+    r = arbitrate(
+        [
+            tbid("lo", 0, 2, mx=2),
+            tbid("hi", 10, 2, mx=2),
+            sbid("api", 1, 2, mx=2),
+        ],
+        5,
+    )
+    assert r.targets == {"lo": 1, "hi": 2, "api": 2}
+    assert [p["victim"] for p in r.preemptions] == ["lo"]
+    assert r.preemptions[0]["beneficiary"] == "api"
+    assert not r.unmet
+
+
+def test_chips_return_when_spike_clears():
+    """Serving above its requirement sheds to it, and the freed chips
+    flow back to training in the SAME fixed point."""
+    r = arbitrate(
+        [tbid("lo", 0, 1, mx=2), tbid("hi", 10, 1, mx=1), sbid("api", 2, 1, mx=2)],
+        4,
+    )
+    assert r.targets == {"api": 1, "lo": 2, "hi": 1}
+    assert r.free_chips == 0 and not r.preemptions
+
+
+def test_preemption_stops_at_min_and_reports_unmet():
+    """Floors are floors: when every trainer is at min the serving
+    requirement goes unmet and is REPORTED, not silently absorbed."""
+    r = arbitrate(
+        [tbid("lo", 0, 1, mx=2), sbid("api", 1, 4, mx=4)], 2
+    )
+    assert r.targets == {"lo": 1, "api": 1}
+    assert r.unmet == {"api": 3}
+
+
+def test_oscillation_free_convergence():
+    """Feeding a fixed point's targets back as currents changes
+    nothing: no diffs, no preemptions, one iteration (the
+    livelock-at-full-utilization class the reference had)."""
+    bids = [
+        tbid("lo", 0, 2, mx=4),
+        tbid("hi", 10, 1, mx=4),
+        sbid("api", 1, 2, mx=2),
+    ]
+    r1 = arbitrate(bids, 6)
+    again = [
+        tbid("lo", 0, r1.targets["lo"], mx=4),
+        tbid("hi", 10, r1.targets["hi"], mx=4),
+        sbid("api", r1.targets["api"], 2, mx=2),
+    ]
+    r2 = arbitrate(again, 6)
+    assert r2.targets == r1.targets
+    assert not r2.preemptions
+    assert r2.iterations == 1
+
+
+def test_goodput_per_chip_orders_growth_within_tier():
+    """The objective: within one priority tier the marginal chip goes
+    to the best measured goodput-per-chip; unmeasured bids sort last."""
+    r = arbitrate(
+        [
+            tbid("meh", 0, 1, mx=4, util=0.05),
+            tbid("good", 0, 1, mx=4, util=0.8),
+            tbid("blind", 0, 1, mx=4, util=None),
+        ],
+        4,
+    )
+    assert r.targets["good"] == 2
+    assert r.targets["meh"] + r.targets["blind"] == 2  # floors + leftover
+
+
+def test_growth_spreads_within_a_tier_by_diminishing_utility():
+    """Utility is re-scaled to the EVOLVING allocation: a job that just
+    took a step needs a proportionally better ledger to take the next
+    one, so equal-tier jobs spread instead of one absorbing the whole
+    free pool."""
+    r = arbitrate(
+        [tbid("a", 0, 1, mx=4, util=0.9), tbid("b", 0, 1, mx=4, util=0.8)],
+        6,
+    )
+    assert r.targets == {"a": 3, "b": 3}
+
+
+def test_preemption_rotates_between_equal_tier_victims():
+    """Victim fulfillment is computed at the evolving allocation too:
+    a big requirement sheds BOTH equal-tier trainers evenly, not one
+    to its floor first."""
+    r = arbitrate(
+        [
+            tbid("a", 0, 4, mx=4),
+            tbid("b", 0, 4, mx=4),
+            sbid("api", 1, 5, mx=5),
+        ],
+        9,
+    )
+    assert r.targets == {"a": 2, "b": 2, "api": 5}
+    assert [p["victim"] for p in r.preemptions] == ["a", "b", "a", "b"]
+
+
+def test_legal_size_quantized_preemption_steps():
+    """Slice/batch quantization survives preemption: a [1,2,4] trainer
+    sheds 4 -> 2 (a whole legal step), never 4 -> 3."""
+    r = arbitrate(
+        [tbid("lo", 0, 4, mx=4, legal=[1, 2, 4]), sbid("api", 1, 2, mx=2)],
+        5,
+    )
+    assert r.targets == {"lo": 2, "api": 2}
+    assert r.preemptions[0]["units_to"] == 2
+
+
+def test_oversubscription_sheds_lowest_priority_first():
+    """Inventory shrank under running jobs: the shed starts at the
+    lowest tier (pass 0), not at whoever sorts first."""
+    r = arbitrate([tbid("lo", 0, 4, mx=4), tbid("hi", 9, 4, mx=4)], 5)
+    assert r.targets["hi"] == 4 and r.targets["lo"] == 1
+    assert r.free_chips == 0
+
+
+def test_duplicate_bid_names_rejected():
+    with pytest.raises(ValueError):
+        arbitrate([tbid("x"), tbid("x")], 4)
+
+
+# ---- inventory ---------------------------------------------------------------
+
+
+def test_chip_inventory_accounting():
+    inv = ChipInventory(total_chips=8)
+    inv.set_holding("a", 3)
+    inv.set_holding("b", 2)
+    assert inv.free() == 3 and inv.allocated() == 5
+    inv.set_holding("a", 0)
+    assert "a" not in inv.holdings and inv.free() == 6
+    snap = inv.snapshot()
+    assert snap == {"total_chips": 8, "free_chips": 6, "holdings": {"b": 2}}
+    with pytest.raises(ValueError):
+        inv.set_holding("c", -1)
+
+
+def test_inventory_from_cluster_resource_parks_scheduled_chips():
+    from edl_tpu.cluster.resources import ClusterResource
+
+    r = ClusterResource(tpu_total=16, tpu_limit=6)
+    assert r.free_chips() == 10
+    inv = ChipInventory.from_cluster_resource(r)
+    assert inv.total_chips == 16
+    assert inv.holdings == {"(scheduled)": 6}
+    assert inv.free() == 10
+
+
+# ---- resource-model plumbing -------------------------------------------------
+
+
+def _job(priority=0, mn=1, mx=4):
+    from edl_tpu.resource.training_job import TrainingJob
+
+    return TrainingJob.from_manifest(
+        {
+            "apiVersion": "edl.tpu.dev/v1",
+            "kind": "TrainingJob",
+            "metadata": {"name": "j"},
+            "spec": {
+                "fault_tolerant": True,
+                "priority": priority,
+                "global_batch_size": 96,
+                "trainer": {
+                    "min_instance": mn,
+                    "max_instance": mx,
+                    "slice_topology": "v5e-4",
+                },
+            },
+        }
+    ).validate()
+
+
+def test_training_bidder_from_job_reads_spec():
+    job = _job(priority=7, mn=1, mx=8)
+    b = TrainingBidder.from_job(job, coordinator=None)
+    assert b.priority == 7
+    assert (b.min_units, b.max_units) == (1, 8)
+    assert b.chips_per_unit == 4
+    assert b.legal_units == job.legal_world_sizes() == [1, 2, 3, 4, 6, 8]
+
+
+def test_jobview_carries_priority():
+    from edl_tpu.autoscaler.algorithm import JobView
+
+    assert JobView.from_job(_job(priority=3)).priority == 3
+
+
+def test_spec_priority_validated():
+    from edl_tpu.resource.training_job import ValidationError
+
+    with pytest.raises(ValidationError):
+        _job(priority=-1)
+
+
+# ---- arbiter driver: actuation + journaling ---------------------------------
+
+
+class FakeCoord:
+    """Coordinator double shared across bidders; every call lands in a
+    COMMON sequenced log so cross-job ordering is assertable."""
+
+    def __init__(self, world, seq, name, goodput=None):
+        self.world = world
+        self.seq = seq
+        self.name = name
+        self.goodput = goodput
+
+    def metrics(self):
+        return {
+            "target_world": self.world,
+            "world_size": self.world,
+            "world_acked": True,
+            "acked_members": 1,
+        }
+
+    def telemetry(self):
+        if self.goodput is None:
+            return {}
+        return {"goodput": {"frac": self.goodput}, "step_rate": 5.0}
+
+    def set_prewarm(self, n, trace_id=""):
+        self.seq.append((self.name, "prewarm", n, trace_id))
+
+    def set_target_world(self, n, trace_id=""):
+        self.seq.append((self.name, "target", n, trace_id))
+        self.world = n
+
+    def target_world(self):
+        return self.world
+
+
+class FakeLane:
+    """Minimal ServingLane stand-in: fixed requirement, real bounds."""
+
+    def __init__(self, coord, required, mn=1, mx=2):
+        self.coordinator = coord
+        self.min_replicas = mn
+        self.max_replicas = mx
+        self.required = required
+        self.on_scale = None
+
+    def observe(self):
+        return {"p95_latency_s": None, "queue_depth": 0}
+
+    def current_replicas(self):
+        return self.coordinator.world
+
+    def desired_replicas(self, obs, current):
+        return self.required, "scripted"
+
+
+def _market(seq, lo_world=2, hi_world=1, serve_world=1, required=1):
+    lo = FakeCoord(lo_world, seq, "lo", goodput=0.9)
+    hi = FakeCoord(hi_world, seq, "hi", goodput=0.8)
+    api = FakeCoord(serve_world, seq, "api")
+    arbiter = FleetArbiter(
+        4,
+        trainers=[
+            TrainingBidder("lo", lo, priority=0, min_units=1, max_units=2),
+            TrainingBidder("hi", hi, priority=10, min_units=1, max_units=1),
+        ],
+        fleets=[
+            ServingBidder("api", FakeLane(api, required)),
+        ],
+    )
+    return arbiter, lo, hi, api
+
+
+def test_arbiter_prewarm_before_retarget_per_transition_with_own_trace():
+    with telemetry.scoped():
+        seq = []
+        arbiter, lo, hi, api = _market(seq, required=2)
+        rec = arbiter.run_once()
+    assert rec is not None
+    # two transitions: lo down, api up — each prewarm->target with ONE
+    # non-empty trace id, and the two ids are distinct
+    lo_ops = [op for op in seq if op[0] == "lo"]
+    api_ops = [op for op in seq if op[0] == "api"]
+    assert [op[1] for op in lo_ops] == ["prewarm", "target"]
+    assert [op[1] for op in api_ops] == ["prewarm", "target"]
+    lo_traces = {op[3] for op in lo_ops}
+    api_traces = {op[3] for op in api_ops}
+    assert len(lo_traces) == 1 and len(api_traces) == 1
+    assert lo_traces != api_traces and "" not in lo_traces | api_traces
+    # downs actuate before ups: the victim's chips free first
+    assert seq.index(lo_ops[0]) < seq.index(api_ops[0])
+    assert lo.world == 1 and api.world == 2 and hi.world == 1
+
+
+def test_arbiter_decision_log_schema():
+    """The per-job decision-log contract the tentpole adds: every bid
+    journals an entry with priority / preemption / trace fields."""
+    with telemetry.scoped():
+        seq = []
+        arbiter, *_ = _market(seq, required=2)
+        rec = arbiter.run_once()
+    required_keys = {
+        "lane", "job", "kind", "priority", "dry_run", "observed",
+        "required_units", "utility", "preempted", "preempted_by",
+        "actuated", "drained", "reason", "trace_id",
+    }
+    entries = {d["job"]: d for d in rec["decisions"]}
+    assert set(entries) == {"lo", "hi", "api"}
+    for d in rec["decisions"]:
+        assert required_keys <= set(d), sorted(required_keys - set(d))
+        assert d["lane"] == "fleet"
+        assert set(d["dry_run"]) == {"current", "proposed", "diff"}
+    assert entries["lo"]["preempted"] and entries["lo"]["preempted_by"] == "api"
+    assert entries["lo"]["priority"] == 0 and entries["hi"]["priority"] == 10
+    assert entries["lo"]["trace_id"] and entries["lo"]["actuated"]
+    assert entries["lo"]["drained"] is True
+    assert entries["hi"]["trace_id"] == ""  # no transition, no id
+    assert entries["api"]["required_units"] == 2
+    # the arbiter's own log mirrors the tick's entries
+    assert arbiter.decision_log[-3:] == rec["decisions"]
+
+
+def test_arbiter_journals_fleet_events_and_metrics():
+    with telemetry.scoped() as (reg, rec_):
+        seq = []
+        arbiter, *_ = _market(seq, required=2)
+        arbiter.run_once()
+        kinds = [e.kind for e in rec_.events()]
+        assert kinds.count("fleet.decision") == 3
+        assert kinds.count("fleet.preempt") == 1
+        preempt = next(
+            e for e in rec_.events() if e.kind == "fleet.preempt"
+        )
+        assert preempt.data["victim"] == "lo"
+        assert preempt.data["victim_trace"]
+        assert preempt.data["beneficiary_trace"]
+        snap = reg.snapshot()
+        gauges = snap["gauges"]
+        assert gauges["edl_fleet_chips_total"][""] == 4
+        assert gauges["edl_fleet_chips_free"][""] == 0
+        assert gauges["edl_fleet_chips_allocated"]["job=lo"] == 1
+        assert gauges["edl_fleet_chips_allocated"]["job=api"] == 2
+        assert gauges["edl_fleet_unmet_demand_chips"]["job=api"] == 0
+        assert (
+            snap["counters"]["edl_fleet_preemptions_total"]["job=lo"]
+            == 1
+        )
+
+
+def test_unreachable_coordinator_freezes_its_holding():
+    """A bidder whose coordinator is down neither grows nor sheds, and
+    the market reserves (at least) its floor instead of handing its
+    chips to someone else."""
+
+    class DeadCoord:
+        def metrics(self):
+            raise ConnectionError("down")
+
+    with telemetry.scoped():
+        seq = []
+        arbiter, lo, hi, api = _market(seq, required=2)
+        arbiter.trainers[0] = TrainingBidder(
+            "lo", DeadCoord(), priority=0, min_units=1, max_units=2
+        )
+        # lo's LAST-KNOWN holding (the previous tick's actuation) is 2
+        # chips — its pods still physically hold them, so the spike
+        # must NOT be granted lo's second chip just because lo's
+        # coordinator stopped answering.
+        arbiter.inventory.set_holding("lo", 2)
+        rec = arbiter.run_once()
+    assert rec["blind"] == ["lo"]
+    jobs = {d["job"] for d in rec["decisions"]}
+    assert "lo" not in jobs
+    assert api.world == 1 and hi.world == 1
+    api_entry = next(
+        d for d in rec["decisions"] if d["job"] == "api"
+    )
+    assert api_entry["dry_run"]["proposed"] == 1  # requirement unmet
+    assert rec["unmet"] == {"api": 1}
+
+
+def test_failed_actuation_keeps_the_physical_holding():
+    """A retarget that fails leaves the old allocation standing: the
+    journaled holding (what the blind-coordinator freeze reserves next
+    tick) must stay at the PHYSICAL occupancy, not the unactuated
+    target — and drained must not claim a quiesce that never ran."""
+
+    class FlakyCoord(FakeCoord):
+        def set_target_world(self, n, trace_id=""):
+            raise ConnectionError("retarget lost")
+
+    with telemetry.scoped():
+        seq = []
+        lo = FlakyCoord(2, seq, "lo", goodput=0.9)
+        api = FakeCoord(1, seq, "api")
+        arbiter = FleetArbiter(
+            4,
+            trainers=[
+                TrainingBidder(
+                    "lo", lo, priority=0, min_units=1, max_units=2
+                ),
+                TrainingBidder(
+                    "hi",
+                    FakeCoord(1, seq, "hi", goodput=0.8),
+                    priority=10,
+                    min_units=1,
+                    max_units=1,
+                ),
+            ],
+            fleets=[ServingBidder("api", FakeLane(api, 2))],
+        )
+        rec = arbiter.run_once()
+    entry = next(d for d in rec["decisions"] if d["job"] == "lo")
+    assert not entry["actuated"] and entry["drained"] is False
+    # the pods still hold 2 chips; the ledger must say so
+    assert arbiter.inventory.holdings["lo"] == 2
+
+
+def test_market_clears_stale_non_fleet_holdings():
+    """A non-fleet holding that vanishes from the fresh inventory
+    inquiry is cleared from the arbiter's ledger — no phantom
+    allocated chips in chips-over-time."""
+    src = {"inv": ChipInventory(total_chips=4)}
+    src["inv"].set_holding("(scheduled)", 2)
+    with telemetry.scoped():
+        seq = []
+        lo = FakeCoord(1, seq, "lo", goodput=0.5)
+        arbiter = FleetArbiter(
+            lambda: src["inv"],
+            trainers=[
+                TrainingBidder(
+                    "lo", lo, priority=0, min_units=1, max_units=4
+                )
+            ],
+        )
+        r1 = arbiter.run_once()
+        assert r1["inventory"]["holdings"] == {"(scheduled)": 2, "lo": 2}
+        fresh = ChipInventory(total_chips=4)  # outside workload done
+        src["inv"] = fresh
+        r2 = arbiter.run_once()
+    assert "(scheduled)" not in r2["inventory"]["holdings"]
+    assert r2["inventory"]["holdings"] == {"lo": 4}
+
+
+def test_attach_fleet_rides_the_autoscaler_tick():
+    from edl_tpu.autoscaler.scaler import Autoscaler
+
+    class NullCluster:
+        def update_parallelism(self, job, n):
+            pass
+
+        def delete_pod(self, name):
+            return True
+
+    with telemetry.scoped():
+        sc = Autoscaler(NullCluster(), coord_client_factory=lambda j: None)
+        seq = []
+        arbiter, *_ = _market(seq, required=2)
+        attach_fleet(sc, arbiter)
+        assert sc.run_once() is None  # no single-cluster jobs registered
+        fleet_entries = [
+            d for d in sc.decision_log if d.get("lane") == "fleet"
+        ]
+        assert {d["job"] for d in fleet_entries} == {"lo", "hi", "api"}
+        with pytest.raises(ValueError):
+            attach_fleet(sc, arbiter)
+
+
+def test_serving_bidder_band_with_scripted_signals():
+    """The REAL ServingLane band logic (p95 window / hysteresis) drives
+    the bid's hard requirement when signals are scripted."""
+    from edl_tpu.autoscaler.serving import ServingLane
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped():
+        coord = LocalCoordinator(target_world=1, max_world=2)
+        lane = ServingLane(
+            coord, min_replicas=1, max_replicas=2, hold_ticks=2
+        )
+        sig = {"p95_latency_s": 0.01, "queue_depth": 0}
+        bidder = ServingBidder("api", lane, signals=lambda: dict(sig))
+        assert bidder.collect().required_units == 1
+        sig["p95_latency_s"] = 3.0
+        bid = bidder.collect()
+        assert bid.required_units == 2
+        assert "overloaded" in bid.observed["slo_reason"]
+        sig["p95_latency_s"] = 0.001
+        coord.set_target_world(2)
+        assert bidder.collect().required_units == 2  # hysteresis hold 1/2
+        assert bidder.collect().required_units == 1  # sheds on tick 2
+
+
+# ---- edl fleet CLI -----------------------------------------------------------
+
+
+def test_fleet_cli_table_and_json(capsys):
+    from edl_tpu.cli import main as cli_main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=2, max_world=2)
+    coord.register("t0")
+    coord.register("t1")
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    try:
+        url = f"127.0.0.1:{server.port}"
+        rc = cli_main(
+            ["fleet", "--job", f"lo={url},chips=4,priority=2", "--chips", "16"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "lo" in out and "training" in out
+        assert "chips allocated: 8 / 16 total" in out
+        rc = cli_main(["fleet", "--job", f"lo={url},chips=4", "--json"])
+        assert rc == 0
+        import json
+
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["bidders"][0]["chips"] == 8
+        assert doc["chips_allocated"] == 8
+    finally:
+        server.stop()
+
+
+def test_fleet_cli_requires_bidders(capsys):
+    from edl_tpu.cli import main as cli_main
+
+    assert cli_main(["fleet"]) == 2
+    assert "no bidders" in capsys.readouterr().err

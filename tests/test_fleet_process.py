@@ -1,0 +1,106 @@
+"""Fleet-market acceptance on REAL processes (the ISSUE 11 bar): a
+scripted serving latency spike provably steals chips from the
+lowest-priority trainer and gives them back when the spike clears —
+
+- the preemption is a consensus-clean scale-down: both members of the
+  victim world leave at ONE agreed stop step (skew 0 across their
+  journals, the PR 6 claim under the arbiter's actuation),
+- the serving grant lands only after the victim-drain ack,
+- every transition runs under its OWN minted trace id spanning
+  decision -> vote/quiesce -> resize -> first post-resize step,
+- warm resizes perform ZERO true XLA compiles on EVERY member
+  (journaled at the backend_compile seam by the launcher's
+  ``EDL_COUNT_XLA_COMPILES``),
+- the protected high-priority trainer is never touched.
+
+The storm driver is shared with ``bench.py fleet``
+(``bench_lib.fleet.run_fleet_storm``); this test asserts its
+invariants, the bench publishes its figures."""
+
+from bench_lib.fleet import run_fleet_storm
+
+
+def test_fleet_spike_steals_chips_from_lowest_priority_and_returns(
+    tmp_path,
+):
+    r = run_fleet_storm(str(tmp_path), base_port=13500)
+
+    # -- the market behaved: calm is a fixed point, the victim is the
+    #    LOWEST-priority trainer, the chips came back ----------------------
+    assert r["calm_tick_diffs"] == 0
+    assert r["victim"] == "lo"
+    assert all(p["victim"] == "lo" for p in r["preemptions"])
+    spiked = [
+        c["holdings"]
+        for c in r["chips_over_time"]
+        if c["phase"] in ("spike", "spike-hold")
+    ]
+    assert spiked and all(
+        h == {"api": 2, "hi": 1, "lo": 1} for h in spiked
+    )
+    assert r["chips_over_time"][-1]["holdings"] == {
+        "api": 1,
+        "hi": 1,
+        "lo": 2,
+    }
+    assert r["slo_attainment"] == 1.0
+
+    # -- consensus-clean scale-down: one agreed boundary ------------------
+    assert r["stop_skew_steps"] == 0
+    assert r["stop_step"] > 0
+    spike_entries = {
+        d["job"]: d for d in r["spike_record"]["decisions"]
+    }
+    assert spike_entries["lo"]["preempted"]
+    assert spike_entries["lo"]["preempted_by"] == "api"
+    assert spike_entries["lo"]["priority"] == 0
+    assert spike_entries["hi"]["priority"] == 10
+    # the serving grant waited for the victim world's drain ack
+    assert spike_entries["lo"]["drained"] is True
+
+    # -- one trace id per transition, end to end --------------------------
+    tr = r["traces"]
+    ids = [
+        tr.get(k)
+        for k in (
+            "preempt_down",
+            "preempt_serve_up",
+            "restore_up",
+            "restore_serve_down",
+        )
+    ]
+    assert all(ids) and len(set(ids)) == 4
+
+    def kinds(member, trace):
+        return [
+            e["kind"]
+            for e in r["member_events"][member]
+            if e.get("trace") == trace
+        ]
+
+    down = tr["preempt_down"]
+    # the data-plane agreement journals under the decision's id on the
+    # members that ran it, and the survivor's resize + first step close
+    # the chain
+    assert "consensus.stop" in kinds("lo-a", down)
+    for member in ("lo-a", "lo-b"):
+        assert "consensus.quiesce" in kinds(member, down), member
+    assert "resize" in kinds("lo-a", down)
+    assert "step.first" in kinds("lo-a", down)
+    up = tr["restore_up"]
+    for member in ("lo-a", "lo-b"):
+        assert "resize" in kinds(member, up), member
+        assert "step.first" in kinds(member, up), member
+
+    # -- zero-compile warm resizes, measured on real workers --------------
+    for member, evs in r["member_events"].items():
+        for ev in evs:
+            if ev.get("kind") == "step.first" and ev.get("trace") in (
+                down,
+                up,
+            ):
+                assert ev["data"]["xla_compiles"] == 0, (member, ev)
+
+    # -- the protected high-priority job was never touched ----------------
+    assert r["hi_generation_stable"]
+    assert r["hi_resize_worlds"] == [1]

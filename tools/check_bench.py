@@ -1,0 +1,125 @@
+"""Structural regression gates over a bench round record.
+
+ROADMAP item 5's "make regressions structural": the per-section
+invariants the repo's perf story rests on — scale-down stop-step skew
+== 0, serving steady-state XLA compiles == 0, warm-resize compiles ==
+0 (already bench-asserted in-section; re-gated here so a silently
+error'd section can't pass), latency ceilings — are asserted by CI
+against a checked-in thresholds JSON, normally over the committed
+``BENCH_r*.json`` snapshot (so a snapshot that violates its own gates
+can never be the baseline) and, when ``EDL_BENCH_RECORD`` points at a
+fresh ``bench.py`` output, over that.
+
+Stdlib-only, like tools/lint.py.  Threshold schema (a JSON list):
+
+    {"path": "detail.scale_down.stop_skew_steps", "max": 0}
+    {"path": "detail.fleet.slo_attainment", "min": 1.0}
+    {"path": "detail.steady_state.mnist.losses_bit_identical",
+     "equals": true}
+    {"path": "detail.moe_lm.mfu", "min": 0.3, "required": false}
+
+``required`` defaults to true: a missing path (section error'd, key
+renamed) FAILS the gate — a gate that silently stops measuring is the
+regression class this tool exists for.  ``required: false`` marks
+platform-dependent sections (TPU-only models skip on CPU boxes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def resolve(doc, path: str):
+    """Dotted-path lookup; returns (found, value)."""
+    cur = doc
+    for part in path.split("."):
+        if isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+                continue
+            except (ValueError, IndexError):
+                return False, None
+        if not isinstance(cur, dict) or part not in cur:
+            return False, None
+        cur = cur[part]
+    return True, cur
+
+
+def check(record: dict, gates: list) -> list:
+    """Returns a list of failure strings (empty = all gates green)."""
+    failures = []
+    for gate in gates:
+        path = gate["path"]
+        required = gate.get("required", True)
+        found, value = resolve(record, path)
+        if not found:
+            if required:
+                failures.append(
+                    f"{path}: MISSING (section error'd or key renamed; "
+                    "a gate that stopped measuring is a failure)"
+                )
+            else:
+                print(f"  skip  {path} (absent, optional)")
+            continue
+        ok = True
+        why = []
+        if "equals" in gate and value != gate["equals"]:
+            ok = False
+            why.append(f"!= {gate['equals']!r}")
+        if "max" in gate:
+            if not isinstance(value, (int, float)) or value > gate["max"]:
+                ok = False
+                why.append(f"> max {gate['max']}")
+        if "min" in gate:
+            if not isinstance(value, (int, float)) or value < gate["min"]:
+                ok = False
+                why.append(f"< min {gate['min']}")
+        if ok:
+            print(f"  ok    {path} = {value!r}")
+        else:
+            failures.append(f"{path} = {value!r} ({', '.join(why)})")
+    return failures
+
+
+def load_record(path: str) -> dict:
+    """Accept either bench.py's raw one-line record or the round
+    driver's wrapper (which nests it under ``parsed``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    if "detail" not in doc:
+        raise SystemExit(
+            f"{path}: not a bench round record (no 'detail' key)"
+        )
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="bench round record JSON")
+    ap.add_argument(
+        "--thresholds",
+        default="bench_thresholds.json",
+        help="checked-in per-section gate definitions",
+    )
+    args = ap.parse_args(argv)
+    record = load_record(args.record)
+    with open(args.thresholds) as f:
+        spec = json.load(f)
+    gates = spec["gates"] if isinstance(spec, dict) else spec
+    print(f"bench gates: {args.record} vs {args.thresholds}")
+    failures = check(record, gates)
+    if failures:
+        print(f"\nbench gates FAILED ({len(failures)}):", file=sys.stderr)
+        for f_ in failures:
+            print(f"  FAIL  {f_}", file=sys.stderr)
+        return 1
+    print(f"bench gates: clean ({len(gates)} gates)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -525,226 +525,13 @@ def bench_cpu_cross_size(n_devices: int = 8) -> dict:
 
 
 def bench_restore_paths() -> dict:
-    """Joiner-only vs transfer restore at TRANSFORMER scale, measured
-    on a real 2-process CPU world (gloo) — the numbers that make the
-    <60s resize budget an extrapolation from measured state sizes
-    rather than from fit_a_line (VERDICT r4 weak-8 / next-10).
+    """Joiner restore paths side by side, plus the multi-source fabric
+    sweep to >= 2GB simulated state — moved to ``bench_lib/restore.py``
+    (ROADMAP item 5's per-module rule: sections move as they next
+    change)."""
+    from bench_lib.restore import run_restore_paths
 
-    local      = every member holds the digest-agreed checkpoint and
-                 restores from its own DRAM (no cross-pod state motion);
-    broadcast  = one member is a fresh joiner, so the holder STREAMS it
-                 the full state (chunked delta transfer — the path that
-                 retired the r05 monolithic broadcast);
-    monolithic = the retired r05 broadcast_one_to_all path, kept
-                 measured side by side so the retirement stays a
-                 benchmarked claim;
-    delta      = one member diverged in a single leaf, so only that
-                 leaf moves."""
-    import os
-    import socket
-    import subprocess
-
-    # Bind port 0 in the parent and hand the free port to both ranks:
-    # a hard-coded port collides with a stale child (or anything else)
-    # from a previous run and fails the whole section.
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
-    procs = []
-    try:
-        for rank in (0, 1):
-            env = dict(os.environ)
-            flags = [
-                f
-                for f in env.get("XLA_FLAGS", "").split()
-                if "--xla_force_host_platform_device_count" not in f
-            ]
-            env["XLA_FLAGS"] = " ".join(flags)
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable,
-                        os.path.abspath(__file__),
-                        "--restore-child",
-                        str(rank),
-                        str(port),
-                    ],
-                    env=env,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.PIPE,
-                    text=True,
-                    cwd=os.path.dirname(os.path.abspath(__file__)),
-                )
-            )
-        # The SAME generous timeout for both ranks: rank 1 does real
-        # work (it is the receiver in every transfer measurement) and
-        # a short rank-1 timeout used to kill the bench under CI load.
-        out0, err0 = procs[0].communicate(timeout=900)
-        _, err1 = procs[1].communicate(timeout=900)
-        # BOTH ranks must exit clean: rank 1 can fail its own invariant
-        # after rank 0 already printed (the collective completed for
-        # rank 0 first) — a one-rank failure must not report a clean
-        # benchmark.
-        for rank, (rc, err) in enumerate(
-            [(procs[0].returncode, err0), (procs[1].returncode, err1)]
-        ):
-            if rc != 0:
-                raise RuntimeError(
-                    f"restore child rank {rank} rc={rc}: {err[-2000:]}"
-                )
-        return json.loads(out0.strip().splitlines()[-1])
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-
-def _restore_child(rank: int, port: int):
-    import time
-
-    import numpy as np
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=f"127.0.0.1:{port}",
-        num_processes=2,
-        process_id=rank,
-        initialization_timeout=60,
-    )
-    import optax
-
-    from edl_tpu.checkpoint import HostDRAMStore
-    from edl_tpu.checkpoint import transfer as tx
-    from edl_tpu.models.base import get_model
-    from edl_tpu.parallel.mesh import dp_mesh
-    from edl_tpu.runtime.coordinator import LocalCoordinator
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
-    from edl_tpu.runtime.elastic import ElasticTrainer
-    from edl_tpu.runtime.train import Trainer
-
-    def worldwide_max(seconds: float) -> float:
-        """A transfer is only done when its RECEIVER is done: report
-        the slowest rank's wall time, not rank 0's (the source returns
-        early — it serves from a background thread)."""
-        from jax.experimental import multihost_utils
-
-        times = multihost_utils.process_allgather(
-            np.asarray([seconds], np.float64)
-        )
-        return float(np.max(times))
-
-    model = get_model("transformer_base")  # full size: the real state mass
-    mesh = dp_mesh(2)
-    trainer = Trainer(model, optax.adam(1e-4), mesh)
-    state = trainer.init_state()
-    coord = LocalCoordinator(target_world=2, max_world=2)
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, 64), global_batch_size=64
-    )
-    et = ElasticTrainer(
-        model, optax.adam(1e-4), data, coord, store=HostDRAMStore()
-    )
-    et.generation = 1
-    et.store.save_async(state, generation=1)
-    et.store.wait()
-    state_mb = et.store.latest().nbytes() / 1e6
-
-    # Path 1: every member holds the identical checkpoint -> local.
-    t0 = time.perf_counter()
-    st, step, source, _ = et._restore_multiprocess(trainer)
-    jax.block_until_ready(st)
-    local_s = worldwide_max(time.perf_counter() - t0)
-    assert source == "local", source
-
-    # Path 2 (the RETIRED r05 path, measured end to end for the
-    # side-by-side): one monolithic broadcast_one_to_all of every
-    # leaf, then the adoption + placement the old
-    # _restore_multiprocess did — store.put (full digest re-hash) and
-    # store.restore (second host materialization + device placement).
-    from edl_tpu.checkpoint import HostCheckpoint
-
-    abstract = jax.eval_shape(
-        trainer._init_fn, jax.random.key(trainer.seed)
-    )
-    leaves_abs, treedef = jax.tree_util.tree_flatten(abstract)
-    scratch_store = HostDRAMStore()
-    t0 = time.perf_counter()
-    mono = tx.monolithic_broadcast_restore(
-        leaves_abs, et.store.latest(), is_source=rank == 0
-    )
-    merged = HostCheckpoint(
-        step=0, generation=1, leaves=mono, treedef=treedef
-    )
-    merged.step = int(np.asarray(merged.unflatten().step))
-    scratch_store.put(merged)
-    mono_state = scratch_store.restore(merged, trainer.mesh, None)
-    jax.block_until_ready(mono_state)
-    monolithic_s = worldwide_max(time.perf_counter() - t0)
-    assert sum(x.nbytes for x in mono) == et.store.latest().nbytes()
-    del mono, merged, mono_state, scratch_store
-
-    # Path 3: rank 1 lost its store (a fresh joiner) -> the full state
-    # streams from rank 0 over the chunked transfer.
-    if rank == 1:
-        et.store._checkpoints.clear()
-    t0 = time.perf_counter()
-    st, step, source, stats = et._restore_multiprocess(trainer)
-    jax.block_until_ready(st)
-    broadcast_s = worldwide_max(time.perf_counter() - t0)
-    assert source == "broadcast", source
-
-    # Path 4: rank 1 diverged in ONE leaf (stale store) -> the delta
-    # agreement moves only that leaf.
-    delta_mb = 0.0
-    if rank == 1:
-        ck = et.store.latest()
-        big = max(range(len(ck.leaves)), key=lambda i: ck.leaves[i].nbytes)
-        leaf = np.array(ck.leaves[big], copy=True)
-        leaf.reshape(-1).view(np.uint8)[0] ^= 0xFF
-        ck.leaves[big] = leaf
-        delta_mb = leaf.nbytes / 1e6
-        # Honest re-advertisement: the member KNOWS its bytes changed.
-        ck._digest = None
-        ck._leaf_digests = None
-    t0 = time.perf_counter()
-    st, step, source, stats = et._restore_multiprocess(trainer)
-    jax.block_until_ready(st)
-    delta_s = worldwide_max(time.perf_counter() - t0)
-    moved_mb = worldwide_max(
-        (stats or {}).get("bytes_received", 0) / 1e6
-    )
-    # Both sides touched the wire: rank 1 received the one diverged
-    # leaf, rank 0 served it.
-    assert source == "broadcast", source
-    # THE delta claim this section exists to publish: only the one
-    # diverged leaf moved, not the full state.  A regression to
-    # full-state transfer must fail the bench, not ship a silently
-    # inflated delta_moved_mb.
-    diverged_mb = worldwide_max(delta_mb)
-    assert abs(moved_mb - diverged_mb) < 1.0, (moved_mb, diverged_mb)
-
-    if rank == 0:
-        print(
-            json.dumps(
-                {
-                    "state_mb": round(state_mb, 1),
-                    "local_restore_s": round(local_s, 4),
-                    "broadcast_restore_s": round(broadcast_s, 4),
-                    "monolithic_restore_s": round(monolithic_s, 4),
-                    "speedup_vs_monolithic": round(
-                        monolithic_s / max(broadcast_s, 1e-9), 2
-                    ),
-                    "delta_restore_s": round(delta_s, 4),
-                    "delta_moved_mb": round(moved_mb, 1),
-                    "chunk_mb": 64,
-                    "processes": 2,
-                }
-            )
-        )
+    return run_restore_paths()
 
 
 def bench_scale_down() -> dict:
@@ -1114,8 +901,5 @@ if __name__ == "__main__":
         i = sys.argv.index("--moe-child")
         rest = [int(x) for x in sys.argv[i + 1 :][:3]]
         _moe_child(*rest)
-    elif "--restore-child" in sys.argv:
-        i = sys.argv.index("--restore-child")
-        _restore_child(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
     else:
         main()

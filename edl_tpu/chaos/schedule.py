@@ -54,6 +54,14 @@ KNOWN_POINTS = (
     # (3b) streaming restore transfer (checkpoint.transfer)
     "transfer.chunk.torn",       # flip a byte in one received chunk
     "transfer.chunk.slow",       # stall the source arg s before a send
+    # (3c) sharded p2p checkpoint fabric (checkpoint.fabric)
+    "fabric.replica.torn",       # a served shard rotted after its crc
+                                 # was advertised (reference-digest
+                                 # check must catch it; per-shard
+                                 # fallback to another holder)
+    "fabric.peer.lost",          # a source peer dies mid-pull
+    "fabric.replica.lost",       # a stage-B replica push is dropped
+    "fabric.pull.slow",          # serving peer stalls arg s pre-chunk
     # (4) kube actuation (chaos.kubeapi)
     "kube.conflict",             # next N update_workload: ConflictError
     "kube.hold",                 # job's pods stick Pending (arg: job)

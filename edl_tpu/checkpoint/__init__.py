@@ -5,6 +5,13 @@ from edl_tpu.checkpoint.transfer import (
     TransferStats,
     stream_restore,
 )
+from edl_tpu.checkpoint.fabric import (
+    FabricServer,
+    ShardLayout,
+    ShardReplicaStore,
+    fabric_restore,
+    replicate_to_buddies,
+)
 
 __all__ = [
     "HostDRAMStore",
@@ -13,4 +20,9 @@ __all__ = [
     "TransferError",
     "TransferStats",
     "stream_restore",
+    "FabricServer",
+    "ShardLayout",
+    "ShardReplicaStore",
+    "fabric_restore",
+    "replicate_to_buddies",
 ]

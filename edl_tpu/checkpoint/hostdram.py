@@ -63,6 +63,25 @@ def _pack_leaf_digests(leaf_digests: List[int]) -> int:
     return zlib.crc32(np.asarray(leaf_digests, np.uint32).tobytes())
 
 
+def _spill_shard_layout(ckpt):
+    """Fabric shard layout at the DEPLOYMENT boundary settings for a
+    checkpoint's leaves — boundaries are world-independent, so the
+    world size is immaterial (1).  Shared by the flush fingerprint and
+    the spill manifest so both hit the same ``shard_digests`` cache."""
+    from edl_tpu.checkpoint.fabric import (
+        ShardLayout,
+        deployment_shard_bytes,
+        leaf_rows,
+    )
+
+    return ShardLayout.build(
+        [l.nbytes for l in ckpt.leaves],
+        1,
+        shard_bytes=deployment_shard_bytes(),
+        rows=leaf_rows(ckpt.leaves),
+    )
+
+
 def leaf_placer(mesh: Mesh):
     """Per-leaf device placement onto ``mesh``: plain device_put on a
     fully-addressable mesh; shard-sliced ``make_array_from_callback``
@@ -302,8 +321,38 @@ class HostCheckpoint:
             self._leaf_digests = [int(d) for d in leaf_digests]
             self._digest = _pack_leaf_digests(self._leaf_digests)
 
+    def shard_digests(self, layout) -> List[int]:
+        """Per-SHARD crc32 vector under ``layout`` (a
+        ``checkpoint.fabric.ShardLayout``), cached by the layout's
+        world-independent boundary key — the refinement of
+        ``leaf_digests`` the peer-to-peer fabric trades in its
+        agreement.  The single memory pass also fills the per-leaf
+        vector (the leaf crc is the chain of its shards' regions), so
+        flush stage B hashing once serves BOTH granularities."""
+        key = layout.key()
+        with self._hash_lock:
+            cached = self._shard_digests
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            from edl_tpu.checkpoint.fabric import compute_shard_digests
+
+            shard_crcs, leaf_crcs = compute_shard_digests(
+                self.leaves, layout
+            )
+            self._shard_digests = (key, shard_crcs)
+            if self._leaf_digests is None:
+                self._leaf_digests = leaf_crcs
+                if self._digest is None:
+                    self._digest = _pack_leaf_digests(leaf_crcs)
+            return shard_crcs
+
     _digest: Optional[int] = field(default=None, repr=False, compare=False)
     _leaf_digests: Optional[List[int]] = field(
+        default=None, repr=False, compare=False
+    )
+    #: (layout boundary key, per-shard crc vector) — one layout cached
+    #: (the fabric uses one shard granularity per deployment)
+    _shard_digests: Optional[tuple] = field(
         default=None, repr=False, compare=False
     )
     #: serializes fingerprint computation across threads (reentrant:
@@ -559,6 +608,18 @@ class HostDRAMStore:
                 # multi-pod resize agreement reads digest() inside its
                 # all-gather, and a full-DRAM crc pass there would sit
                 # on the <60s critical path the digest exists to cut.
+                # Shard-first ordering, same as flush_sync's finish():
+                # one memory pass serves both granularities — digest()
+                # first would make _spill's shard_digests a second
+                # full pass.  Gated on the spill actually consuming
+                # the shard vector: without a spill_dir nothing reads
+                # it, and the prewarm costs an extra crc over every
+                # region.
+                if self.spill_dir:
+                    try:
+                        ckpt.shard_digests(_spill_shard_layout(ckpt))
+                    except Exception:  # pragma: no cover - defensive
+                        pass
                 ckpt.digest()
                 self._publish(ckpt)
                 self._m_saves.inc(kind="async")
@@ -592,9 +653,18 @@ class HostDRAMStore:
             ]
             self._pending.append(th)
 
-    def flush_sync(self, state, generation: int = 0):
+    def flush_sync(self, state, generation: int = 0, on_background=None):
         """The resize-window flush: device->host ORDERED, fingerprint +
         spill OVERLAPPED.
+
+        ``on_background(ckpt)``: optional stage-B hook invoked on the
+        background thread after fingerprint + spill — the checkpoint
+        fabric hangs shard-digest prewarming and buddy replication
+        here (never in the resize window; the hook must spawn its own
+        thread for anything long-running, because the caller joins
+        this background thread before the resize returns).  Hook
+        errors are printed, never recorded on ``edl_error``: a failed
+        replication must not read as a failed flush.
 
         Returns ``(ckpt, background_thread_or_None)``.  Only the
         device-to-host materialization runs on the caller thread —
@@ -679,12 +749,33 @@ class HostDRAMStore:
                     # at the end of the window must stay bounded.
                     for ev in self.chaos.due("flush.spill.slow"):
                         time.sleep(float(ev.arg or 0.05))
+                if self.spill_dir or on_background is not None:
+                    # One memory pass serves BOTH granularities: the
+                    # shard pass fills the leaf vector and the
+                    # whole-checkpoint digest as it goes, making the
+                    # digest() below (and _spill's shard_digests) cache
+                    # hits — ordering digest() first would pay a second
+                    # full pass for the shard crcs.  Gated on an actual
+                    # consumer (spill manifest or the fabric's stage-B
+                    # hook): otherwise the shard crcs cost an extra
+                    # hash over every region for nobody.
+                    try:
+                        ckpt.shard_digests(_spill_shard_layout(ckpt))
+                    except Exception:  # pragma: no cover - defensive
+                        pass
                 ckpt.digest()
                 if self.spill_dir:
                     self._spill(ckpt)
             except BaseException as e:
                 th.edl_error = e
             finally:
+                if on_background is not None:
+                    try:
+                        on_background(ckpt)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
                 th.edl_seconds = time.perf_counter() - t1
                 with self._lock:
                     self._inflight_steps.discard(step_val)
@@ -859,6 +950,16 @@ class HostDRAMStore:
             "digest_v": 2,
             "leaf_digests": ckpt.leaf_digests(),
         }
+        # Per-SHARD digests (checkpoint fabric granularity) ride the
+        # manifest too: shard boundaries are world-independent, so a
+        # cold start can re-seed the fabric agreement's shard vector —
+        # and a torn spill localizes to a shard, not a whole leaf.
+        try:
+            layout = _spill_shard_layout(ckpt)
+            manifest["shard_bytes"] = layout.shard_bytes
+            manifest["shard_digests"] = ckpt.shard_digests(layout)
+        except Exception:  # pragma: no cover - defensive
+            pass
         tmp_json = f"{path}.{tag}.tmp.json"
         with open(tmp_json, "w") as f:
             json.dump(manifest, f)
@@ -978,6 +1079,34 @@ class HostDRAMStore:
             else:
                 ok = ckpt.verify()  # records a fresh digest, passes
             if ok:
+                if manifest.get("shard_digests") is not None:
+                    # Re-seed the fabric's per-shard vector from the
+                    # manifest so a cold start pays no extra hash pass
+                    # before its first shard agreement.
+                    try:
+                        from edl_tpu.checkpoint.fabric import (
+                            ShardLayout,
+                            leaf_rows,
+                        )
+
+                        layout = ShardLayout.build(
+                            [l.nbytes for l in leaves],
+                            1,
+                            shard_bytes=int(manifest["shard_bytes"]),
+                            rows=leaf_rows(leaves),
+                        )
+                        if len(layout.shards) == len(
+                            manifest["shard_digests"]
+                        ):
+                            ckpt._shard_digests = (
+                                layout.key(),
+                                [
+                                    int(d)
+                                    for d in manifest["shard_digests"]
+                                ],
+                            )
+                    except Exception:  # pragma: no cover - defensive
+                        pass
                 break
             if step is not None:
                 raise RuntimeError(

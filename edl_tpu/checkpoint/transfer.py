@@ -116,7 +116,8 @@ class TransferStats:
     """What the restore agreement decided and what actually moved."""
 
     #: "init" (nobody has state), "local" (identical bytes everywhere,
-    #: nothing moves), "delta" (the streaming transfer ran)
+    #: nothing moves), "delta" (the streaming transfer ran), "fabric"
+    #: (the sharded multi-peer fabric ran — checkpoint/fabric.py)
     mode: str
     source_rank: int = -1
     step: int = -1
@@ -130,6 +131,14 @@ class TransferStats:
     leaves_skipped: int = 0
     chunks_received: int = 0
     seconds: float = 0.0
+    #: fabric pulls: payload bytes received per SOURCE rank (str keys
+    #: so the dict JSON-serializes straight into ResizeEvent.transfer
+    #: — the per-peer wire accounting the "no single peer sends full
+    #: state" claim is asserted on)
+    per_peer: Optional[Dict[str, int]] = None
+    #: fabric pulls: shards re-pulled from another replica holder
+    #: after their preferred peer died or served torn bytes
+    shard_fallbacks: int = 0
 
 
 @dataclass
@@ -141,6 +150,11 @@ class TransferResult:
     #: the source's advertised per-leaf digests (for zero-copy
     #: adoption); None for mode "init"
     leaf_digests: Optional[List[int]] = None
+    #: fabric agreements: every member's advertised fabric-server
+    #: address, rank -> (ip, port) — cached by the caller so the
+    #: post-flush background replication can reach its buddies without
+    #: another gather
+    peer_addrs: Optional[Dict[int, tuple]] = None
 
 
 # ---------------------------------------------------------------------------

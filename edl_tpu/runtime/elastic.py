@@ -306,6 +306,35 @@ class ElasticTrainer:
         #: the transfer to the normal broken-world machinery
         self.transfer_chunk_bytes: int = 64 << 20
         self.transfer_timeout: float = 120.0
+        #: sharded peer-to-peer checkpoint fabric (checkpoint/fabric.py):
+        #: multiprocess restores agree at SHARD granularity and a
+        #: joiner pulls from many peers in parallel, falling back
+        #: per-shard to replica holders and wholesale to the PR 2
+        #: single-source stream when the world offers no multi-peer
+        #: coverage.  EDL_FABRIC=0 pins every restore to the stream.
+        import os as _os
+
+        from edl_tpu.checkpoint.fabric import deployment_shard_bytes
+
+        self.fabric_enabled: bool = _os.environ.get("EDL_FABRIC", "1") != "0"
+        self.fabric_replicas: int = int(_os.environ.get("EDL_FABRIC_K", "1"))
+        #: one definition of the deployment's shard granularity —
+        #: spill manifests derive boundaries from the same knob, so
+        #: their digest vectors stay cache-key-compatible
+        self.fabric_shard_bytes: int = deployment_shard_bytes()
+        self.fabric_max_streams: int = 8
+        #: persistent shard endpoint + buddy-replica store, created on
+        #: the first multiprocess restore (never in local/test runs)
+        self._fabric_server = None
+        self._fabric_replica_store = None
+        #: rank -> (ip, port) fabric addresses cached from the last
+        #: shard agreement — what stage-B replication and the victim's
+        #: inheritance push dial without another gather
+        self._fabric_peer_addrs: Dict[int, tuple] = {}
+        self._fabric_rank: int = -1
+        self._fabric_world: int = 0
+        #: last stage-B replication thread (tests join it)
+        self._fabric_replication = None
         #: member ids this process keeps alive at the coordinator (the
         #: launcher sets its own pod id; local mode sets all simulated
         #: members).  Heartbeats are what make eviction-based failure
@@ -697,7 +726,27 @@ class ElasticTrainer:
         on the returned background thread, overlapping world formation
         / compile / restore.  Returns (checkpoint, bg_thread_or_None);
         the caller joins the thread before the resize returns."""
-        ckpt, bg = self.store.flush_sync(self.state, generation=generation)
+        on_bg = None
+        if self.fabric_enabled and jax.process_count() > 1:
+            # Fabric stage B rides the flush's background thread:
+            # shard-digest prewarm inline (it overlaps the window and
+            # the next agreement reads it cached), buddy replication
+            # on its own daemon (the window's tail join must not wait
+            # on peer TCP).  The world/rank/peer snapshot is taken
+            # HERE, on the resize thread, while they still describe
+            # the world this flush belongs to — the background thread
+            # outlives the teardown and would otherwise read the NEW
+            # world's values mid-restore and mis-replicate the one
+            # flush the shrink's inheritance path depends on.
+            world = self._fabric_world
+            rank = self._fabric_rank
+            peer_addrs = dict(self._fabric_peer_addrs)
+
+            def on_bg(ckpt, _w=world, _r=rank, _p=peer_addrs):
+                self._fabric_stage_b(ckpt, world=_w, rank=_r, peers=_p)
+        ckpt, bg = self.store.flush_sync(
+            self.state, generation=generation, on_background=on_bg
+        )
         self.coordinator.report_checkpoint(int(ckpt.step))
         return ckpt, bg
 
@@ -819,6 +868,24 @@ class ElasticTrainer:
                 import traceback
 
                 traceback.print_exc()
+        if self.fabric_enabled and self._fabric_peer_addrs:
+            # Fabric stretch: offer the shard inheritance to the
+            # surviving ring before parking (offer/accept — when the
+            # survivors flushed the same step, nothing moves).  Rides
+            # a daemon with a bounded join: an unreachable survivor's
+            # connect timeout (up to 30s, serial per buddy) must not
+            # stall parking past the scaler's victim-drain window, or
+            # the drain ack it is waiting on arrives late and the
+            # victim gets SIGTERMed mid-quiesce — the exact failure
+            # the ack exists to prevent.  Push uses only TCP + host
+            # memory, so it safely outlives the teardown below.
+            th = threading.Thread(
+                target=self._fabric_push_inheritance,
+                daemon=True,
+                name="edl-fabric-inherit",
+            )
+            th.start()
+            th.join(timeout=10.0)
         self.state = None
         self._world_members = ()
         self._clear_trainers()
@@ -1215,6 +1282,223 @@ class ElasticTrainer:
         )
         return transfer.JaxProcessFabric(advertise_host=host)
 
+    # -- sharded p2p checkpoint fabric (checkpoint/fabric.py) ----------------
+    def _ensure_fabric_server(self):
+        """Lazily start this member's persistent shard endpoint: pulls
+        are served from whatever checkpoint the store holds at the
+        requested step, falling back to the buddy-replica store; OFFER
+        pushes land in the replica store.  Created only on the
+        multiprocess restore path, so local/test trainers never bind a
+        socket."""
+        from edl_tpu.checkpoint.fabric import (
+            FabricServer,
+            ReplicaIngest,
+            ShardReplicaStore,
+        )
+
+        if self._fabric_replica_store is None:
+            self._fabric_replica_store = ShardReplicaStore()
+        if self._fabric_server is None:
+
+            def has_bytes(step, leaf, offset, length):
+                ck = self.store.get(step)
+                return (
+                    ck is not None
+                    and leaf < len(ck.leaves)
+                    and ck.leaves[leaf].nbytes >= offset + length
+                )
+
+            def lookup(step, leaf, offset, length):
+                ck = self.store.get(step)
+                if (
+                    ck is not None
+                    and leaf < len(ck.leaves)
+                    and ck.leaves[leaf].nbytes >= offset + length
+                ):
+                    from edl_tpu.checkpoint.fabric import byte_view
+
+                    return byte_view(ck.leaves[leaf])[
+                        offset : offset + length
+                    ]
+                return self._fabric_replica_store.get(
+                    step, leaf, offset, length
+                )
+
+            self._fabric_server = FabricServer(
+                lookup,
+                ingest=ReplicaIngest(self._fabric_replica_store, has_bytes),
+                timeout=self.transfer_timeout,
+                chaos=self.store.chaos,
+            ).start()
+        return self._fabric_server
+
+    def _fabric_layout(self, leaves, world: Optional[int] = None):
+        """The deployment's shard table over ``leaves`` (abstract or
+        materialized — only shapes/nbytes are read).  Row extents come
+        from axis 0, the axis the dp/fsdp GSPMD partitions split, so
+        shard boundaries nest inside every world size's slices.
+        ``world`` overrides the live ``_fabric_world`` for callers
+        holding a snapshot of an older world (flush stage B)."""
+        from edl_tpu.checkpoint.fabric import ShardLayout, leaf_rows
+        from edl_tpu.checkpoint.transfer import _leaf_sizes
+
+        return ShardLayout.build(
+            _leaf_sizes(leaves),
+            max(1, self._fabric_world if world is None else world),
+            k=self.fabric_replicas,
+            shard_bytes=self.fabric_shard_bytes,
+            rows=leaf_rows(leaves),
+        )
+
+    def _fabric_stage_b(
+        self, ckpt, *, world: int, rank: int, peers: Dict[int, tuple]
+    ) -> None:
+        """Flush stage B (background thread): prewarm the per-shard
+        digest vector the next agreement reads, then offer this
+        member's owned shards to its K deterministic buddies on a
+        separate daemon (offer/accept — a collective flush leaves
+        every buddy declining, so the common case moves zero bytes).
+        ``world``/``rank``/``peers`` are the caller's snapshot of the
+        world the flush belongs to — never read live off self here:
+        this thread overlaps the next world's restore, which rebinds
+        those fields mid-flight."""
+        try:
+            # Prewarm on THIS thread (joined before the resize
+            # returns): the next agreement reads the shard vector
+            # cached, and the replicate daemon's recompute below is a
+            # cache hit.
+            ckpt.shard_digests(self._fabric_layout(ckpt.leaves, world=world))
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return
+        peers = dict(peers)
+        peers.pop(rank, None)
+        if rank < 0 or not peers:
+            return
+
+        def replicate():
+            summary = self._fabric_offer_owned(
+                ckpt,
+                world=world,
+                rank=rank,
+                peers=peers,
+                timeout=self.transfer_timeout,
+            )
+            self.recorder.record(
+                "fabric.replicate",
+                summary,
+                step=int(ckpt.step),
+                generation=int(ckpt.generation),
+            )
+
+        th = threading.Thread(
+            target=replicate, daemon=True, name="edl-fabric-replicate"
+        )
+        th.start()
+        self._fabric_replication = th
+
+    def _fabric_offer_owned(
+        self,
+        ckpt,
+        *,
+        world: Optional[int],
+        rank: int,
+        peers: Dict[int, tuple],
+        timeout: float,
+        generation: Optional[int] = None,
+    ) -> dict:
+        """Offer ``ckpt``'s owned shards to the K ring buddies — the
+        ONE sourcing path (layout, cached shard digests, byte_view
+        slices) shared by flush stage B and the standby inheritance
+        push, so the offset arithmetic can never diverge between
+        them."""
+        from edl_tpu.checkpoint import fabric as fab
+
+        layout = self._fabric_layout(ckpt.leaves, world=world)
+        digs = ckpt.shard_digests(layout)
+
+        def shard_source(s):
+            view = fab.byte_view(ckpt.leaves[s.leaf])
+            return view[s.offset : s.offset + s.length], digs[s.index]
+
+        return fab.replicate_to_buddies(
+            layout,
+            rank,
+            int(ckpt.step),
+            int(ckpt.generation) if generation is None else generation,
+            peers,
+            shard_source,
+            chunk_bytes=self.transfer_chunk_bytes,
+            timeout=timeout,
+            chaos=self.store.chaos,
+        )
+
+    def _fabric_push_inheritance(self) -> None:
+        """Consensus-clean scale-down stretch: before parking, a
+        victim offers its newest verified shards — owned AND
+        buddy-held — to the surviving ring so planned shrinks keep the
+        state K-replicated without a durable-dir round trip.
+        Best-effort and bounded: a declined offer (survivors flushed
+        the same step, the common graceful case) moves zero bytes."""
+        from edl_tpu.checkpoint import fabric as fab
+
+        peers = dict(self._fabric_peer_addrs)
+        rank = self._fabric_rank
+        peers.pop(rank, None)
+        if rank < 0 or not peers:
+            return
+        # latest(), not latest_verified(): a full re-hash here would
+        # eat the bounded parking budget at exactly the state scale
+        # the fabric targets, and the buddy-side ShardReplicaStore
+        # crc-rejects any shard whose bytes no longer match the
+        # offered digest — receiver-side verification covers rot.
+        ckpt = self.store.latest()
+        if ckpt is None:
+            return
+        try:
+            summary = self._fabric_offer_owned(
+                ckpt,
+                world=None,
+                rank=rank,
+                peers=peers,
+                timeout=min(30.0, self.transfer_timeout),
+                generation=self.generation,
+            )
+            rep = self._fabric_replica_store
+            if rep is not None and rep.newest_step() > int(ckpt.step):
+                # Buddy-held shards NEWER than our own checkpoint may
+                # be the only surviving copy of a degraded-flush step:
+                # re-home them downstream under THEIR step.
+                step = rep.newest_step()
+                items = [
+                    (leaf, off, length, crc, rep.get(step, leaf, off, length))
+                    for leaf, off, length, crc in rep.shards_at(step)
+                ]
+                items = [it for it in items if it[4] is not None]
+                for buddy in sorted(peers):
+                    try:
+                        acc, sent = fab.push_shards(
+                            peers[buddy], rank, step, self.generation,
+                            items, timeout=min(30.0, self.transfer_timeout),
+                        )
+                        summary["accepted"] += acc
+                        summary["bytes"] += sent
+                        break
+                    except (OSError, fab.TransferError):
+                        continue
+            self.recorder.record(
+                "fabric.inherit",
+                summary,
+                step=int(ckpt.step),
+                generation=self.generation,
+            )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+
     def _restore_multiprocess(
         self, trainer: Trainer, flushed: Optional[HostCheckpoint] = None
     ):
@@ -1291,15 +1575,51 @@ class ElasticTrainer:
         # corruption degrades the whole world to the next-oldest
         # snapshot TOGETHER — one member quietly restoring an older
         # step would diverge the step counter across a live world.
-        result = transfer.stream_restore(
-            self._transfer_fabric(),
-            leaves_abs,
-            ckpt,
-            chunk_bytes=self.transfer_chunk_bytes,
-            timeout=self.transfer_timeout,
-            chaos=self.store.chaos,
-            on_leaf=on_leaf,
-        )
+        fabric_net = self._transfer_fabric()
+        if self.fabric_enabled:
+            # Sharded p2p fabric: shard-granular agreement, parallel
+            # multi-peer pull, per-shard replica fallback — and a
+            # world-deterministic hand-off to the PR 2 single-source
+            # stream when there is no multi-peer coverage.
+            from edl_tpu.checkpoint import fabric as fab
+
+            self._fabric_rank = fabric_net.rank
+            self._fabric_world = fabric_net.world
+            rows = fab.leaf_rows(leaves_abs)
+            # Ordering: _ensure_fabric_server() CREATES the replica
+            # store on first use — resolve it before reading the
+            # store attribute, or the first restore passes None.
+            server = self._ensure_fabric_server()
+            result = fab.fabric_restore(
+                fabric_net,
+                leaves_abs,
+                ckpt,
+                rows=rows,
+                k=self.fabric_replicas,
+                shard_bytes=self.fabric_shard_bytes,
+                replica_store=self._fabric_replica_store,
+                server=server,
+                chunk_bytes=self.transfer_chunk_bytes,
+                timeout=self.transfer_timeout,
+                chaos=self.store.chaos,
+                on_leaf=on_leaf,
+                max_streams=self.fabric_max_streams,
+            )
+            if result.peer_addrs is not None:
+                # Cache every member's fabric address: the stage-B
+                # buddy replication and the victim's inheritance push
+                # dial these without another gather.
+                self._fabric_peer_addrs = dict(result.peer_addrs)
+        else:
+            result = transfer.stream_restore(
+                fabric_net,
+                leaves_abs,
+                ckpt,
+                chunk_bytes=self.transfer_chunk_bytes,
+                timeout=self.transfer_timeout,
+                chaos=self.store.chaos,
+                on_leaf=on_leaf,
+            )
 
         stats = result.stats
         stats_dict = {
@@ -1313,6 +1633,10 @@ class ElasticTrainer:
             "chunks_received": stats.chunks_received,
             "seconds": round(stats.seconds, 4),
         }
+        if stats.per_peer is not None:
+            stats_dict["per_peer_bytes"] = dict(stats.per_peer)
+        if stats.shard_fallbacks:
+            stats_dict["shard_fallbacks"] = stats.shard_fallbacks
         if stats.mode == "init":
             # Nobody has state (fresh job): deterministic same-seed
             # init everywhere — nothing to move.
@@ -1320,6 +1644,20 @@ class ElasticTrainer:
 
         if stats.mode == "local":
             # Identical bytes everywhere: restore locally, no wire.
+            if ckpt is None or int(ckpt.step) != stats.step:
+                # A partial/replica-only holder assembled its full
+                # state from local shards (fabric mode "local" without
+                # a matching checkpoint): adopt the assembly so this
+                # member is a normal local-restore peer next time.
+                ckpt = HostCheckpoint(
+                    step=stats.step,
+                    generation=self.generation,
+                    leaves=result.leaves,
+                    treedef=treedef,
+                )
+                if result.leaf_digests is not None:
+                    ckpt.adopt_digests(result.leaf_digests)
+                self.store.put(ckpt)
             state = self.store.restore(ckpt, trainer.mesh, shardings)
             return state, int(ckpt.step), "local", stats_dict
 
@@ -1328,9 +1666,19 @@ class ElasticTrainer:
         # the state straight from the placed device arrays, no second
         # host materialization.
         state = jax.tree_util.tree_unflatten(treedef, placed)
-        if stats.bytes_received:
+        if (
+            stats.bytes_received
+            or ckpt is None
+            or int(ckpt.step) != stats.step
+        ):
             # Adopt the assembled checkpoint so this process can be a
             # local-restore (or source) member after a future resize.
+            # The step check matters even at zero bytes pulled: a
+            # replica-only holder can assemble the full state from
+            # LOCAL buddy shards in fabric mode (a joiner elsewhere
+            # keeps the world off the "local" path), and that assembly
+            # may be the only full copy of a degraded-flush step — the
+            # inheritance push reads it from the store.
             # Zero-copy: the store keeps the very buffers the wire
             # filled, and the digests come from the source's verified
             # advertisement instead of a fresh hash pass.
@@ -1340,15 +1688,17 @@ class ElasticTrainer:
                 leaves=result.leaves,
                 treedef=treedef,
             )
-            merged.adopt_digests(result.leaf_digests)
+            if result.leaf_digests is not None:
+                merged.adopt_digests(result.leaf_digests)
+            # A fabric assembly without a full-state authority carries
+            # no leaf-digest advertisement: put() fingerprints fresh.
             self.store.put(merged)
         moved = stats.bytes_received or stats.bytes_sent
-        return (
-            state,
-            stats.step,
-            "broadcast" if moved else "local",
-            stats_dict,
-        )
+        if stats.mode == "fabric":
+            source = "fabric" if moved else "local"
+        else:
+            source = "broadcast" if moved else "local"
+        return (state, stats.step, source, stats_dict)
 
     def _beat_once(self):
         if self._leaving:

@@ -138,6 +138,49 @@ CATALOG = {
         "help": "Restore-transfer engine seconds (agreement + wire).",
         "labels": (),
     },
+    # -- sharded peer-to-peer checkpoint fabric ------------------------------
+    "edl_fabric_bytes_sent_total": {
+        "type": "counter",
+        "help": "Checkpoint-fabric payload bytes this process served "
+        "to pulling peers.",
+        "labels": (),
+    },
+    "edl_fabric_bytes_received_total": {
+        "type": "counter",
+        "help": "Checkpoint-fabric payload bytes this process pulled "
+        "from peers (across all parallel streams).",
+        "labels": (),
+    },
+    "edl_fabric_shard_fallbacks_total": {
+        "type": "counter",
+        "help": "Shards re-pulled from another replica holder after "
+        "their preferred peer died or served torn bytes.",
+        "labels": (),
+    },
+    "edl_fabric_pull_peers": {
+        "type": "gauge",
+        "help": "Distinct source peers of the last parallel fabric "
+        "pull (>= 2 is the no-single-NIC claim).",
+        "labels": (),
+    },
+    "edl_fabric_pull_seconds": {
+        "type": "histogram",
+        "help": "Fabric restore engine seconds (agreement + parallel "
+        "pull + confirmation).",
+        "labels": (),
+    },
+    "edl_fabric_replicas_total": {
+        "type": "counter",
+        "help": "Replica shards accepted into this process's shard "
+        "replica store (buddy pushes / inheritance).",
+        "labels": (),
+    },
+    "edl_fabric_replica_bytes_total": {
+        "type": "counter",
+        "help": "Payload bytes accepted into the shard replica store "
+        "(offer/accept pushes; declined offers move no bytes).",
+        "labels": (),
+    },
     # -- control plane -------------------------------------------------------
     "edl_retry_attempts_total": {
         "type": "counter",
@@ -440,6 +483,10 @@ KNOWN_EVENT_KINDS = {
     # checkpoints / transfer
     "checkpoint.save": "checkpoint materialization submitted",
     "transfer": "streaming restore-transfer summary",
+    # sharded peer-to-peer checkpoint fabric (checkpoint.fabric)
+    "fabric.pull": "one parallel multi-peer fabric restore summary",
+    "fabric.replicate": "stage-B buddy replica offer/push summary",
+    "fabric.inherit": "scale-down victim pushed its shard inheritance",
     # control plane (runtime.coordinator)
     "coord.plan": "coordinator plan rebuild (generation bump)",
     "coord.evict": "heartbeat-lease eviction",

@@ -403,3 +403,130 @@ def test_watcher_fires_on_update_for_annotation_change():
     manifest["metadata"]["annotations"] = {"edl.tpu.dev/note": "v2"}
     assert watcher.poll_once() == 1
     assert rec.events[-1] == ("update", "ann")
+
+
+# ---- fleet-market wiring (ROADMAP item 2 residue) ---------------------------
+
+
+def make_priority_job(name, pri, mn=1, mx=2):
+    job = make_job(name=name, mn=mn, mx=mx)
+    job.spec.priority = pri
+    return job
+
+
+def test_controller_auto_attaches_fleet_when_two_jobs_carry_priority():
+    """>= 2 live jobs with spec.priority: the deployed controller
+    constructs the chip-market arbiter itself and rides it on the
+    autoscaler tick; market jobs leave the single-cluster lane while
+    non-priority jobs stay in it."""
+    kube = FakeKube(tpu_nodes(8))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    ctrl.on_add(make_priority_job("hi", 10))
+    ctrl.run_once()
+    # One prioritized job is not a market.
+    assert getattr(ctrl.autoscaler, "fleet_arbiter", None) is None
+
+    ctrl.on_add(make_priority_job("lo", 1))
+    ctrl.on_add(make_job(name="plain", mn=1, mx=2))
+    ctrl.run_once()
+    arbiter = ctrl.autoscaler.fleet_arbiter
+    assert arbiter is not None
+    assert {b.name for b in arbiter.trainers} == {"hi", "lo"}
+    # Bidder bounds/priority came from the validated spec.
+    hi = next(b for b in arbiter.trainers if b.name == "hi")
+    assert hi.priority == 10 and (hi.min_units, hi.max_units) == (1, 2)
+    # Market jobs left the single-cluster lane; the plain job stayed.
+    ctrl.autoscaler._drain_events()
+    assert "hi" not in ctrl.autoscaler.jobs
+    assert "lo" not in ctrl.autoscaler.jobs
+    assert "plain" in ctrl.autoscaler.jobs
+    # The live-inventory callable parks non-fleet usage opaquely.
+    inv = ctrl._fleet_inventory()
+    assert inv.total_chips == 32  # 8 nodes x 4 chips
+
+
+def test_controller_fleet_bidder_sync_add_and_remove():
+    kube = FakeKube(tpu_nodes(8))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    ctrl.on_add(make_priority_job("a", 5))
+    ctrl.on_add(make_priority_job("b", 3))
+    ctrl.run_once()
+    arbiter = ctrl.autoscaler.fleet_arbiter
+    assert {b.name for b in arbiter.trainers} == {"a", "b"}
+
+    # A job gaining priority later joins the market...
+    ctrl.on_add(make_priority_job("c", 7))
+    ctrl.run_once()
+    assert {b.name for b in arbiter.trainers} == {"a", "b", "c"}
+    ctrl.autoscaler._drain_events()
+    assert "c" not in ctrl.autoscaler.jobs
+    # ...and a deleted job leaves it.
+    ctrl.on_delete(ctrl.jobs["b"])
+    ctrl.run_once()
+    assert {b.name for b in arbiter.trainers} == {"a", "c"}
+    assert ctrl._fleet_managed == {"a", "c"}
+
+
+def test_controller_respects_explicitly_attached_arbiter():
+    """An arbiter attached by hand (tests / custom markets) is reused:
+    the controller only syncs ITS jobs into it, never re-attaches."""
+    from edl_tpu.fleet import FleetArbiter, TrainingBidder, attach_fleet
+
+    kube = FakeKube(tpu_nodes(8))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    arbiter = FleetArbiter(
+        8,
+        trainers=[
+            TrainingBidder("external", None, min_units=1, max_units=1)
+        ],
+    )
+    attach_fleet(ctrl.autoscaler, arbiter)
+    ctrl.on_add(make_priority_job("x", 2))
+    ctrl.on_add(make_priority_job("y", 4))
+    ctrl.run_once()  # must NOT raise "already attached"
+    assert ctrl.autoscaler.fleet_arbiter is arbiter
+    assert {b.name for b in arbiter.trainers} == {"external", "x", "y"}
+
+
+def test_controller_market_jobs_survive_watch_updates():
+    """A watch update on a market-owned job must NOT re-enroll it in
+    the single-cluster lane (two planners would fight over one
+    workload)."""
+    kube = FakeKube(tpu_nodes(8))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    ctrl.on_add(make_priority_job("a", 5))
+    ctrl.on_add(make_priority_job("b", 3))
+    ctrl.run_once()
+    assert ctrl.autoscaler.fleet_arbiter is not None
+    # Annotation-style update (same spec, new object) on a market job.
+    ctrl.on_update(make_priority_job("a", 5))
+    ctrl.autoscaler._drain_events()
+    assert "a" not in ctrl.autoscaler.jobs
+    ctrl.run_once()  # and the next tick keeps both planners disjoint
+    ctrl.autoscaler._drain_events()
+    assert "a" not in ctrl.autoscaler.jobs
+
+
+def test_controller_priority_removed_job_returns_to_single_lane():
+    """A live job whose spec.priority is edited away leaves the market
+    AND re-enters the single-cluster lane — owned by neither planner,
+    it would never scale again."""
+    kube = FakeKube(tpu_nodes(8))
+    cluster = Cluster(kube)
+    ctrl = Controller(cluster, clock=lambda: 100.0)
+    ctrl.on_add(make_priority_job("a", 5))
+    ctrl.on_add(make_priority_job("b", 3))
+    ctrl.run_once()
+    arbiter = ctrl.autoscaler.fleet_arbiter
+    assert {bd.name for bd in arbiter.trainers} == {"a", "b"}
+
+    ctrl.on_update(make_job(name="b", mn=1, mx=2))  # priority -> 0
+    ctrl.run_once()
+    assert {bd.name for bd in arbiter.trainers} == {"a"}
+    assert ctrl._fleet_managed == {"a"}
+    ctrl.autoscaler._drain_events()
+    assert "b" in ctrl.autoscaler.jobs

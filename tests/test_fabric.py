@@ -1,0 +1,1099 @@
+"""Sharded peer-to-peer checkpoint fabric (checkpoint/fabric.py).
+
+Same discipline as tests/test_restore_transfer.py: the tiny agreement
+rides the barrier-based ``LoopbackWorld`` while the TCP data plane is
+REAL (loopback sockets, per-chunk CRCs) — so the per-peer wire
+accounting these tests assert is the production transport's.
+
+The headline property: a joiner's restore is fed by MANY peers in
+parallel with NO single peer sending the full state, and a peer that
+dies or serves torn bytes mid-pull costs only a per-shard fallback,
+never the restore.
+"""
+
+import threading
+import zlib
+
+import numpy as np
+
+import jax
+
+from edl_tpu.chaos import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import transfer as tx
+from edl_tpu.checkpoint import fabric as fab
+from edl_tpu.checkpoint.hostdram import HostCheckpoint, HostDRAMStore
+
+
+def make_ckpt(leaves, step=10):
+    _, treedef = jax.tree_util.tree_flatten(list(leaves))
+    return HostCheckpoint(
+        step=step, generation=1, leaves=list(leaves), treedef=treedef
+    )
+
+
+def template_of(leaves):
+    return [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+
+
+def source_leaves(seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.randn(64, 32).astype(np.float32),   # 8KB
+        rng.randn(257, 16).astype(np.float32),  # odd row count
+        np.asarray(rng.randint(0, 100), np.int32).reshape(()),  # 0-d step
+        rng.randn(4000).astype(np.float64),     # 32KB
+    ]
+
+
+def run_world(member_fns, timeout=60):
+    world = tx.LoopbackWorld(len(member_fns))
+    results = [None] * len(member_fns)
+    errors = [None] * len(member_fns)
+
+    def runner(rank, fn):
+        try:
+            results[rank] = fn(world.fabric(rank))
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=runner, args=(r, fn), daemon=True)
+        for r, fn in enumerate(member_fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        assert not t.is_alive(), "member thread hung"
+    for e in errors:
+        if e is not None:
+            raise e
+    return results
+
+
+# ---- the shard layout ------------------------------------------------------
+
+
+def test_layout_boundaries_are_world_independent():
+    sizes = [100 << 10, 3 << 10, 4]
+    rows = [256, 0, 0]
+    a = fab.ShardLayout.build(sizes, 2, shard_bytes=16 << 10, rows=rows)
+    b = fab.ShardLayout.build(sizes, 7, shard_bytes=16 << 10, rows=rows)
+    assert [
+        (s.leaf, s.offset, s.length) for s in a.shards
+    ] == [(s.leaf, s.offset, s.length) for s in b.shards]
+    assert a.key() == b.key()
+    # Coverage is exact and non-overlapping per leaf.
+    for layout in (a, b):
+        for i, nbytes in enumerate(sizes):
+            shs = sorted(layout.by_leaf[i], key=lambda s: s.offset)
+            assert shs[0].offset == 0
+            assert sum(s.length for s in shs) == nbytes
+            for prev, nxt in zip(shs, shs[1:]):
+                assert prev.offset + prev.length == nxt.offset
+
+
+def test_layout_row_aligned_ownership_matches_gspmd_chunks():
+    """Row-aligned shards are owned by the member whose ceil-chunked
+    axis-0 GSPMD slice contains them — 'each member already holds
+    exactly its shards'."""
+    rows = 256
+    row_b = 1 << 10
+    layout = fab.ShardLayout.build(
+        [rows * row_b], 4, shard_bytes=16 << 10, rows=[rows]
+    )
+    chunk = -(-rows // 4)  # 64 rows per member
+    for s in layout.shards:
+        assert layout.owner(s) == min(s.start_row // chunk, 3)
+    owners = {layout.owner(s) for s in layout.shards}
+    assert owners == {0, 1, 2, 3}  # every member owns a stripe
+
+
+def test_layout_replica_map_is_ring_deterministic():
+    layout = fab.ShardLayout.build(
+        [64 << 10], 4, k=2, shard_bytes=8 << 10, rows=[64]
+    )
+    for s in layout.shards:
+        owner = layout.owner(s)
+        assert layout.holders(s) == (
+            owner,
+            (owner + 1) % 4,
+            (owner + 2) % 4,
+        )
+    # Every member computes the identical map from the membership.
+    assert layout.replica_map() == fab.ShardLayout.build(
+        [64 << 10], 4, k=2, shard_bytes=8 << 10, rows=[64]
+    ).replica_map()
+
+
+def test_shard_digests_refine_leaf_digests():
+    leaves = source_leaves(1)
+    ck = make_ckpt(leaves)
+    layout = fab.ShardLayout.build(
+        [l.nbytes for l in leaves], 3, shard_bytes=1024,
+        rows=[l.shape[0] if l.ndim else 0 for l in leaves],
+    )
+    shard_crcs, leaf_crcs = fab.compute_shard_digests(leaves, layout)
+    # The chained-shard leaf crc IS PR 2's leaf digest, bit for bit.
+    assert leaf_crcs == ck.leaf_digests()
+    # One flipped byte dirties exactly one shard (and its leaf).
+    dirty = [np.array(l, copy=True) for l in leaves]
+    dirty[3].reshape(-1).view(np.uint8)[7] ^= 0xFF
+    shard2, leaf2 = fab.compute_shard_digests(dirty, layout)
+    diff = [i for i in range(len(shard_crcs)) if shard_crcs[i] != shard2[i]]
+    assert len(diff) == 1 and layout.shards[diff[0]].leaf == 3
+    assert [i for i in range(4) if leaf_crcs[i] != leaf2[i]] == [3]
+
+
+def test_hostcheckpoint_shard_digest_cache_and_spill_manifest(tmp_path):
+    leaves = source_leaves(2)
+    layout = fab.ShardLayout.build(
+        [l.nbytes for l in leaves], 2, shard_bytes=1024,
+        rows=[l.shape[0] if l.ndim else 0 for l in leaves],
+    )
+    ck = make_ckpt(leaves)
+    digs = ck.shard_digests(layout)
+    assert ck.shard_digests(layout) is digs  # cached by boundary key
+    # The single pass filled the per-leaf vector too.
+    assert ck._leaf_digests is not None
+
+    # Spill manifests carry the per-shard vector; a cold load re-seeds
+    # the cache without a hash pass.
+    import glob
+    import json
+
+    store = HostDRAMStore(spill_dir=str(tmp_path))
+    state = {"w": np.arange(4096, dtype=np.float32), "step": 3}
+    store.save_async(state)
+    store.wait()
+    (mpath,) = glob.glob(f"{tmp_path}/ckpt-*.json")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    assert "shard_digests" in manifest and "shard_bytes" in manifest
+    cold = HostDRAMStore(spill_dir=str(tmp_path))
+    loaded = cold.load_from_disk(state)
+    assert loaded._shard_digests is not None
+    assert loaded._shard_digests[1] == manifest["shard_digests"]
+
+
+# ---- the parallel pull -----------------------------------------------------
+
+
+def test_joiner_pulls_from_many_peers_no_single_full_sender():
+    """THE acceptance property (ROADMAP item 3): a fresh joiner's
+    restore is fed by >= 2 peers in parallel and NO single peer sends
+    the full state — wire bytes accounted per peer."""
+    leaves = source_leaves(3)
+    total = sum(l.nbytes for l in leaves)
+    src = make_ckpt(leaves, step=9)
+    twin = make_ckpt([np.array(l) for l in leaves], step=9)
+    template = template_of(leaves)
+    placed = []
+
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(
+                f, template, src, shard_bytes=1024
+            ),
+            lambda f: fab.fabric_restore(
+                f, template, twin, shard_bytes=1024
+            ),
+            lambda f: fab.fabric_restore(
+                f,
+                template,
+                None,
+                shard_bytes=1024,
+                on_leaf=lambda i, a: placed.append(i),
+            ),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    assert r2.stats.bytes_received == total
+    per_peer = r2.stats.per_peer
+    assert len(per_peer) >= 2, per_peer
+    assert sum(per_peer.values()) == total
+    assert max(per_peer.values()) < total, (
+        f"one peer sent the full state: {per_peer}"
+    )
+    # Every leaf reached placement exactly once; bytes are bit-exact.
+    assert sorted(placed) == list(range(len(leaves)))
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    # Zero-copy adoption discipline: the authority's leaf digests
+    # verify against the assembled bytes.
+    merged = make_ckpt(r2.leaves, step=9)
+    merged.adopt_digests(r2.leaf_digests)
+    assert merged.verify()
+    # The sources each served only part of the state.
+    for r in (r0, r1):
+        assert 0 < r.stats.bytes_sent < total
+
+
+def test_two_member_world_falls_back_to_single_source_stream():
+    """One holder = no multi-peer coverage: every member hands the
+    restore to PR 2's stream (mode 'delta'), so 2-member worlds keep
+    the exact leaf-level delta behavior."""
+    leaves = source_leaves(4)
+    src = make_ckpt(leaves, step=5)
+    template = template_of(leaves)
+    r0, r1 = run_world(
+        [
+            lambda f: fab.fabric_restore(f, template, src, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024),
+        ]
+    )
+    assert r0.stats.mode == "delta" and r1.stats.mode == "delta"
+    # The fabric agreement's endpoint addresses ride the hand-off
+    # result, so small worlds still replicate/inherit afterwards.
+    assert r0.peer_addrs is not None and 0 in r0.peer_addrs
+    assert r1.stats.bytes_received == sum(l.nbytes for l in leaves)
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+
+
+def test_identical_stores_move_nothing_and_nobody_is_init():
+    leaves = source_leaves(5)
+    template = template_of(leaves)
+    a = make_ckpt([np.array(l) for l in leaves], step=4)
+    b = make_ckpt([np.array(l) for l in leaves], step=4)
+    c = make_ckpt([np.array(l) for l in leaves], step=4)
+    rs = run_world(
+        [
+            lambda f, ck=ck: fab.fabric_restore(
+                f, template, ck, shard_bytes=1024
+            )
+            for ck in (a, b, c)
+        ]
+    )
+    for r in rs:
+        assert r.stats.mode == "local"
+        assert r.stats.bytes_received == r.stats.bytes_sent == 0
+
+    rs = run_world(
+        [
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024)
+            for _ in range(3)
+        ]
+    )
+    assert all(r.stats.mode == "init" for r in rs)
+
+
+def test_partial_divergence_moves_only_diverged_shards():
+    """A member diverged in ONE shard of one leaf receives exactly
+    that shard's bytes — the delta discipline at shard granularity."""
+    leaves = source_leaves(6)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=7)
+    twin = make_ckpt([np.array(l) for l in leaves], step=7)
+    stale_leaves = [np.array(l) for l in leaves]
+    # Flip one byte inside the big last leaf (32KB / 1KB shards).
+    stale_leaves[3].reshape(-1).view(np.uint8)[5] ^= 0xFF
+    stale = make_ckpt(stale_leaves, step=7)
+
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(f, template, src, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, twin, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, stale, shard_bytes=1024),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    assert r2.stats.bytes_received == 1024  # exactly one shard
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+
+
+def test_replica_holder_serves_without_a_checkpoint():
+    """A member holding only buddy-replica shards (no checkpoint)
+    advertises and serves them — the coverage that makes inheritance
+    visible to the next agreement."""
+    leaves = source_leaves(7)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=6)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 3, shard_bytes=1024, rows=rows)
+    # The replica holder carries the big leaf's shards at step 6.
+    rep = fab.ShardReplicaStore()
+    for s in layout.by_leaf[3]:
+        view = memoryview(leaves[3]).cast("B")[
+            s.offset : s.offset + s.length
+        ]
+        data = np.frombuffer(bytes(view), np.uint8)
+        assert rep.put(
+            6, s.leaf, s.offset, s.length, data, zlib.crc32(view)
+        )
+
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(f, template, src, shard_bytes=1024),
+            lambda f: fab.fabric_restore(
+                f, template, None, shard_bytes=1024, replica_store=rep
+            ),
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    # The joiner pulled from BOTH the source and the replica holder.
+    assert len(r2.stats.per_peer) == 2, r2.stats.per_peer
+    assert r2.stats.per_peer.get("1", 0) > 0
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    # The replica holder itself assembled a full verified state too.
+    for got, want in zip(r1.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+
+
+# ---- chaos: torn replicas, lost peers, slow peers --------------------------
+
+
+def test_torn_replica_falls_back_to_another_holder():
+    """chaos[fabric.replica.torn]: a serving peer's bytes rotted after
+    its crc was advertised — the receiver's reference-digest check
+    must reject the shard and re-pull it from another holder, and the
+    restore must still succeed."""
+    leaves = source_leaves(8)
+    total = sum(l.nbytes for l in leaves)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=3)
+    twin = make_ckpt([np.array(l) for l in leaves], step=3)
+    chaos = FaultSchedule(
+        seed=5, events=[FaultEvent(step=0, point="fabric.replica.torn")]
+    )
+    chaos.advance(0)
+
+    def src_member(f):
+        # The chaos schedule rides ONE member's server: exactly one
+        # served shard is torn.
+        return fab.fabric_restore(
+            f, template, src, shard_bytes=1024, chaos=chaos
+        )
+
+    r0, r1, r2 = run_world(
+        [
+            src_member,
+            lambda f: fab.fabric_restore(f, template, twin, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    assert r2.stats.shard_fallbacks >= 1
+    # The torn shard was re-received: one extra shard of wire bytes.
+    assert r2.stats.bytes_received > total
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    assert not chaos.pending()
+
+
+def test_peer_lost_mid_pull_falls_back_per_shard():
+    """chaos[fabric.peer.lost]: a source dies mid-pull — its
+    unfinished shards fall back to another replica holder instead of
+    failing the restore."""
+    leaves = source_leaves(9)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=8)
+    twin = make_ckpt([np.array(l) for l in leaves], step=8)
+    chaos = FaultSchedule(
+        seed=6, events=[FaultEvent(step=0, point="fabric.peer.lost")]
+    )
+    chaos.advance(0)
+    placed = []
+
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(f, template, src, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, twin, shard_bytes=1024),
+            lambda f: fab.fabric_restore(
+                f,
+                template,
+                None,
+                shard_bytes=1024,
+                chaos=chaos,
+                on_leaf=lambda i, a: placed.append(i),
+            ),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    assert r2.stats.shard_fallbacks >= 1
+    assert sorted(placed) == list(range(len(leaves)))
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    assert not chaos.pending()
+
+
+def test_all_holders_torn_fails_resize_on_every_member():
+    """When EVERY holder of a shard serves torn bytes the pull is
+    unrecoverable: the confirmation all-gather must fail the resize on
+    every member together (nobody adopts), exactly PR 2's
+    world-consistent verdict."""
+    leaves = source_leaves(10)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=2)
+    twin = make_ckpt([np.array(l) for l in leaves], step=2)
+    # Both holders serve one torn shard each (their own schedules).
+    chaos_a = FaultSchedule(
+        seed=7,
+        events=[FaultEvent(step=0, point="fabric.replica.torn", arg=None)]
+        * 60,
+    )
+    chaos_b = FaultSchedule(
+        seed=8,
+        events=[FaultEvent(step=0, point="fabric.replica.torn", arg=None)]
+        * 60,
+    )
+    chaos_a.advance(0)
+    chaos_b.advance(0)
+
+    world = tx.LoopbackWorld(3)
+    errs = [None, None, None]
+
+    def member(rank, ck, chaos=None):
+        def run():
+            try:
+                fab.fabric_restore(
+                    world.fabric(rank),
+                    template,
+                    ck,
+                    shard_bytes=1024,
+                    chaos=chaos,
+                )
+            except BaseException as e:  # noqa: BLE001 - asserted below
+                errs[rank] = e
+
+        return run
+
+    ts = [
+        threading.Thread(target=member(0, src, chaos_a), daemon=True),
+        threading.Thread(target=member(1, twin, chaos_b), daemon=True),
+        threading.Thread(target=member(2, None), daemon=True),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    assert all(
+        isinstance(e, tx.TornTransferError) for e in errs
+    ), errs
+
+
+def test_slow_peer_stalls_but_completes():
+    """chaos[fabric.pull.slow]: a stalled serving peer delays its
+    stream without corrupting the restore."""
+    import time
+
+    leaves = source_leaves(11)
+    template = template_of(leaves)
+    src = make_ckpt(leaves, step=1)
+    twin = make_ckpt([np.array(l) for l in leaves], step=1)
+    chaos = FaultSchedule(
+        seed=9,
+        events=[FaultEvent(step=0, point="fabric.pull.slow", arg=0.3)],
+    )
+    chaos.advance(0)
+
+    t0 = time.perf_counter()
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(
+                f, template, src, shard_bytes=1024, chaos=chaos
+            ),
+            lambda f: fab.fabric_restore(f, template, twin, shard_bytes=1024),
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024),
+        ]
+    )
+    assert time.perf_counter() - t0 >= 0.25
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    assert not chaos.pending()
+
+
+# ---- replication: offer/accept to the deterministic buddies ----------------
+
+
+def _serve_member(ckpt, replicas, step):
+    """A started FabricServer over (ckpt, replica store)."""
+
+    def lookup(st, leaf, off, length):
+        if (
+            ckpt is not None
+            and st == step
+            and leaf < len(ckpt.leaves)
+            and ckpt.leaves[leaf].nbytes >= off + length
+        ):
+            return memoryview(
+                np.ascontiguousarray(ckpt.leaves[leaf])
+            ).cast("B")[off : off + length]
+        return replicas.get(st, leaf, off, length)
+
+    def has_bytes(st, leaf, off, length):
+        return (
+            ckpt is not None
+            and st == step
+            and leaf < len(ckpt.leaves)
+            and ckpt.leaves[leaf].nbytes >= off + length
+        )
+
+    return fab.FabricServer(
+        lookup, ingest=fab.ReplicaIngest(replicas, has_bytes)
+    ).start()
+
+
+def test_replication_offer_accept_dedup():
+    """Buddies missing the step accept the payload; buddies already
+    holding the flushed checkpoint decline BEFORE any payload moves —
+    the byte-free common case of collective flushes."""
+    from edl_tpu import telemetry
+
+    leaves = source_leaves(12)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 3, k=1, shard_bytes=1024, rows=rows)
+    ck = make_ckpt(leaves, step=20)
+    digs = ck.shard_digests(layout)
+
+    with telemetry.scoped():
+        # Buddy 1 already holds the flushed checkpoint; buddy 2 is
+        # cold (a fresh joiner / degraded-flush survivor).
+        warm_rep = fab.ShardReplicaStore()
+        cold_rep = fab.ShardReplicaStore()
+        warm = _serve_member(make_ckpt(leaves, step=20), warm_rep, 20)
+        cold = _serve_member(None, cold_rep, 20)
+        try:
+            peer_addrs = {
+                1: ("127.0.0.1", warm.port),
+                2: ("127.0.0.1", cold.port),
+            }
+
+            def shard_source(s):
+                view = memoryview(
+                    np.ascontiguousarray(ck.leaves[s.leaf])
+                ).cast("B")
+                return (
+                    view[s.offset : s.offset + s.length],
+                    digs[s.index],
+                )
+
+            # Rank 0 offers its owned shards; its k=1 buddies are the
+            # ring successors of each shard's owner.
+            summary = fab.replicate_to_buddies(
+                layout, 0, 20, 1, peer_addrs, shard_source
+            )
+        finally:
+            warm.stop()
+            cold.stop()
+    owned = layout.owned_by(0)
+    to_warm = [s for s in owned if layout.holders(s)[1:] == (1,)]
+    to_cold = [s for s in owned if layout.holders(s)[1:] == (2,)]
+    assert summary["offered"] == len(to_warm) + len(to_cold)
+    # The warm buddy declined everything (zero payload), the cold one
+    # accepted its offers.
+    assert summary["accepted"] == len(to_cold)
+    assert summary["bytes"] == sum(s.length for s in to_cold)
+    assert warm_rep.nbytes() == 0
+    for s in to_cold:
+        assert cold_rep.get(20, s.leaf, s.offset, s.length) is not None
+
+
+def test_replication_lost_push_is_dropped_not_fatal():
+    """chaos[fabric.replica.lost]: a dropped push is journaled as
+    dropped and the flush is unaffected (best-effort replication)."""
+    leaves = source_leaves(13)
+    sizes = [l.nbytes for l in leaves]
+    layout = fab.ShardLayout.build(
+        sizes, 2, k=1, shard_bytes=1024,
+        rows=[l.shape[0] if l.ndim else 0 for l in leaves],
+    )
+    ck = make_ckpt(leaves, step=30)
+    digs = ck.shard_digests(layout)
+    chaos = FaultSchedule(
+        seed=10, events=[FaultEvent(step=0, point="fabric.replica.lost")]
+    )
+    chaos.advance(0)
+    rep = fab.ShardReplicaStore()
+    srv = _serve_member(None, rep, 30)
+    try:
+
+        def shard_source(s):
+            view = memoryview(
+                np.ascontiguousarray(ck.leaves[s.leaf])
+            ).cast("B")
+            return view[s.offset : s.offset + s.length], digs[s.index]
+
+        summary = fab.replicate_to_buddies(
+            layout,
+            0,
+            30,
+            1,
+            {1: ("127.0.0.1", srv.port)},
+            shard_source,
+            chaos=chaos,
+        )
+    finally:
+        srv.stop()
+    assert summary["dropped"] > 0
+    assert rep.nbytes() == 0
+    assert not chaos.pending()
+
+
+def test_replica_store_bounds_and_staleness():
+    rep = fab.ShardReplicaStore(keep_steps=1)
+    data = np.arange(16, dtype=np.uint8)
+    crc = zlib.crc32(data)
+    assert rep.wants(5, 0, 0, 16)
+    assert rep.put(5, 0, 0, 16, data, crc)
+    assert not rep.wants(5, 0, 0, 16)  # already held
+    assert not rep.wants(4, 0, 0, 16)  # stale step declined
+    assert rep.put(6, 1, 0, 16, data, crc)  # newer step...
+    assert rep.get(5, 0, 0, 16) is None  # ...prunes the old one
+    assert rep.newest_step() == 6
+    # A crc-mismatched put is rejected outright.
+    assert not rep.put(7, 0, 0, 16, data, crc ^ 1)
+
+
+def test_inheritance_round_trip_via_next_agreement():
+    """The stretch end-to-end, unit-scale: a 'victim' replicates its
+    newest shards to a buddy, then a LATER agreement (victim gone)
+    finds the buddy advertising them — the joiner restores a state
+    that only survived through the replica store."""
+    leaves = source_leaves(14)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 2, k=1, shard_bytes=1024, rows=rows)
+    victim_ck = make_ckpt(leaves, step=40)
+    digs = victim_ck.shard_digests(layout)
+
+    survivor_rep = fab.ShardReplicaStore()
+    srv = _serve_member(None, survivor_rep, 40)
+    try:
+
+        def shard_source(s):
+            view = memoryview(
+                np.ascontiguousarray(victim_ck.leaves[s.leaf])
+            ).cast("B")
+            return view[s.offset : s.offset + s.length], digs[s.index]
+
+        # The victim owns EVERY shard at world=1 (it is the only
+        # member of its ownership ring that still has the bytes).
+        solo = fab.ShardLayout.build(sizes, 1, k=1, shard_bytes=1024,
+                                     rows=rows)
+        items = [
+            (
+                s.leaf,
+                s.offset,
+                s.length,
+                digs[s.index],
+                shard_source(s)[0],
+            )
+            for s in solo.shards
+        ]
+        accepted, _ = fab.push_shards(
+            ("127.0.0.1", srv.port), 0, 40, 2, items
+        )
+        assert accepted == len(solo.shards)
+    finally:
+        srv.stop()
+
+    # Next world: the survivor (replica-only) + a fresh joiner.  The
+    # victim is gone; its state restores from the replica store.  One
+    # holder only -> the fabric routes to the single-source stream,
+    # which needs a full checkpoint — so pair the survivor with a twin
+    # replica holder to keep multi-peer coverage.
+    template = template_of(leaves)
+    twin_rep = fab.ShardReplicaStore()
+    for leaf, off, length, crc in survivor_rep.shards_at(40):
+        data = survivor_rep.get(40, leaf, off, length)
+        assert twin_rep.put(40, leaf, off, length, np.array(data), crc)
+
+    r0, r1, r2 = run_world(
+        [
+            lambda f: fab.fabric_restore(
+                f, template, None, shard_bytes=1024,
+                replica_store=survivor_rep,
+            ),
+            lambda f: fab.fabric_restore(
+                f, template, None, shard_bytes=1024,
+                replica_store=twin_rep,
+            ),
+            lambda f: fab.fabric_restore(f, template, None, shard_bytes=1024),
+        ]
+    )
+    assert r2.stats.mode == "fabric"
+    assert r2.stats.step == 40
+    for got, want in zip(r2.leaves, leaves):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )
+    # No full-state authority existed: adoption digests are absent and
+    # the caller fingerprints fresh (store.put path).
+    assert r2.leaf_digests is None
+    # The replica-only holders assembled REAL full leaves from their
+    # stores too (not the absent checkpoint's Nones).
+    for r in (r0, r1):
+        for got, want in zip(r.leaves, leaves):
+            assert got is not None
+            np.testing.assert_array_equal(
+                np.asarray(got).reshape(want.shape), want
+            )
+
+
+def test_fabric_metrics_and_events_registered():
+    """Every fabric metric/event/chaos name is catalog-registered (the
+    lint gate's runtime mirror)."""
+    from edl_tpu.chaos.schedule import KNOWN_POINTS
+    from edl_tpu.telemetry.catalog import CATALOG, KNOWN_EVENT_KINDS
+
+    for name in (
+        "edl_fabric_bytes_sent_total",
+        "edl_fabric_bytes_received_total",
+        "edl_fabric_shard_fallbacks_total",
+        "edl_fabric_pull_peers",
+        "edl_fabric_pull_seconds",
+        "edl_fabric_replicas_total",
+        "edl_fabric_replica_bytes_total",
+    ):
+        assert name in CATALOG, name
+    for kind in ("fabric.pull", "fabric.replicate", "fabric.inherit"):
+        assert kind in KNOWN_EVENT_KINDS, kind
+    for point in (
+        "fabric.replica.torn",
+        "fabric.peer.lost",
+        "fabric.replica.lost",
+        "fabric.pull.slow",
+    ):
+        assert point in KNOWN_POINTS, point
+
+
+def test_flush_sync_stage_b_hook_runs_on_background_thread():
+    """``flush_sync(on_background=...)`` fires after fingerprint/spill
+    on the BACKGROUND thread, and a hook failure is printed — never
+    recorded as a flush error (a failed replication must not read as a
+    failed flush and degrade a later resize to replay)."""
+    import collections
+
+    St = collections.namedtuple("St", ("w", "step"))
+    store = HostDRAMStore()
+    seen = []
+    ckpt, bg = store.flush_sync(
+        St(np.arange(1024, dtype=np.float32), 5),
+        generation=2,
+        on_background=lambda ck: seen.append(ck.step),
+    )
+    assert bg is not None
+    bg.join()
+    assert seen == [5]
+    assert bg.edl_error is None
+
+    def boom(ck):
+        raise RuntimeError("replication transport down")
+
+    ckpt2, bg2 = store.flush_sync(
+        St(np.arange(1024, dtype=np.float32) + 1, 6),
+        generation=2,
+        on_background=boom,
+    )
+    bg2.join()
+    assert bg2.edl_error is None  # hook errors never poison the flush
+    store.wait()
+
+
+def test_zero_length_shard_offer_keeps_session_in_sync():
+    """A 0-byte leaf's shard carries no payload chunks in an OFFER
+    session: an accepted empty shard must not desync the wire for the
+    ranges after it (the ack must arrive, later shards must land)."""
+    leaves = [
+        np.zeros((0, 4), np.float32),  # 0-byte leaf
+        np.arange(512, dtype=np.float32),
+    ]
+    sizes = [l.nbytes for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 2, shard_bytes=1024, rows=[0, 512])
+    ck = make_ckpt(leaves, step=50)
+    digs = ck.shard_digests(layout)
+    rep = fab.ShardReplicaStore()
+    srv = _serve_member(None, rep, 50)
+    try:
+        items = [
+            (
+                s.leaf,
+                s.offset,
+                s.length,
+                digs[s.index],
+                fab.byte_view(ck.leaves[s.leaf])[
+                    s.offset : s.offset + s.length
+                ],
+            )
+            for s in layout.shards  # empty shard FIRST, then payload
+        ]
+        accepted, sent = fab.push_shards(
+            ("127.0.0.1", srv.port), 0, 50, 1, items
+        )
+    finally:
+        srv.stop()
+    assert accepted == len(layout.shards)
+    assert sent == leaves[1].nbytes  # only the non-empty shards moved
+    parts = [
+        rep.get(50, 1, s.offset, s.length) for s in layout.by_leaf[1]
+    ]
+    assert all(p is not None for p in parts)
+    np.testing.assert_array_equal(
+        np.frombuffer(b"".join(bytes(p) for p in parts), np.float32),
+        leaves[1],
+    )
+    assert rep.get(50, 0, 0, 0) is not None  # empty shard recorded
+
+
+def test_replica_only_identical_coverage_assembles_locally():
+    """Every member is a replica-only holder with the IDENTICAL full
+    coverage: nothing moves (mode local) but each member must rebuild
+    real leaves from its store — not return the absent checkpoint's
+    Nones after a clean agreement."""
+    leaves = source_leaves(15)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 2, shard_bytes=1024, rows=rows)
+    ck = make_ckpt(leaves, step=60)
+    digs = ck.shard_digests(layout)
+
+    def replica_store_of():
+        rep = fab.ShardReplicaStore()
+        for s in layout.shards:
+            view = fab.byte_view(ck.leaves[s.leaf])[
+                s.offset : s.offset + s.length
+            ]
+            assert rep.put(
+                60,
+                s.leaf,
+                s.offset,
+                s.length,
+                np.frombuffer(bytes(view), np.uint8),
+                digs[s.index],
+            )
+        return rep
+
+    template = template_of(leaves)
+    r0, r1 = run_world(
+        [
+            lambda f, rep=replica_store_of(): fab.fabric_restore(
+                f, template, None, shard_bytes=1024, replica_store=rep
+            )
+            for _ in range(2)
+        ]
+    )
+    for r in (r0, r1):
+        assert r.stats.mode == "local"
+        assert r.stats.bytes_received == r.stats.bytes_sent == 0
+        assert r.leaf_digests is None  # no full-state authority
+        for got, want in zip(r.leaves, leaves):
+            assert got is not None
+            np.testing.assert_array_equal(
+                np.asarray(got).reshape(want.shape), want
+            )
+
+
+def test_unrestorable_newer_step_degrades_to_full_checkpoint():
+    """A replica-only PARTIAL newer step with no full holder anywhere
+    must not livelock the hold-and-retry loop: the failed agreement
+    drops the poisoned step's replica bytes on EVERY member (all
+    decode the same gather matrix), so the retried agreement
+    advertises the newest FULL checkpoint step — PR 2's
+    degrade-to-next-oldest discipline at fabric granularity."""
+    leaves = source_leaves(16)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 2, shard_bytes=1024, rows=rows)
+    cks = [make_ckpt(leaves, step=60), make_ckpt(leaves, step=60)]
+    nk = make_ckpt(source_leaves(17), step=70)
+    digs70 = nk.shard_digests(layout)
+
+    def partial_store():
+        rep = fab.ShardReplicaStore()
+        for s in layout.shards[: len(layout.shards) // 2]:
+            view = fab.byte_view(nk.leaves[s.leaf])[
+                s.offset : s.offset + s.length
+            ]
+            assert rep.put(
+                70,
+                s.leaf,
+                s.offset,
+                s.length,
+                np.frombuffer(bytes(view), np.uint8),
+                digs70[s.index],
+            )
+        return rep
+
+    reps = [partial_store(), partial_store()]
+    template = template_of(leaves)
+
+    def held(rank):
+        def fn(f):
+            try:
+                fab.fabric_restore(
+                    f,
+                    template,
+                    cks[rank],
+                    shard_bytes=1024,
+                    replica_store=reps[rank],
+                )
+            except tx.TransferError as e:
+                return str(e)
+            return None
+
+        return fn
+
+    held0, held1 = run_world([held(0), held(1)])
+    assert held0 is not None and held1 is not None
+    assert "partial coverage" in held0
+    # The poisoned step's bytes are gone on BOTH members: the retry
+    # cannot re-advertise step 70.
+    assert reps[0].newest_step() == reps[1].newest_step() == -1
+
+    r0, r1 = run_world(
+        [
+            lambda f: fab.fabric_restore(
+                f,
+                template,
+                cks[0],
+                shard_bytes=1024,
+                replica_store=reps[0],
+            ),
+            lambda f: fab.fabric_restore(
+                f,
+                template,
+                cks[1],
+                shard_bytes=1024,
+                replica_store=reps[1],
+            ),
+        ]
+    )
+    for r in (r0, r1):
+        assert r.stats.mode == "local"
+        assert r.stats.step == 60
+        assert r.stats.bytes_received == 0
+
+
+def test_unrestorable_asymmetric_coverage_also_degrades():
+    """No full holder + ASYMMETRIC partial coverage: needs is
+    non-empty and ≥2 peers serve the needed shards, but some shards
+    were advertised by NOBODY.  The gap check must catch those before
+    the pull (they appear in no needs row) and degrade — previously
+    this fell through to the exhausted-holder pull failure, which
+    retries without degrading and livelocks."""
+    leaves = source_leaves(18)
+    sizes = [l.nbytes for l in leaves]
+    rows = [l.shape[0] if l.ndim else 0 for l in leaves]
+    layout = fab.ShardLayout.build(sizes, 3, shard_bytes=1024, rows=rows)
+    cks = [make_ckpt(leaves, step=60) for _ in range(3)]
+    nk = make_ckpt(source_leaves(19), step=70)
+    digs70 = nk.shard_digests(layout)
+    half = len(layout.shards) // 2
+
+    def partial_store(count):
+        rep = fab.ShardReplicaStore()
+        for s in layout.shards[:count]:
+            view = fab.byte_view(nk.leaves[s.leaf])[
+                s.offset : s.offset + s.length
+            ]
+            assert rep.put(
+                70,
+                s.leaf,
+                s.offset,
+                s.length,
+                np.frombuffer(bytes(view), np.uint8),
+                digs70[s.index],
+            )
+        return rep
+
+    # Two members cover the first half, the third only a quarter:
+    # its missing shards have TWO serving peers, while the second
+    # half of the table has none.
+    reps = [partial_store(half), partial_store(half), partial_store(half // 2)]
+    template = template_of(leaves)
+
+    def held(rank):
+        def fn(f):
+            try:
+                fab.fabric_restore(
+                    f,
+                    template,
+                    cks[rank],
+                    shard_bytes=1024,
+                    replica_store=reps[rank],
+                )
+            except tx.TransferError as e:
+                return str(e)
+            return None
+
+        return fn
+
+    msgs = run_world([held(0), held(1), held(2)])
+    for msg in msgs:
+        assert msg is not None and "no holder" in msg
+    for rep in reps:
+        assert rep.newest_step() == -1  # poisoned step dropped
+
+    results = run_world(
+        [
+            lambda f, r=rank: fab.fabric_restore(
+                f,
+                template,
+                cks[r],
+                shard_bytes=1024,
+                replica_store=reps[r],
+            )
+            for rank in range(3)
+        ]
+    )
+    for r in results:
+        assert r.stats.mode == "local"
+        assert r.stats.step == 60
+
+
+def test_stale_step_member_keeps_crc_matched_shards():
+    """PR 2's step-agnostic delta keep at shard granularity: a member
+    whose checkpoint is one step BEHIND must re-pull only the shards
+    whose crcs differ from the agreed reference — the agreement just
+    proved the rest byte-identical, so sourcing them locally is free."""
+    leaves = source_leaves(20)
+    newer = [l.copy() for l in leaves]
+    newer[0] = leaves[0] + 1.0  # one leaf really changed
+    newer[2] = np.asarray(77, np.int32).reshape(())  # the 0-d step leaf
+    template = template_of(leaves)
+    cks = [
+        make_ckpt([l.copy() for l in newer], step=80),
+        make_ckpt([l.copy() for l in newer], step=80),
+        make_ckpt(leaves, step=79),  # stale member
+    ]
+    rs = run_world(
+        [
+            lambda f, r=rank: fab.fabric_restore(
+                f, template, cks[r], shard_bytes=1024
+            )
+            for rank in range(3)
+        ]
+    )
+    stale = rs[2]
+    assert stale.stats.step == 80
+    changed = newer[0].nbytes + newer[2].nbytes
+    total = sum(l.nbytes for l in leaves)
+    assert 0 < stale.stats.bytes_received <= changed + 2048
+    assert stale.stats.bytes_received < total // 2
+    for got, want in zip(stale.leaves, newer):
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(want.shape), want
+        )

@@ -577,11 +577,14 @@ def test_multipod_joiner_only_restore(tmp_path):
         rc = _read_resizes(hist["c"])
         # a started the job fresh.
         assert ra[0]["world_size"] == 1 and ra[0]["restore_source"] == "init"
-        # Joiners receive state by broadcast (b at world 2, c at world 3).
+        # Joiners receive state over the wire: b's 2-member world has
+        # one holder (the fabric routes to the single-source stream,
+        # "broadcast"); c's 3-member world has two holders, so the
+        # parallel shard fabric feeds it ("fabric").
         first_b = next(rz for rz in rb if rz["world_size"] == 2)
         assert first_b["restore_source"] == "broadcast", rb
         first_c = next(rz for rz in rc if rz["world_size"] == 3)
-        assert first_c["restore_source"] == "broadcast", rc
+        assert first_c["restore_source"] in ("broadcast", "fabric"), rc
         # The graceful scale-down (3 -> 2) moved NO state: survivors
         # restored locally from their own flushed checkpoint.
         down_a = [
@@ -951,7 +954,7 @@ def test_multipod_layout_fsdp_1_2_1(tmp_path):
             assert ev["replayed_steps"] == 0, f"replay on resize: {ev}"
         # Survivor restores locally (no cross-pod state motion).
         assert all(
-            ev["restore_source"] in ("local", "broadcast")
+            ev["restore_source"] in ("local", "broadcast", "fabric")
             for ev in resizes[1:]
         )
         down = [ev for ev in resizes if ev["world_size"] == 1][-1:]
@@ -961,6 +964,112 @@ def test_multipod_layout_fsdp_1_2_1(tmp_path):
         formations = _read_formations(hist["f1"])
         two_pod = [f for f in formations if f["world_size"] == 2]
         assert two_pod and all(f["devices"] == 4 for f in two_pod)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+
+
+def test_multipod_fabric_joiner_parallel_restore_no_full_sender(tmp_path):
+    """Sharded p2p checkpoint fabric (ROADMAP item 3): a joiner
+    restoring a dp x fsdp layout is fed by MULTIPLE peers in parallel
+    with NO single peer sending the full state — asserted from the
+    per-peer wire-byte accounting in the joiner's resize record, the
+    same proof style as PR 2's delta accounting.  Three 2-chip pods
+    run mnist with ``EDL_PARALLELISM=fsdp=2``; the world grows 1 -> 2
+    (one holder: the fabric deterministically routes to the PR 2
+    single-source stream) -> 3 (two holders: the parallel fabric)."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(
+        target_world=1,
+        max_world=3,
+        heartbeat_timeout=60.0,
+        legal_sizes=[1, 2, 3],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    names = ("p1", "p2", "p3")
+    hist = {w: tmp_path / f"{w}.jsonl" for w in names}
+    procs = []
+
+    def spawn(name, base_port):
+        return _spawn_worker(
+            procs, hist, name, base_port, caddr,
+            devices=2, gbs=12, entrypoint="mnist", parallelism="fsdp=2",
+            checkpoint_interval=50,
+            # Tiny shards so even mnist's state spreads over many
+            # owners (production default is 32MB).
+            extra_env={"EDL_FABRIC_SHARD_BYTES": "2048"},
+        )
+
+    try:
+        p1 = spawn("p1", 12700)
+        _wait_for(
+            lambda: len(_read_history(hist["p1"])) >= 3,
+            240, "p1 stepping at world 1", procs,
+        )
+        p2 = spawn("p2", 12760)
+        coord.set_target_world(2)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 2 for r in _read_history(hist["p2"])
+            ),
+            300, "the 2-pod world to step", procs,
+        )
+        p3 = spawn("p3", 12820)
+        coord.set_target_world(3)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 3 for r in _read_history(hist["p3"])
+            ),
+            300, "the 3-pod world to step", procs,
+        )
+        # Assert from the journals while the world is still up, then
+        # tear every pod down TOGETHER: sequential SIGTERMs would
+        # drive the survivors through a 3 -> 2 resize first (the shape
+        # the known jaxlib bad_cast issue lives in — see
+        # test_multipod_joiner_only_restore's gate).
+
+        # One holder at p2's join: the single-source stream.
+        first_2 = next(
+            rz
+            for rz in _read_resizes(hist["p2"])
+            if rz["world_size"] == 2
+        )
+        assert first_2["restore_source"] == "broadcast", first_2
+
+        # Two holders at p3's join: THE fabric claim.
+        first_3 = next(
+            rz
+            for rz in _read_resizes(hist["p3"])
+            if rz["world_size"] == 3
+        )
+        assert first_3["restore_source"] == "fabric", first_3
+        t = first_3["transfer"]
+        assert t["mode"] == "fabric", t
+        per_peer = t["per_peer_bytes"]
+        assert len(per_peer) >= 2, per_peer
+        assert sum(per_peer.values()) == t["bytes_received"], t
+        # NO single peer sent the full state.
+        assert max(per_peer.values()) < t["bytes_received"], per_peer
+        assert min(per_peer.values()) > 0, per_peer
+
+        # Step stream stays contiguous and finite on the first pod.
+        h1 = _read_history(hist["p1"])
+        steps_done = sorted(set(r["step"] for r in h1))
+        assert steps_done == list(range(steps_done[-1] + 1))
+        assert all(math.isfinite(r["loss"]) for r in h1)
+
+        for proc in (p3, p2, p1):
+            proc.send_signal(signal.SIGTERM)
+        for proc in (p3, p2, p1):
+            try:
+                proc.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                proc.kill()
     finally:
         for p in procs:
             if p.poll() is None:
@@ -1041,7 +1150,7 @@ def test_multipod_durable_checkpoint_survives_whole_world_loss(tmp_path):
         assert max(r["step"] for r in post) > last_before
         cold = _read_resizes(hist["d3"])[-1]
         assert cold["restored_step"] >= spilled[0] > 0, cold
-        assert cold["restore_source"] in ("local", "broadcast"), cold
+        assert cold["restore_source"] in ("local", "broadcast", "fabric"), cold
         assert all(math.isfinite(r["loss"]) for r in post)
     finally:
         for p in procs:

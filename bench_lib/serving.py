@@ -9,6 +9,14 @@ path (asserted at the backend_compile seam, same as warm resizes), a
 checkpoint hot-swap with zero failed/dropped requests (+ the swap
 pause), and a scale-up replica answering its FIRST request on a
 pre-warmed executable.
+
+The DECODE sweep (ISSUE 13) measures the KV-cached autoregressive
+path the same way: generate requests at 3 offered loads through the
+token-iteration batcher — tokens/s, time-to-first-token p50/p95,
+inter-token p95 — with steady-state decode asserted at ZERO XLA
+compiles (prefill + decode executables are AOT-held per bucket), and
+a hot swap under decode load completing with zero failed/dropped
+sequences.
 """
 
 from __future__ import annotations
@@ -231,4 +239,222 @@ def bench_serving() -> dict:
         "steady_state_xla_compiles": steady_compiles,
         "hot_swap": hot_swap,
         "scale_up": scale_up,
+        "decode": bench_decode(),
+    }
+
+
+def bench_decode() -> dict:
+    """KV-cached autoregressive decode through the token-iteration
+    batcher: generate requests at 3 offered loads (tokens/s, TTFT
+    p50/p95, inter-token p95), 0 steady-state compiles asserted, and a
+    hot swap under decode load with zero failed/dropped sequences."""
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import DecodeEngine, TokenContinuousBatcher
+    from edl_tpu.telemetry.aggregate import histogram_quantile
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = get_model("transformer_lm", tiny=not on_tpu)
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adam(1e-3)
+
+    def state_at(step: int, seed: int = 0) -> TrainState:
+        p = (
+            params
+            if seed == 0
+            else model.init_params(jax.random.key(seed))
+        )
+        return TrainState(
+            step=jnp.asarray(step, jnp.int32),
+            params=p,
+            opt_state=opt.init(p),
+        )
+
+    store = HostDRAMStore()
+    store.save_async(state_at(1))
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=8,
+        block_tokens=16,
+    )
+    engine.load()
+    engine.warm()
+
+    reg = telemetry.get_registry()
+    m_requests = reg.counter("edl_serve_requests_total")
+    m_tokens = reg.counter("edl_serve_tokens_total")
+    h_ttft = reg.histogram("edl_serve_ttft_seconds")
+    h_intertoken = reg.histogram("edl_serve_intertoken_seconds")
+    batcher = TokenContinuousBatcher(
+        engine, queue_limit=8192, default_deadline_s=120.0
+    ).start()
+
+    def _hist_delta(after, before):
+        if after is None:
+            return None
+        if before is None:
+            return after
+        return {
+            "buckets": list(after["buckets"]),
+            "counts": [
+                a - b for a, b in zip(after["counts"], before["counts"])
+            ],
+            "sum": after["sum"] - before["sum"],
+            "count": after["count"] - before["count"],
+        }
+
+    rng = np.random.RandomState(0)
+    corpus = model.synth_batch(rng, 64)["tokens"]
+    max_new = 8
+
+    import jax._src.compiler as _compiler
+
+    m_compiles = reg.counter("edl_xla_compiles_total")
+    compiles_before = m_compiles.value()
+    _real_bc = _compiler.backend_compile
+
+    def _counting_bc(*args, **kwargs):
+        m_compiles.inc()
+        return _real_bc(*args, **kwargs)
+
+    _compiler.backend_compile = _counting_bc
+    try:
+        # -- offered-load decode sweep (open-loop arrivals) --------------
+        sweep = []
+        for offered_rps in (8, 24, 48):
+            ttft0 = h_ttft.series()
+            it0 = h_intertoken.series()
+            tokens0 = m_tokens.value()
+            n_req = max(16, min(64, offered_rps * 2))
+
+            def submit(i):
+                plen = 5 + (i * 7) % 40
+                prompt = corpus[i % len(corpus)][:plen]
+                return batcher.submit_generate(
+                    {"tokens": prompt}, max_new_tokens=max_new
+                )
+
+            t0 = time.perf_counter()
+            tickets, lstats = run_open_loop(
+                submit, arrival_offsets(offered_rps, n_req)
+            )
+            for t in tickets:
+                t.result(timeout=240)
+            elapsed = time.perf_counter() - t0
+            ttft = _hist_delta(h_ttft.series(), ttft0)
+            inter = _hist_delta(h_intertoken.series(), it0)
+            emitted = m_tokens.value() - tokens0
+            tp50 = histogram_quantile(ttft, 0.5)
+            tp95 = histogram_quantile(ttft, 0.95)
+            ip95 = histogram_quantile(inter, 0.95)
+            sweep.append(
+                {
+                    "offered_rps": offered_rps,
+                    "achieved_rps": round(n_req / elapsed, 1),
+                    "tokens_per_s": round(emitted / elapsed, 1),
+                    "scheduler_lag_max_s": lstats["scheduler_lag_max_s"],
+                    "ttft_p50_ms": (
+                        round(tp50 * 1000, 3) if tp50 else None
+                    ),
+                    "ttft_p95_ms": (
+                        round(tp95 * 1000, 3) if tp95 else None
+                    ),
+                    "intertoken_p95_ms": (
+                        round(ip95 * 1000, 3) if ip95 else None
+                    ),
+                }
+            )
+
+        # -- hot swap under decode load ----------------------------------
+        err0 = (
+            m_requests.value(status="error")
+            + m_requests.value(status="expired")
+            + m_requests.value(status="rejected")
+        )
+        gen0 = engine.weights_generation
+        stop = threading.Event()
+        swap_tickets = []
+
+        def stream():
+            i = 0
+            while not stop.is_set():
+                plen = 5 + (i * 11) % 40
+                swap_tickets.append(
+                    batcher.submit_generate(
+                        {"tokens": corpus[i % len(corpus)][:plen]},
+                        max_new_tokens=16,
+                    )
+                )
+                i += 1
+                time.sleep(0.004)
+
+        th = threading.Thread(target=stream, daemon=True)
+        th.start()
+        time.sleep(0.1)
+        store.save_async(state_at(100, seed=7))
+        store.wait()
+        t_swap = time.perf_counter()
+        while engine.weights_generation == gen0:
+            if time.perf_counter() - t_swap > 30:
+                break
+            time.sleep(0.002)
+        swap_latency_s = time.perf_counter() - t_swap
+        time.sleep(0.1)
+        stop.set()
+        th.join(timeout=10)
+        results = [t.result(timeout=240) for t in swap_tickets]
+        failed = (
+            m_requests.value(status="error")
+            + m_requests.value(status="expired")
+            + m_requests.value(status="rejected")
+            - err0
+        )
+        restarted = sum(1 for _, meta in results if meta["restarts"])
+        hot_swap = {
+            "swapped": engine.weights_generation > gen0,
+            "to_step": engine.weights_step,
+            "swap_latency_ms": round(swap_latency_s * 1000, 3),
+            "sequences_during_swap": len(swap_tickets),
+            "completed": len(results),
+            "restarted_mid_generation": restarted,
+            "failed_or_dropped": int(failed),
+        }
+        assert hot_swap["swapped"], "decode hot swap never installed"
+        assert failed == 0, f"{failed} sequences failed/dropped in swap"
+
+        steady_compiles = int(m_compiles.value() - compiles_before)
+        assert steady_compiles == 0, (
+            f"{steady_compiles} XLA compiles on the steady decode path"
+        )
+    finally:
+        batcher.stop()
+        _compiler.backend_compile = _real_bc
+
+    return {
+        "model": model.name,
+        "max_seqs": engine.max_seqs,
+        "block_tokens": engine.block_tokens,
+        "prompt_buckets": list(engine.prompt_buckets),
+        "decode_buckets": list(engine.decode_buckets),
+        "max_new_tokens": max_new,
+        "sweep": sweep,
+        "tokens_per_s": sweep[-1]["tokens_per_s"],
+        "ttft_p95_ms": sweep[-1]["ttft_p95_ms"],
+        "intertoken_p95_ms": sweep[-1]["intertoken_p95_ms"],
+        "steady_state_xla_compiles": steady_compiles,
+        "hot_swap": hot_swap,
     }

@@ -14,11 +14,16 @@ from edl_tpu.autoscaler.algorithm import (
     scale_all_jobs_dry_run,
 )
 from edl_tpu.autoscaler.scaler import Autoscaler, ScalePlan
-from edl_tpu.autoscaler.serving import ServingLane, attach_serving_lane
+from edl_tpu.autoscaler.serving import (
+    ServingLane,
+    attach_serving_lane,
+    kube_replica_glue,
+)
 
 __all__ = [
     "ServingLane",
     "attach_serving_lane",
+    "kube_replica_glue",
     "JobView",
     "PendingDemand",
     "fulfillment",

@@ -342,6 +342,44 @@ def cmd_metrics(args) -> int:
         wstep = gauges_all.get("edl_serve_weights_step") or {}
         if wstep:
             print(f"  {'weights_step':<24} {max(wstep.values()):g}")
+        tok = counters_all.get("edl_serve_tokens_total") or {}
+        if tok:
+            # Decode stats (the token-iteration path): tokens/s is the
+            # decode-iteration cadence the fleet sustained — emitted
+            # tokens over the seconds the inter-token histogram
+            # accumulated (its count/sum), aggregated across replicas.
+            it_h = hists_all.get("edl_serve_intertoken_seconds") or {}
+            it_count = sum(h["count"] for h in it_h.values())
+            it_sum = sum(h["sum"] for h in it_h.values())
+            print(f"  {'tokens_total':<24} {sum(tok.values()):g}")
+            if it_sum > 0:
+                print(
+                    f"  {'decode_tokens_per_s':<24} "
+                    f"{it_count / it_sum:.1f}"
+                )
+            ttft = hists_all.get("edl_serve_ttft_seconds")
+            for q, tag in ((0.5, "ttft_p50"), (0.95, "ttft_p95")):
+                v = histogram_quantile(ttft, q) if ttft else None
+                print(
+                    f"  {tag:<24} "
+                    f"{f'{v * 1000:.1f} ms' if v is not None else 'n/a'}"
+                )
+            it95 = (
+                histogram_quantile(
+                    hists_all.get("edl_serve_intertoken_seconds"), 0.95
+                )
+                if it_h
+                else None
+            )
+            print(
+                f"  {'intertoken_p95':<24} "
+                f"{f'{it95 * 1000:.2f} ms' if it95 is not None else 'n/a'}"
+            )
+            kv = gauges_all.get("edl_serve_kv_occupancy") or {}
+            if kv:
+                print(
+                    f"  {'kv_slot_occupancy':<24} {max(kv.values()):.3f}"
+                )
         req = counters_all.get("edl_serve_requests_total") or {}
         for key in sorted(req):
             print(f"  requests{{{key}}}{'':<10} {req[key]:g}")

@@ -240,6 +240,49 @@ class Cluster:
             ) from e.last_error
         return result is not missing
 
+    def update_serving_replicas(self, job: TrainingJob, replicas: int) -> bool:
+        """Set the serving replica Deployment's replica count (the
+        ``ServingLane`` retarget's kube half: the coordinator target
+        moves the serving WORLD, this moves the pods that fill it).
+        Same optimistic-concurrency discipline as
+        ``update_parallelism`` — bounded ``conflict_retry`` attempts,
+        typed ``ParallelismUpdateError`` on exhaustion so the lane's
+        tick can log-and-skip.  Returns False when the job renders no
+        serving fleet (``spec.serving`` unset) or the Deployment does
+        not exist."""
+        from edl_tpu.cluster.kube import ConflictError
+
+        if job.spec.serving is None:
+            return False
+        name = job.serving_name()
+        missing = object()
+
+        def put():
+            w = self.kube.get_workload(name, kind="Deployment")
+            if w is None:
+                return missing
+            w.parallelism = replicas
+            self.kube.update_workload(w)
+            return True
+
+        import zlib
+
+        try:
+            result = self.conflict_retry.run(
+                put,
+                retryable=lambda e: isinstance(e, ConflictError),
+                seed=zlib.crc32(name.encode()),
+                describe=f"serving replicas PUT for {job.name}",
+            )
+        except GiveUpError as e:
+            raise ParallelismUpdateError(
+                f"serving replicas PUT for {job.name} -> {replicas} gave "
+                f"up after {e.attempts} conflict(s)",
+                last_error=e.last_error,
+                attempts=e.attempts,
+            ) from e.last_error
+        return result is not missing
+
     # -- pod counting (ref JobPods) -----------------------------------------
     def job_pods(self, job: TrainingJob) -> Tuple[int, int, int, int]:
         """(total, running, pending, succeeded) over the job's

@@ -186,10 +186,15 @@ def main(argv: List[str]) -> int:
                     continue
                 items.append(m)
             print(json.dumps({"items": items}))
-        elif kind == "job":
+        elif kind in ("job", "deployment"):
             w = kube.get_workload(args[2])
-            if w is None:
-                print(f'Error from server (NotFound): jobs "{args[2]}" not found', file=sys.stderr)
+            want = "Deployment" if kind == "deployment" else "Job"
+            if w is None or w.kind != want:
+                print(
+                    f'Error from server (NotFound): {kind}s "{args[2]}" '
+                    "not found",
+                    file=sys.stderr,
+                )
                 return 1
             print(json.dumps(_job_manifest(w)))
         else:
@@ -241,19 +246,28 @@ def main(argv: List[str]) -> int:
             return 1
         w = kube.get_workload(name)
         if w is None:
-            print(f'Error from server (NotFound): jobs "{name}" not found', file=sys.stderr)
+            print(
+                f'Error from server (NotFound): {args[1]}s "{name}" not '
+                "found",
+                file=sys.stderr,
+            )
             return 1
         rv = patch.get("metadata", {}).get("resourceVersion")
         if rv is not None:
             w.resource_version = int(rv)
-        w.parallelism = patch.get("spec", {}).get("parallelism", w.parallelism)
+        spec = patch.get("spec", {})
+        # Jobs scale through spec.parallelism, Deployments (the serving
+        # replica fleet) through spec.replicas — one knob either way.
+        w.parallelism = spec.get(
+            "replicas", spec.get("parallelism", w.parallelism)
+        )
         try:
             kube.update_workload(w)
         except ConflictError as e:
             print(f"Error from server (Conflict): {e}", file=sys.stderr)
             return 1
         _save(kube, raw)
-        print(f"job/{name} patched")
+        print(f"{args[1]}/{name} patched")
         return 0
 
     if verb == "delete":

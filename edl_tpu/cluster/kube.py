@@ -135,7 +135,12 @@ class KubeAPI:
         raise NotImplementedError
 
     # trainer workload CRUD (ref pkg/cluster.go:91-113, 245-291)
-    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+    def get_workload(
+        self, name: str, kind: str = "Job"
+    ) -> Optional[WorkloadInfo]:
+        """Fetch one workload by name.  ``kind`` routes the lookup on
+        backends whose API is kind-scoped (kubectl); name-keyed
+        backends (FakeKube) may ignore it."""
         raise NotImplementedError
 
     def list_workloads(self) -> List[WorkloadInfo]:
@@ -219,9 +224,11 @@ class FakeKube(KubeAPI):
             return [PodInfo(**vars(p)) for p in self.pods.values()]
 
     # -- workload CRUD ------------------------------------------------------
-    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+    def get_workload(
+        self, name: str, kind: str = "Job"
+    ) -> Optional[WorkloadInfo]:
         with self._lock:
-            w = self.workloads.get(name)
+            w = self.workloads.get(name)  # name-keyed; kind advisory
             return WorkloadInfo(**vars(w)) if w else None
 
     def list_workloads(self) -> List[WorkloadInfo]:
@@ -477,9 +484,11 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
             )
         return pods
 
-    def get_workload(self, name: str) -> Optional[WorkloadInfo]:
+    def get_workload(
+        self, name: str, kind: str = "Job"
+    ) -> Optional[WorkloadInfo]:
         try:
-            it = self._run("get", "job", name)
+            it = self._run("get", kind.lower(), name)
         except subprocess.CalledProcessError:
             return None
         spec = it["spec"]
@@ -496,12 +505,14 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
         return WorkloadInfo(
             name=name,
             job_name=labels.get("edl-job", name),
-            parallelism=spec.get("parallelism", 0),
+            parallelism=spec.get(
+                "parallelism", spec.get("replicas", 0)
+            ),
             cpu_request_milli=parse_cpu_milli(req.get("cpu", 0)),
             memory_request_mega=parse_memory_mega(req.get("memory", 0)),
             tpu_limit=parse_count(lim.get("google.com/tpu", 0)),
             resource_version=int(it["metadata"]["resourceVersion"]),
-            kind=it.get("kind", "Job"),
+            kind=it.get("kind", kind),
             owner=labels.get("edl-job", labels.get("edl-owner", "")),
         )
 
@@ -541,10 +552,13 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
         # Include resourceVersion in the merge patch so the API server
         # enforces the optimistic-concurrency precondition; a 409 maps to
         # ConflictError so Cluster.update_parallelism's retry loop works
-        # identically against FakeKube and a real cluster.
+        # identically against FakeKube and a real cluster.  The knob
+        # follows the kind: batch Jobs scale through spec.parallelism,
+        # Deployments (the serving replica fleet) through spec.replicas.
+        knob = "replicas" if w.kind == "Deployment" else "parallelism"
         patch = {
             "metadata": {"resourceVersion": str(w.resource_version)},
-            "spec": {"parallelism": w.parallelism},
+            "spec": {knob: w.parallelism},
         }
         r = subprocess.run(
             [
@@ -552,7 +566,7 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
                 "-n",
                 self.namespace,
                 "patch",
-                "job",
+                w.kind.lower(),
                 w.name,
                 "--type=merge",
                 "-p",
@@ -566,7 +580,7 @@ class KubectlAPI(KubeAPI):  # pragma: no cover - needs a real cluster
             if "Conflict" in msg or "the object has been modified" in msg:
                 raise ConflictError(msg.strip())
             raise RuntimeError(f"kubectl patch failed: {msg.strip()}")
-        return self.get_workload(w.name)
+        return self.get_workload(w.name, kind=w.kind)
 
     def update_training_job_status(
         self, name: str, status: dict, namespace: Optional[str] = None

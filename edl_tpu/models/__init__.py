@@ -5,6 +5,7 @@ Importing this package registers all built-in models with
 """
 
 from edl_tpu.models.base import (
+    DecodeSpec,
     ModelDef,
     bind_model,
     get_model,
@@ -23,6 +24,7 @@ import edl_tpu.models.moe  # noqa: F401
 import edl_tpu.models.pipeline_lm  # noqa: F401
 
 __all__ = [
+    "DecodeSpec",
     "ModelDef",
     "bind_model",
     "get_model",

@@ -23,6 +23,44 @@ Params = Any
 
 
 @dataclass(frozen=True)
+class DecodeSpec:
+    """A model family's incremental-decode contract (ISSUE 13): the
+    two executables the serving engine AOT-warms per padded bucket,
+    plus the cache geometry it allocates the paged KV pool from.
+
+    Both functions are pure and jit-traceable, with the cache as
+    EXPLICIT carried state (pools in, pools out — never flax mutable
+    collections), so the engine can donate the pool buffers and hold
+    the compiled executables:
+
+    - ``prefill_fn(params, tokens[B,P], lengths[B], kpool, vpool,
+      tables[B,mb]) -> (ids[B], kpool', vpool')`` — run the prompt
+      through the normal causal forward once, scatter every layer's
+      K/V into the pool blocks, return the greedy next token read at
+      each row's last real position (``lengths - 1``).  ``P`` is a
+      block-aligned padded bucket; positions past ``lengths`` hold
+      garbage K/V that later decode writes overwrite and masks never
+      expose.
+    - ``decode_fn(params, tokens[B], lengths[B], kpool, vpool,
+      tables) -> (ids[B], kpool', vpool')`` — one token of compute:
+      write the token's K/V at position ``lengths[i]``, attend over
+      the cache through the block table, return the next greedy id.
+
+    Pools are ``[layers, num_blocks, block_tokens, heads, head_dim]``
+    of ``cache_dtype``; ``max_len`` bounds prompt + generated length
+    (the positional-table range).
+    """
+
+    layers: int
+    heads: int
+    head_dim: int
+    max_len: int
+    cache_dtype: Any
+    prefill_fn: Callable[..., Tuple[Any, Any, Any]]
+    decode_fn: Callable[..., Tuple[Any, Any, Any]]
+
+
+@dataclass(frozen=True)
 class ModelDef:
     """A trainable model as pure functions.
 
@@ -57,6 +95,10 @@ class ModelDef:
     #: batch keys ``predict_fn`` consumes (the serving request schema;
     #: a strict subset of ``synth_batch``'s keys)
     predict_inputs: Tuple[str, ...] = ()
+    #: incremental-decode contract (KV-cached prefill/decode pair) for
+    #: autoregressive serving; None = the family only serves single-
+    #: shot forwards through ``predict_fn``
+    decode: Optional[DecodeSpec] = None
 
 
 def divisor_at_most(n: int, want: int) -> int:

@@ -1,0 +1,186 @@
+"""Paged KV-cache primitives for incremental (autoregressive) decode.
+
+The serving decode path (ISSUE 13 / ROADMAP item 1) splits generation
+into two jit-carried-state phases:
+
+- **prefill**: the prompt runs through the normal causal forward ONCE,
+  and every layer's K/V projections are written into a block-paged
+  cache pool — so the quadratic prefix recompute happens exactly once
+  per sequence.
+- **decode**: each subsequent token is ONE position of compute — the
+  query attends over the cached K/V gathered through the sequence's
+  block table, and the new token's K/V is scattered into the pool at
+  its position.
+
+The cache is EXPLICIT state (pool arrays passed in and returned, never
+flax mutable collections): the serving engine AOT-lowers prefill and
+decode executables from abstract shapes with the pools donated, so
+steady-state decode re-uses the pool buffers in place and performs
+zero XLA compiles (the ``InferenceEngine.warm`` discipline).
+
+Paging (the Orca/vLLM recipe, host-managed): the pool is
+``[layers, num_blocks, block_tokens, heads, head_dim]``; a sequence
+owns an ordered list of fixed-size blocks recorded in a per-sequence
+**block table** ``[max_blocks]`` of physical block ids.  Logical
+position ``p`` lives at ``(table[p // block_tokens], p % block_tokens)``.
+Block 0 is the TRASH block: padding rows of a decode batch (and
+unallocated table tails) point at it, so their writes land somewhere
+harmless and their gathers stay in range.  The free list itself lives
+host-side in the serving engine (``serving.engine.KVBlockPool``) —
+device code only ever sees tables.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: physical block id every padding row / unallocated table slot points
+#: at.  Real sequences never own block 0.
+TRASH_BLOCK = 0
+
+
+def write_prefill_kv(kpool_l, vpool_l, tables, k, v):
+    """Scatter a prompt's per-layer K/V ``[B, P, H, D]`` into the pool
+    at the sequences' first ``P // block_tokens`` blocks.  ``P`` must
+    be a multiple of the pool's block_tokens (the engine pads prompts
+    to block-aligned buckets).  Returns the updated pools."""
+    nb, bt, h, d = kpool_l.shape
+    b, p = k.shape[0], k.shape[1]
+    nblk = p // bt
+    blocks = tables[:, :nblk]  # [B, nblk]
+    k_b = k.reshape(b, nblk, bt, h, d)
+    v_b = v.reshape(b, nblk, bt, h, d)
+    return (
+        kpool_l.at[blocks].set(k_b.astype(kpool_l.dtype)),
+        vpool_l.at[blocks].set(v_b.astype(vpool_l.dtype)),
+    )
+
+
+def write_decode_kv(kpool_l, vpool_l, tables, lengths, k, v):
+    """Scatter one new token's K/V ``[B, H, D]`` at each row's current
+    position ``lengths[i]`` through its block table.  Padding rows
+    (table full of TRASH_BLOCK, length 0) write into the trash block.
+    Returns the updated pools."""
+    nb, bt, h, d = kpool_l.shape
+    blocks = jnp.take_along_axis(
+        tables, (lengths // bt)[:, None], axis=1
+    )[:, 0]  # [B]
+    offs = lengths % bt
+    return (
+        kpool_l.at[blocks, offs].set(k.astype(kpool_l.dtype)),
+        vpool_l.at[blocks, offs].set(v.astype(vpool_l.dtype)),
+    )
+
+
+def paged_decode_attention(q, kpool_l, vpool_l, tables, lengths):
+    """One-token attention over the paged cache.
+
+    ``q``: [B, H, D] (the new token's query, already written to the
+    pool along with its K/V — it attends to itself).  Gathers each
+    row's cache ``[max_blocks * block_tokens, H, D]`` through its block
+    table, masks positions ``> lengths[i]`` (the new token sits AT
+    ``lengths[i]``), and returns [B, H, D] in f32.
+    """
+    nb, bt, h, d = kpool_l.shape
+    k_g = kpool_l[tables]  # [B, mb, bt, H, D]
+    v_g = vpool_l[tables]
+    b, mb = tables.shape
+    m = mb * bt
+    k_g = k_g.reshape(b, m, h, d)
+    v_g = v_g.reshape(b, m, h, d)
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum(
+        "bhd,bkhd->bhk",
+        q.astype(jnp.float32),
+        k_g.astype(jnp.float32),
+    ) * scale
+    mask = jnp.arange(m)[None, :] <= lengths[:, None]  # [B, m]
+    scores = jnp.where(mask[:, None, :], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhk,bkhd->bhd", w, v_g.astype(jnp.float32))
+
+
+def cache_abstract(
+    layers: int,
+    num_blocks: int,
+    block_tokens: int,
+    heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> Tuple[jax.ShapeDtypeStruct, jax.ShapeDtypeStruct]:
+    """Abstract (k, v) pool shapes — what the engine's AOT warmer
+    lowers the decode executables from (zero device allocation)."""
+    shape = (layers, num_blocks, block_tokens, heads, head_dim)
+    return (
+        jax.ShapeDtypeStruct(shape, dtype),
+        jax.ShapeDtypeStruct(shape, dtype),
+    )
+
+
+class LayerKV:
+    """Per-layer cache view threaded through a model's attention
+    modules.  ``prefill`` switches the two phases (a STATIC flag: the
+    engine compiles prefill and decode as separate executables).
+
+    Attention modules call exactly two hooks:
+
+    - ``write(k, v)`` — scatter this layer's new K/V; returns the
+      updated (kpool_l, vpool_l) which the module must thread back out.
+    - ``attend(q, kpool_l, vpool_l)`` — decode-phase paged attention
+      ([B, 1, H, D] query -> [B, 1, H, D] f32); prefill-phase attention
+      stays the module's own causal path (the math the train step
+      uses).
+    """
+
+    def __init__(self, kpool_l, vpool_l, tables, lengths, prefill: bool):
+        self.kpool_l = kpool_l
+        self.vpool_l = vpool_l
+        self.tables = tables
+        self.lengths = lengths
+        self.prefill = prefill
+
+    def write(self, k, v):
+        """k, v: [B, P, H, D] (prefill) or [B, 1, H, D] (decode)."""
+        if self.prefill:
+            return write_prefill_kv(
+                self.kpool_l, self.vpool_l, self.tables, k, v
+            )
+        return write_decode_kv(
+            self.kpool_l,
+            self.vpool_l,
+            self.tables,
+            self.lengths,
+            k[:, 0],
+            v[:, 0],
+        )
+
+    def attend(self, q, kpool_l, vpool_l):
+        """Decode-phase paged attention (q: [B, 1, H, D])."""
+        out = paged_decode_attention(
+            q[:, 0], kpool_l, vpool_l, self.tables, self.lengths
+        )
+        return out[:, None]
+
+
+def greedy_from_features(features, embedding, positions=None):
+    """Tied-vocab greedy ids from pre-projection features.
+
+    ``features``: [B, T, D]; ``embedding``: [V, D].  When ``positions``
+    ([B] int32) is given, only that one position's logits are computed
+    (the prefill's next-token read); otherwise T == 1 (decode).
+    Returns [B] int32 ids.
+    """
+    if positions is not None:
+        features = jnp.take_along_axis(
+            features, positions[:, None, None], axis=1
+        )
+    logits = jnp.einsum(
+        "btd,vd->btv",
+        features.astype(jnp.bfloat16),
+        embedding.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+    return jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)

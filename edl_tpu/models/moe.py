@@ -185,21 +185,35 @@ class MoEBlock(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + CausalSelfAttention(
+        attn = CausalSelfAttention(
             self.num_heads, self.d_model, self.sp_mesh, self.dtype, name="attn"
-        )(h)
+        )
+        if kv is not None:
+            a, pools = attn(h, kv=kv)
+            x = x + a
+        else:
+            x = x + attn(h)
+            pools = None
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        return x + MoEMlp(
+        # Decode routes PER TOKEN (group 1): capacity grouping couples
+        # tokens within a group, and a decode batch groups UNRELATED
+        # sequences — per-token routing keeps each sequence's output a
+        # pure function of its own history (and capacity never binds:
+        # cap = max(1, 1.25/E) = 1 with position always 0).  The group
+        # width is routing-only (no params), so the swap is free.
+        group = 1 if (kv is not None and not kv.prefill) else self.group
+        out = x + MoEMlp(
             self.d_model,
             self.d_ff,
             self.num_experts,
-            group=self.group,
+            group=group,
             ep_mesh=self.ep_mesh,
             dtype=self.dtype,
             name="moe",
         )(h)
+        return out if kv is None else (out, pools)
 
 
 class MoELM(nn.Module):
@@ -216,7 +230,9 @@ class MoELM(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, kv=None):
+        from edl_tpu.models.decode import LayerKV
+
         embed = nn.Embed(
             self.vocab_size,
             self.d_model,
@@ -228,6 +244,36 @@ class MoELM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
         )
+        if kv is not None:
+            # Incremental decode (see TransformerLM.__call__): cache
+            # tuple threaded per layer, features + pools returned.
+            kpool, vpool, tables, lengths, prefill = kv
+            if prefill:
+                T = tokens.shape[1]
+                x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
+            else:
+                x = (
+                    embed(tokens[:, None]) + pos[lengths][:, None]
+                ).astype(self.dtype)
+            for i in range(self.num_layers):
+                layer_kv = LayerKV(
+                    kpool[i], vpool[i], tables, lengths, prefill
+                )
+                x, (kl, vl) = MoEBlock(
+                    self.num_heads,
+                    self.d_model,
+                    self.d_ff,
+                    self.num_experts,
+                    group=self.group,
+                    sp_mesh=self.sp_mesh,
+                    ep_mesh=self.ep_mesh,
+                    dtype=self.dtype,
+                    name=f"layer_{i}",
+                )(x, kv=layer_kv)
+                kpool = kpool.at[i].set(kl)
+                vpool = vpool.at[i].set(vl)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+            return x, kpool, vpool
         T = tokens.shape[1]
         x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
         for i in range(self.num_layers):
@@ -391,6 +437,8 @@ def moe_lm(
         * L
         + 12 * layers * L * L * d_model // 2
     )
+    from edl_tpu.models.transformer_lm import lm_decode_spec
+
     return ModelDef(
         name="moe_lm",
         init_params=init_params,
@@ -401,4 +449,5 @@ def moe_lm(
         tokens_per_example=L,
         predict_fn=predict_fn,
         predict_inputs=("tokens",),
+        decode=lm_decode_spec(module, heads, d_model, L),
     )

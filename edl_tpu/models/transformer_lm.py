@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from edl_tpu.models.base import ModelDef, register_model
+from edl_tpu.models.base import DecodeSpec, ModelDef, register_model
 from edl_tpu.ops import fused_attention, ring_attention
 
 
@@ -33,7 +33,7 @@ class CausalSelfAttention(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None):
         head_dim = self.d_model // self.num_heads
         qkv = nn.DenseGeneral(
             features=(3, self.num_heads, head_dim),
@@ -42,6 +42,23 @@ class CausalSelfAttention(nn.Module):
             name="qkv",
         )(x)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,H,D]
+        if kv is not None:
+            # Incremental-decode path (models/decode.py): this layer's
+            # K/V scatter into the paged pool.  Prefill keeps the
+            # module's own causal attention (the training math over
+            # the prompt); decode attends over the gathered cache.
+            kp, vp = kv.write(k, v)
+            if kv.prefill:
+                out = fused_attention(q, k, v, causal=True)
+            else:
+                out = kv.attend(q, kp, vp)
+            proj = nn.DenseGeneral(
+                features=self.d_model,
+                axis=(-2, -1),
+                dtype=self.dtype,
+                name="out",
+            )(out.astype(self.dtype))
+            return proj, (kp, vp)
         if self.sp_mesh is not None:
             out = ring_attention(q, k, v, self.sp_mesh, axis="sp", causal=True)
         else:
@@ -62,15 +79,22 @@ class LMBlock(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv=None):
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + CausalSelfAttention(
+        attn = CausalSelfAttention(
             self.num_heads, self.d_model, self.sp_mesh, self.dtype, name="attn"
-        )(h)
+        )
+        if kv is not None:
+            a, pools = attn(h, kv=kv)
+            x = x + a
+        else:
+            x = x + attn(h)
+            pools = None
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(self.d_ff, dtype=self.dtype, name="wi")(h)
         h = nn.gelu(h)
-        return x + nn.Dense(self.d_model, dtype=self.dtype, name="wo")(h)
+        out = x + nn.Dense(self.d_model, dtype=self.dtype, name="wo")(h)
+        return out if kv is None else (out, pools)
 
 
 class TransformerLM(nn.Module):
@@ -84,11 +108,20 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, tokens, return_features: bool = False):
+    def __call__(self, tokens, return_features: bool = False, kv=None):
         """tokens: [B, T] int32.  Returns [B, T, V] logits, or the
         pre-projection [B, T, D] features when ``return_features``
-        (the chunked-loss path, ``ops/losses.tied_vocab_xent``)."""
-        T = tokens.shape[1]
+        (the chunked-loss path, ``ops/losses.tied_vocab_xent``).
+
+        ``kv`` (incremental decode): ``(kpool, vpool, tables, lengths,
+        prefill)`` — pools [L, nb, bt, H, D], per-row block tables and
+        lengths (models/decode.py).  Prefill runs the normal causal
+        forward over the prompt while scattering every layer's K/V
+        into the pool; decode takes ``tokens`` [B] (ONE token per row,
+        embedded at position ``lengths[i]``) and attends through the
+        block table.  Returns (features, kpool', vpool')."""
+        from edl_tpu.models.decode import LayerKV
+
         embed = nn.Embed(
             self.vocab_size,
             self.d_model,
@@ -100,6 +133,32 @@ class TransformerLM(nn.Module):
             nn.initializers.normal(0.02),
             (self.max_len, self.d_model),
         )
+        if kv is not None:
+            kpool, vpool, tables, lengths, prefill = kv
+            if prefill:
+                T = tokens.shape[1]
+                x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
+            else:
+                x = (
+                    embed(tokens[:, None]) + pos[lengths][:, None]
+                ).astype(self.dtype)
+            for i in range(self.num_layers):
+                layer_kv = LayerKV(
+                    kpool[i], vpool[i], tables, lengths, prefill
+                )
+                x, (kl, vl) = LMBlock(
+                    self.num_heads,
+                    self.d_model,
+                    self.d_ff,
+                    self.sp_mesh,
+                    self.dtype,
+                    name=f"layer_{i}",
+                )(x, kv=layer_kv)
+                kpool = kpool.at[i].set(kl)
+                vpool = vpool.at[i].set(vl)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+            return x, kpool, vpool
+        T = tokens.shape[1]
         x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
         for i in range(self.num_layers):
             x = LMBlock(
@@ -244,4 +303,76 @@ def transformer_lm(
         tokens_per_example=L,
         predict_fn=predict_fn,
         predict_inputs=("tokens",),
+        decode=lm_decode_spec(module, heads, d_model, L),
     )
+
+
+def lm_decode_spec(module, heads: int, d_model: int, L: int) -> DecodeSpec:
+    """KV-cached prefill/decode pair for a module whose ``__call__``
+    threads the ``kv`` cache tuple (TransformerLM / MoELM — shared so
+    the families cannot drift).  ``drop_intermediates``: pass-through
+    for MoE modules that sow router diagnostics (discarded — serving
+    reads tokens, not load-balance telemetry)."""
+    from edl_tpu.models.decode import greedy_from_features
+
+    sows = getattr(module, "num_experts", None) is not None
+
+    def _apply(params, tokens, kv):
+        if sows:
+            out, _ = module.apply(
+                {"params": params},
+                tokens,
+                kv=kv,
+                mutable=["intermediates"],
+            )
+            return out
+        return module.apply({"params": params}, tokens, kv=kv)
+
+    def prefill_fn(params, tokens, lengths, kpool, vpool, tables):
+        feats, kp, vp = _apply(
+            params, tokens, (kpool, vpool, tables, lengths, True)
+        )
+        last = jnp.clip(lengths - 1, 0, tokens.shape[1] - 1)
+        ids = greedy_from_features(
+            feats, params["embed"]["embedding"], positions=last
+        )
+        return ids, kp, vp
+
+    def decode_fn(params, tokens, lengths, kpool, vpool, tables):
+        feats, kp, vp = _apply(
+            params, tokens, (kpool, vpool, tables, lengths, False)
+        )
+        ids = greedy_from_features(feats, params["embed"]["embedding"])
+        return ids, kp, vp
+
+    return DecodeSpec(
+        layers=module.num_layers,
+        heads=heads,
+        head_dim=d_model // heads,
+        max_len=L,
+        cache_dtype=module.dtype,
+        prefill_fn=prefill_fn,
+        decode_fn=decode_fn,
+    )
+
+
+@register_model("longcontext_lm")
+def longcontext_lm(
+    tiny: bool = False,
+    seq_len: Optional[int] = None,
+    sp_mesh: Optional[Mesh] = None,
+) -> ModelDef:
+    """The long-context workload as a first-class registry entry: the
+    same decoder-only family at the flash-attention context lengths
+    ``bench_longcontext_lm`` measures (4k default; ring attention when
+    an ``sp_mesh`` is bound).  Registered separately so serving specs
+    and the decode path can name it without smuggling ``seq_len``
+    overrides through every layer."""
+    import dataclasses
+
+    base = transformer_lm(
+        tiny=tiny,
+        seq_len=seq_len or (128 if tiny else 4096),
+        sp_mesh=sp_mesh,
+    )
+    return dataclasses.replace(base, name="longcontext_lm")

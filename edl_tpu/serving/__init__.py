@@ -10,25 +10,45 @@ OSDI '22), and ``ServingServer``/``ServingReplica`` put an HTTP front
 on it and register it into a serving world the autoscaler's
 ``ServingLane`` (edl_tpu.autoscaler.serving) scales on p95 latency and
 queue depth.
+
+Generative (autoregressive) traffic runs the TRUE-Orca path: a
+``DecodeEngine`` holds separate AOT-warmed prefill/decode executables
+over a paged KV cache (``KVBlockPool`` — fixed-size blocks, host-side
+free list), and a ``TokenContinuousBatcher`` schedules per-TOKEN
+iterations: requests join/leave the running batch at token
+boundaries, finished sequences release their blocks the same
+iteration they emit EOS, and a checkpoint hot swap re-prefills
+in-flight sequences so no sequence ever mixes weight generations.
 """
 
 from edl_tpu.serving.batcher import (
     ContinuousBatcher,
     DeadlineExceededError,
+    GenerateTicket,
     QueueFullError,
     Ticket,
+    TokenContinuousBatcher,
 )
-from edl_tpu.serving.engine import InferenceEngine, NotReadyError
+from edl_tpu.serving.engine import (
+    DecodeEngine,
+    InferenceEngine,
+    KVBlockPool,
+    NotReadyError,
+)
 from edl_tpu.serving.server import ServingReplica, ServingServer, serve_run
 
 __all__ = [
     "ContinuousBatcher",
     "DeadlineExceededError",
+    "DecodeEngine",
+    "GenerateTicket",
     "InferenceEngine",
+    "KVBlockPool",
     "NotReadyError",
     "QueueFullError",
     "ServingReplica",
     "ServingServer",
     "Ticket",
+    "TokenContinuousBatcher",
     "serve_run",
 ]

@@ -7,10 +7,13 @@ is queued the moment the previous micro-batch retires (up to the
 engine's largest bucket), so a request arriving mid-computation joins
 the *next* dispatch instead of waiting out a fixed batching window —
 the compute time itself is the batching window, and occupancy rises
-with load instead of being configured.  (Our unit of continuity is the
-request/forward pass, not Orca's per-token iteration: the model zoo's
-forwards are single-shot, so "iteration-level" and "request-level"
-coincide.)
+with load instead of being configured.  For single-shot forwards
+(mnist, resnet, /predict on the LM families) the request IS the
+iteration, so ``ContinuousBatcher`` schedules requests; generative
+traffic runs ``TokenContinuousBatcher`` below — Orca's actual
+per-TOKEN iteration scheduling over a ``DecodeEngine``'s paged KV
+cache, where requests join and leave the running batch at token
+boundaries.
 
 Admission is where backpressure lives: a full queue rejects
 immediately with a retry-after hint (the HTTP front maps it to 429)
@@ -34,6 +37,8 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
+
+from edl_tpu.serving.engine import NotReadyError
 
 
 class QueueFullError(RuntimeError):
@@ -276,3 +281,486 @@ def jax_tree_slice(outputs: Dict[str, np.ndarray], lo: int, hi: int):
     """Row-slice every output array (outputs are host numpy by the time
     the batcher splits them back per request)."""
     return {k: v[lo:hi] for k, v in outputs.items()}
+
+
+# -- per-token continuous batching (the true-Orca path) ----------------------
+
+#: GenerateTicket lifecycle states
+_QUEUED, _DECODING, _DONE = "queued", "decoding", "done"
+
+
+class GenerateTicket:
+    """One admitted generate request: the prompt, its budget, and the
+    future its caller blocks on.  ``on_event`` (optional) streams
+    incremental events as the worker emits them:
+
+    - ``{"token": id, "i": n}``     — one generated token
+    - ``{"restart": True, ...}``    — a hot swap voided prior tokens
+      (the sequence re-prefills against the new weights; previously
+      streamed tokens are not part of the final output)
+    - ``{"done": True, "tokens": [...], ...meta}`` / ``{"error": ...}``
+    """
+
+    __slots__ = (
+        "prompt", "max_new", "deadline", "eos_id", "enqueued", "on_event",
+        "state", "blocks", "table", "length", "last_token", "tokens",
+        "restarts", "last_time", "_done", "_result", "_error",
+    )
+
+    def __init__(
+        self,
+        prompt: np.ndarray,
+        max_new: int,
+        deadline: float,
+        eos_id: Optional[int],
+        on_event=None,
+    ):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline
+        self.eos_id = eos_id
+        self.enqueued = time.monotonic()
+        self.on_event = on_event
+        self.state = _QUEUED
+        #: owned physical block ids (freed the iteration we finish)
+        self.blocks: List[int] = []
+        self.table: Optional[np.ndarray] = None
+        #: written cache positions (prompt + generated so far)
+        self.length = 0
+        self.last_token = 0
+        self.tokens: List[int] = []
+        self.restarts = 0
+        self.last_time = 0.0
+        self._done = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def _event(self, ev: dict) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(ev)
+            except Exception:
+                self.on_event = None  # a dead stream must not kill the worker
+
+    def _finish(self, meta: Dict[str, Any]) -> None:
+        self.state = _DONE
+        self._result = (list(self.tokens), meta)
+        self._event({"done": True, "tokens": list(self.tokens), **meta})
+        self._done.set()
+
+    def _reject(self, err: BaseException) -> None:
+        self.state = _DONE
+        self._error = err
+        self._event({"error": str(err)})
+        self._done.set()
+
+    def result(self, timeout: Optional[float] = None) -> tuple:
+        """Block for (tokens, meta); raises the worker's error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in flight")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class TokenContinuousBatcher:
+    """Per-TOKEN iteration scheduling over a ``DecodeEngine`` (Orca's
+    actual continuity unit, closing PAPERS.md's request-level caveat).
+
+    Each worker iteration:
+
+    1. **swap check** — at the token boundary only.  A newer verified
+       checkpoint re-prefills every in-flight sequence against the new
+       weights (their partial output is VOID, streamed as a restart
+       event): one sequence never mixes weight generations, and the
+       generation a finished sequence reports produced every one of
+       its tokens.
+    2. **join** — queued requests are admitted while decode slots and
+       KV blocks last; each pays its own bucketed prefill and emits
+       its first token (the TTFT moment).
+    3. **decode** — ONE token of compute for every active sequence
+       (bucketed by count; block tables absorb ragged lengths).
+       Finished sequences (EOS / token budget / context cap / past
+       deadline) resolve and release their KV blocks the SAME
+       iteration.
+
+    Admission semantics carry over from the single-shot batcher
+    unchanged: bounded queue -> ``QueueFullError`` (HTTP 429 +
+    Retry-After), queued-dead requests expire instead of computing.
+    """
+
+    def __init__(
+        self,
+        engine,
+        queue_limit: int = 256,
+        default_deadline_s: float = 30.0,
+        default_max_new: int = 16,
+        refresh: bool = True,
+        chaos=None,
+    ):
+        self.engine = engine
+        self.queue_limit = int(queue_limit)
+        self.default_deadline_s = float(default_deadline_s)
+        self.default_max_new = int(default_max_new)
+        #: False = another batcher sharing this engine owns refresh();
+        #: this one still observes generation changes and re-prefills
+        self.refresh = refresh
+        self.chaos = chaos if chaos is not None else engine.chaos
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._active: List[GenerateTicket] = []
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._bound_gen = -1
+        self._bound_step = -1
+        self._bound_epoch = 0  # engine.cache_epoch last observed
+        self.stats = {"iterations": 0, "prefills": 0, "swaps": 0,
+                      "restarts": 0}
+
+        from edl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self.recorder = telemetry.get_recorder()
+        self._m_requests = reg.counter("edl_serve_requests_total")
+        self._m_tokens = reg.counter("edl_serve_tokens_total")
+        self._m_prefills = reg.counter("edl_serve_prefills_total")
+        self._m_iterations = reg.counter(
+            "edl_serve_decode_iterations_total"
+        )
+        self._m_restarts = reg.counter("edl_serve_restarts_total")
+        self._g_depth = reg.gauge("edl_serve_decode_queue_depth")
+        self._g_active = reg.gauge("edl_serve_active_sequences")
+        self._g_kv = reg.gauge("edl_serve_kv_occupancy")
+        self._m_ttft = reg.histogram("edl_serve_ttft_seconds")
+        self._m_intertoken = reg.histogram("edl_serve_intertoken_seconds")
+        self._m_occupancy = reg.histogram("edl_serve_batch_occupancy")
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "TokenContinuousBatcher":
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._work, daemon=True, name="edl-serve-decode"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    @property
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    # -- admission ----------------------------------------------------------
+    def submit_generate(
+        self,
+        inputs: Dict[str, Any],
+        max_new_tokens: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+        eos_id: Optional[int] = None,
+        on_event=None,
+    ) -> GenerateTicket:
+        """Admit one autoregressive request (a single prompt row).
+        Raises ``QueueFullError`` on backpressure and ``ValueError``
+        on a schema violation — both before any compute."""
+        prompt = self.engine.coerce_prompt(inputs)
+        max_new = int(max_new_tokens or self.default_max_new)
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens {max_new} < 1")
+        budget = (
+            deadline_s if deadline_s is not None else self.default_deadline_s
+        )
+        ticket = GenerateTicket(
+            prompt,
+            max_new,
+            time.monotonic() + budget,
+            None if eos_id is None else int(eos_id),
+            on_event=on_event,
+        )
+        with self._cv:
+            forced = self.chaos is not None and bool(
+                self.chaos.due("serve.queue.full")
+            )
+            if forced or len(self._queue) >= self.queue_limit:
+                self._m_requests.inc(status="rejected")
+                raise QueueFullError(
+                    f"admission queue full ({self.queue_limit}); retry",
+                    retry_after=max(0.01, budget / 4),
+                )
+            self._queue.append(ticket)
+            self._g_depth.set(len(self._queue))
+            self._cv.notify()
+        return ticket
+
+    # -- worker internals ---------------------------------------------------
+    def _free_blocks(self, t: GenerateTicket) -> None:
+        if t.blocks:
+            self.engine.pool.free(t.blocks)
+            t.blocks = []
+        t.table = None
+
+    def _finish(self, t: GenerateTicket, status: str = "ok") -> None:
+        """Resolve + release KV blocks (the SAME iteration the final
+        token was emitted — slot reuse is what keeps occupancy high)."""
+        self._free_blocks(t)
+        if t in self._active:
+            self._active.remove(t)
+        self._m_requests.inc(status=status)
+        w_gen = self._bound_gen
+        t._finish(
+            {
+                "weights_step": self._bound_step,
+                "weights_generation": w_gen,
+                "restarts": t.restarts,
+                "prompt_tokens": int(t.prompt.shape[0]),
+            }
+        )
+
+    def _expire(self, t: GenerateTicket) -> None:
+        self._free_blocks(t)
+        if t in self._active:
+            self._active.remove(t)
+        self._m_requests.inc(status="expired")
+        t._reject(DeadlineExceededError("deadline passed mid-generation"))
+
+    def _restart_active(self, new_gen: int, new_step: int) -> None:
+        """A hot swap landed: every in-flight sequence re-prefills
+        against the new weights.  Its emitted-so-far tokens are VOID
+        (streamed as a restart event) — the alternative, continuing
+        the old prefix under new weights, would mix generations within
+        one sequence, which is exactly what the generation-keyed
+        contract forbids."""
+        restarted = list(self._active)
+        self._active = []
+        with self._cv:
+            for t in reversed(restarted):
+                self._free_blocks(t)
+                t.state = _QUEUED
+                t.tokens = []
+                t.length = 0
+                t.last_token = 0
+                t.restarts += 1
+                t._event(
+                    {
+                        "restart": True,
+                        "weights_generation": new_gen,
+                        "weights_step": new_step,
+                    }
+                )
+                self._queue.appendleft(t)  # keep arrival order
+            self._g_depth.set(len(self._queue))
+        if restarted:
+            self.stats["restarts"] += len(restarted)
+            self._m_restarts.inc(len(restarted))
+            self.recorder.record(
+                "serve.restart",
+                {
+                    "sequences": len(restarted),
+                    "to_generation": new_gen,
+                    "to_step": new_step,
+                },
+                step=max(0, new_step),
+            )
+
+    def _admit(self, weights) -> int:
+        """Token-boundary JOIN: pop queued requests while decode slots
+        and KV blocks last; each pays its own bucketed prefill.
+        Returns how many sequences joined."""
+        bt = self.engine.block_tokens
+        joined = 0
+        while len(self._active) < self.engine.max_seqs:
+            with self._cv:
+                if not self._queue:
+                    return joined
+                t = self._queue[0]
+                now = time.monotonic()
+                if t.deadline <= now:
+                    self._queue.popleft()
+                    self._g_depth.set(len(self._queue))
+                    self._m_requests.inc(status="expired")
+                    t._reject(
+                        DeadlineExceededError("deadline passed while queued")
+                    )
+                    continue
+                plen = int(t.prompt.shape[0])
+                need = self.engine.prompt_bucket_for(plen) // bt
+                blocks = self.engine.pool.alloc(need)
+                if blocks is None:
+                    return joined  # KV pressure: no more joins now
+                self._queue.popleft()
+                self._g_depth.set(len(self._queue))
+            t.blocks = blocks
+            t.table = np.zeros(self.engine.blocks_per_seq, np.int32)
+            t.table[: len(blocks)] = blocks
+            try:
+                first = self.engine.prefill(weights, t.prompt, t.table)
+            except BaseException as e:
+                self._free_blocks(t)
+                self._m_requests.inc(status="error")
+                t._reject(e)
+                continue
+            self.stats["prefills"] += 1
+            self._m_prefills.inc()
+            now = time.monotonic()
+            self._m_ttft.observe(now - t.enqueued)
+            t.state = _DECODING
+            t.length = plen
+            t.last_token = first
+            t.last_time = now
+            t.tokens.append(first)
+            t._event({"token": first, "i": 0})
+            self._m_tokens.inc()
+            self._active.append(t)
+            joined += 1
+            if self._seq_finished(t):
+                self._finish(t)
+        return joined
+
+    def _seq_finished(self, t: GenerateTicket) -> bool:
+        if t.eos_id is not None and t.tokens and t.tokens[-1] == t.eos_id:
+            return True
+        if len(t.tokens) >= t.max_new:
+            return True
+        # context cap: position t.length (the next write) must exist,
+        # i.e. continue while t.length <= max_context - 1
+        return t.length >= self.engine.max_context
+
+    def _decode_iteration(self, weights) -> int:
+        """ONE token for every active sequence.  Returns how many
+        sequences actually decoded."""
+        now = time.monotonic()
+        for t in list(self._active):
+            if t.deadline <= now:
+                self._expire(t)
+        if not self._active:
+            return 0
+        bt = self.engine.block_tokens
+        ready: List[GenerateTicket] = []
+        for t in self._active:
+            bi = t.length // bt
+            if bi >= len(t.blocks):
+                blk = self.engine.pool.alloc(1)
+                if blk is None:
+                    continue  # KV pressure: this seq skips one iteration
+                t.blocks.append(blk[0])
+                t.table[bi] = blk[0]
+            ready.append(t)
+        if not ready:
+            return 0
+        if self.chaos is not None:
+            for ev in self.chaos.due("serve.request.slow"):
+                # chaos[serve.request.slow]: a slow decode iteration
+                # inflates TTFT/inter-token — the signals the serving
+                # lane scales on, under test control.
+                time.sleep(float(ev.arg or 0.05))
+        bucket = self.engine.decode_bucket_for(len(ready))
+        tokens = np.zeros(bucket, np.int32)
+        lengths = np.zeros(bucket, np.int32)
+        tables = np.zeros(
+            (bucket, self.engine.blocks_per_seq), np.int32
+        )  # padding rows: trash block, length 0
+        for i, t in enumerate(ready):
+            tokens[i] = t.last_token
+            lengths[i] = t.length
+            tables[i] = t.table
+        try:
+            ids = self.engine.decode_step(weights, tokens, lengths, tables)
+        except BaseException as e:
+            for t in ready:
+                if t in self._active:
+                    self._active.remove(t)
+                self._free_blocks(t)
+                self._m_requests.inc(status="error")
+                t._reject(e)
+            return 0
+        self.stats["iterations"] += 1
+        self._m_iterations.inc()
+        self._m_tokens.inc(len(ready))
+        self._m_occupancy.observe(len(ready) / bucket)
+        now = time.monotonic()
+        for i, t in enumerate(ready):
+            tok = int(ids[i])
+            t.length += 1
+            t.last_token = tok
+            t.tokens.append(tok)
+            self._m_intertoken.observe(now - t.last_time)
+            t.last_time = now
+            t._event({"token": tok, "i": len(t.tokens) - 1})
+            if self._seq_finished(t):
+                self._finish(t)
+        return len(ready)
+
+    def _work(self) -> None:
+        while True:
+            with self._cv:
+                while (
+                    not self._queue
+                    and not self._active
+                    and not self._stop
+                ):
+                    self._cv.wait(timeout=0.5)
+                if self._stop:
+                    queued = list(self._queue)
+                    self._queue.clear()
+                    self._g_depth.set(0)
+                    break
+            # 1. swap check — at the token boundary only.  Guarded:
+            # a swap-path failure costs the swap, never the worker.
+            try:
+                if self.refresh and self.engine.refresh():
+                    self.stats["swaps"] += 1
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            w = self.engine.current_weights()
+            if w is None:
+                # No verified checkpoint yet: requests cannot serve.
+                with self._cv:
+                    queued = list(self._queue)
+                    self._queue.clear()
+                    self._g_depth.set(0)
+                for t in queued:
+                    self._m_requests.inc(status="error")
+                    t._reject(NotReadyError("no verified checkpoint loaded"))
+                continue
+            epoch = getattr(self.engine, "cache_epoch", 0)
+            if w.generation != self._bound_gen or epoch != self._bound_epoch:
+                if self._bound_gen >= 0:
+                    # A swap (new generation) or a rebuilt pool (new
+                    # cache epoch after a failed donated dispatch):
+                    # either way the live caches are unusable — every
+                    # in-flight sequence re-prefills.
+                    self._restart_active(w.generation, w.step)
+                self._bound_gen = w.generation
+                self._bound_step = w.step
+                self._bound_epoch = epoch
+            # 2. token-boundary join; 3. one decode iteration.
+            progress = self._admit(w)
+            progress += self._decode_iteration(w)
+            self._g_active.set(len(self._active))
+            self._g_kv.set(self.engine.pool.occupancy())
+            if not progress and (self._active or self._queue):
+                # Every live sequence is stalled (KV-block exhaustion)
+                # and nobody could join: nothing can change until a
+                # deadline expires or blocks free, so don't busy-spin.
+                time.sleep(0.01)
+        # stopped: nothing queued or active survives, resolve all.
+        for t in queued + list(self._active):
+            self._free_blocks(t)
+            self._m_requests.inc(status="error")
+            t._reject(RuntimeError("batcher stopped"))
+        self._active = []
+        self._g_active.set(0)

@@ -139,6 +139,10 @@ class InferenceEngine:
         self._compiled: Dict[int, Any] = {}
         self._weights: Optional[_Weights] = None
         self._swap_lock = threading.Lock()
+        #: serializes refresh(): the single-shot and token batchers may
+        #: share one engine, and two concurrent refreshes would install
+        #: the same checkpoint twice (a phantom generation bump)
+        self._refresh_lock = threading.Lock()
         #: request schema: input key -> (trailing shape, dtype), probed
         #: from the model's own synthetic batch so serving cannot drift
         #: from the model's actual shapes
@@ -184,6 +188,13 @@ class InferenceEngine:
     @property
     def ready(self) -> bool:
         return self._weights is not None
+
+    def current_weights(self) -> Optional[_Weights]:
+        """The installed weight record (immutable).  The token batcher
+        binds this ONCE per iteration and passes it to prefill/decode
+        explicitly, so a swap landing mid-iteration cannot mix
+        generations within one dispatch."""
+        return self._weights
 
     def _template_state(self):
         """Abstract TrainState schema for positional durable-dir loads
@@ -259,6 +270,10 @@ class InferenceEngine:
         engine keeps serving the current weights; no request is ever
         dropped for a swap.  Cheap when nothing changed: one step
         comparison, no hash pass."""
+        with self._refresh_lock:
+            return self._refresh_locked()
+
+    def _refresh_locked(self) -> bool:
         current = self.weights_step
         if self.chaos is not None:
             for _ in self.chaos.due("serve.swap.torn"):
@@ -499,3 +514,407 @@ class InferenceEngine:
             "rows": n,
         }
         return host, meta
+
+
+class KVBlockPool:
+    """Preallocated paged KV cache: fixed-size blocks in one device
+    pool, free-list managed HOST-side (the device only ever sees block
+    tables).  Block 0 is the trash block (padding rows of a decode
+    batch write there); real sequences allocate from 1..num_blocks-1.
+
+    Allocation is all-or-nothing (``alloc`` returns None rather than a
+    partial grant) so a prompt either gets its full block run or waits
+    at admission — a half-allocated sequence could neither prefill nor
+    free cleanly.
+    """
+
+    def __init__(
+        self,
+        layers: int,
+        heads: int,
+        head_dim: int,
+        num_blocks: int,
+        block_tokens: int,
+        dtype,
+        sharding,
+    ):
+        import jax.numpy as jnp
+        from collections import deque
+
+        if num_blocks < 2:
+            raise ValueError("pool needs >= 2 blocks (block 0 is trash)")
+        self.num_blocks = int(num_blocks)
+        self.block_tokens = int(block_tokens)
+        self._shape = (layers, num_blocks, block_tokens, heads, head_dim)
+        self._dtype = dtype
+        self._sharding = sharding
+        self.kpool = jax.device_put(jnp.zeros(self._shape, dtype), sharding)
+        self.vpool = jax.device_put(jnp.zeros(self._shape, dtype), sharding)
+        self._free = deque(range(1, num_blocks))
+
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - 1
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.usable_blocks - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.used_blocks / max(1, self.usable_blocks)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Take ``n`` blocks or None (never a partial grant)."""
+        if n > len(self._free):
+            return None
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 (trash) is never owned")
+            self._free.append(int(b))
+
+    def reset(self) -> None:
+        """Return every block to the free list (engine re-warm /
+        tests).  Stale bytes need no scrub: a reused block is fully
+        overwritten by prefill, and decode masks never expose
+        positions beyond a sequence's written length."""
+        from collections import deque
+
+        self._free = deque(range(1, self.num_blocks))
+
+    def rebuild(self) -> None:
+        """Replace the device arrays with fresh zeros, keeping the
+        free-list/ownership state.  The recovery path for a failed
+        dispatch whose DONATED inputs may already be consumed: the
+        old buffers are unusable either way, and the cached contents
+        are lost — callers must re-prefill every live sequence (the
+        engine bumps ``cache_epoch`` to say so)."""
+        import jax.numpy as jnp
+
+        self.kpool = jax.device_put(
+            jnp.zeros(self._shape, self._dtype), self._sharding
+        )
+        self.vpool = jax.device_put(
+            jnp.zeros(self._shape, self._dtype), self._sharding
+        )
+
+
+class DecodeEngine(InferenceEngine):
+    """KV-cached autoregressive decode on top of the single-shot
+    engine: separate prefill and decode executables AOT-lowered from
+    abstract shapes and HELD per padded bucket (``warm``'s discipline
+    — this jax's ``.lower().compile()`` does not warm the jit dispatch
+    cache), with the paged pool buffers DONATED so steady-state decode
+    updates the cache in place and performs ZERO XLA compiles.
+
+    Shape discipline:
+
+    - **prefill** compiles per padded prompt bucket (block-aligned
+      powers of two of ``block_tokens``), one sequence per dispatch —
+      the Orca posture: a joining request pays its own prefill, the
+      running decode batch never waits on a stranger's prompt shape.
+    - **decode** compiles per active-sequence-count bucket (powers of
+      two up to ``max_seqs``); ragged sequence lengths ride ONE
+      executable because the block tables absorb the raggedness.
+
+    Weights are passed EXPLICITLY (``current_weights()`` record): the
+    token batcher binds one record per iteration, so a hot swap can
+    only take effect at a token boundary — and the batcher then
+    re-prefills affected sequences against the new weights rather than
+    ever mixing generations within one sequence.
+    """
+
+    def __init__(
+        self,
+        model: ModelDef,
+        store: Optional[HostDRAMStore] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+        max_batch: int = 8,
+        seed: int = 0,
+        optimizer=None,
+        chaos=None,
+        max_seqs: int = 8,
+        block_tokens: int = 16,
+        max_context: Optional[int] = None,
+        num_blocks: Optional[int] = None,
+    ):
+        if model.decode is None:
+            raise ValueError(
+                f"model {model.name!r} declares no DecodeSpec; it can "
+                "only serve single-shot forwards (InferenceEngine)"
+            )
+        devs = list(devices) if devices is not None else jax.devices()
+        if max_batch < len(devs):
+            # The single-shot /predict buckets must shard over the dp
+            # extent, but a decode-focused fleet sizes max_batch for
+            # generate traffic (decode tensors are replicated, any
+            # count works) — lift the single-shot cap instead of
+            # refusing to boot.
+            import sys
+
+            print(
+                f"[edl-serve] max_batch {max_batch} raised to the "
+                f"{len(devs)}-device dp extent (single-shot bucket "
+                "floor; decode batching is unaffected)",
+                file=sys.stderr,
+            )
+            max_batch = len(devs)
+        super().__init__(
+            model,
+            store,
+            devices=devs,
+            max_batch=max_batch,
+            seed=seed,
+            optimizer=optimizer,
+            chaos=chaos,
+        )
+        spec = model.decode
+        self.spec = spec
+        self.block_tokens = int(block_tokens)
+        ctx = min(max_context or spec.max_len, spec.max_len)
+        #: blocks per sequence: the whole context window, block-aligned
+        #: (rounded DOWN — a partial trailing block could never be
+        #: addressed by the table)
+        self.blocks_per_seq = max(1, ctx // self.block_tokens)
+        self.max_context = self.blocks_per_seq * self.block_tokens
+        self.max_seqs = int(max_seqs)
+        if num_blocks is None:
+            # Enough for every slot's full context + the trash block.
+            num_blocks = self.max_seqs * self.blocks_per_seq + 1
+        self._replicated = NamedSharding(self.mesh, P())
+        self.pool = KVBlockPool(
+            spec.layers,
+            spec.heads,
+            spec.head_dim,
+            num_blocks,
+            self.block_tokens,
+            spec.cache_dtype,
+            self._replicated,
+        )
+        #: decode-batch buckets (active sequence counts)
+        buckets = []
+        b = 1
+        while b < self.max_seqs:
+            buckets.append(b)
+            b *= 2
+        buckets.append(self.max_seqs)
+        self.decode_buckets: Tuple[int, ...] = tuple(buckets)
+        #: padded prompt buckets (block-aligned, capped at the context)
+        pbuckets = []
+        p = self.block_tokens
+        while p < self.max_context:
+            pbuckets.append(p)
+            p *= 2
+        pbuckets.append(self.max_context)
+        self.prompt_buckets: Tuple[int, ...] = tuple(pbuckets)
+        # Pools donated (argnums 3, 4 of (params, tokens, lengths,
+        # kpool, vpool, tables)): steady-state decode reuses the cache
+        # buffers in place instead of copying the pool every token.
+        self._prefill_jit = jax.jit(spec.prefill_fn, donate_argnums=(3, 4))
+        self._decode_jit = jax.jit(spec.decode_fn, donate_argnums=(3, 4))
+        #: ("prefill", P) / ("decode", B) -> held AOT executable
+        self._decode_compiled: Dict[Tuple[str, int], Any] = {}
+        #: bumped whenever the cache contents were lost (pool rebuilt
+        #: after a failed dispatch): the token batcher re-prefills
+        #: every live sequence when it sees a new epoch, exactly like
+        #: a weights-generation change
+        self.cache_epoch = 0
+
+    # -- buckets ------------------------------------------------------------
+    @property
+    def max_prompt(self) -> int:
+        """Longest admissible prompt: one position must remain for the
+        first generated token."""
+        return self.max_context - 1
+
+    def prompt_bucket_for(self, n: int) -> int:
+        for p in self.prompt_buckets:
+            if n <= p:
+                return p
+        raise ValueError(
+            f"prompt of {n} tokens exceeds the context window "
+            f"{self.max_context}"
+        )
+
+    def decode_bucket_for(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"{n} active sequences exceed max_seqs {self.max_seqs}"
+        )
+
+    def coerce_prompt(self, inputs: Dict[str, Any]) -> np.ndarray:
+        """Validate one generate request's prompt: a 1-D (or [1, n])
+        int token row, 1 <= n <= max_prompt."""
+        if "tokens" not in inputs:
+            raise ValueError(
+                "generate request missing 'tokens' (the prompt row)"
+            )
+        a = np.asarray(inputs["tokens"])
+        if a.ndim == 2 and a.shape[0] == 1:
+            a = a[0]
+        if a.ndim != 1:
+            raise ValueError(
+                f"prompt must be one token row, got shape {a.shape}"
+            )
+        if not np.issubdtype(a.dtype, np.integer):
+            raise ValueError(f"prompt dtype {a.dtype} is not integral")
+        if not 1 <= a.shape[0] <= self.max_prompt:
+            raise ValueError(
+                f"prompt of {a.shape[0]} tokens outside [1, "
+                f"{self.max_prompt}] (context {self.max_context})"
+            )
+        return a.astype(np.int32)
+
+    # -- warm ---------------------------------------------------------------
+    def warm(self, buckets: Optional[Sequence[int]] = None) -> int:
+        """Single-shot buckets (the /predict path) PLUS the decode
+        stack: one prefill executable per prompt bucket, one decode
+        executable per sequence-count bucket."""
+        warmed = super().warm(buckets)
+        return warmed + self.warm_decode()
+
+    def _abs_decode_args(self, kind: str, n: int):
+        spec = self.spec
+        rep = self._replicated
+        pool = jax.ShapeDtypeStruct(
+            self.pool.kpool.shape, self.pool.kpool.dtype, sharding=rep
+        )
+        abs_params = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep),
+            getattr(self._abstract_params, "params", self._abstract_params),
+        )
+        if kind == "prefill":
+            tokens = jax.ShapeDtypeStruct((1, n), np.int32, sharding=rep)
+            rows = 1
+        else:
+            tokens = jax.ShapeDtypeStruct((n,), np.int32, sharding=rep)
+            rows = n
+        lengths = jax.ShapeDtypeStruct((rows,), np.int32, sharding=rep)
+        tables = jax.ShapeDtypeStruct(
+            (rows, self.blocks_per_seq), np.int32, sharding=rep
+        )
+        fn = spec.prefill_fn if kind == "prefill" else spec.decode_fn
+        return fn, (abs_params, tokens, lengths, pool, pool, tables)
+
+    def warm_decode(self) -> int:
+        """AOT-compile + HOLD every prefill/decode bucket from abstract
+        shapes (zero device allocation).  Idempotent."""
+        warmed = 0
+        todo = [("prefill", p) for p in self.prompt_buckets]
+        todo += [("decode", b) for b in self.decode_buckets]
+        for key in todo:
+            if key in self._decode_compiled:
+                continue
+            fn, abs_args = self._abs_decode_args(*key)
+            t0 = time.perf_counter()
+            with self.mesh:
+                self._decode_compiled[key] = jax.jit(
+                    fn, donate_argnums=(3, 4)
+                ).lower(*abs_args).compile()
+            dt = time.perf_counter() - t0
+            self._m_compile_seconds.observe(dt)
+            self.recorder.record(
+                "serve.warm",
+                {
+                    "bucket": key[1],
+                    "kind": key[0],
+                    "model": self.model.name,
+                },
+                timing={"seconds": round(dt, 6)},
+            )
+            warmed += 1
+        return warmed
+
+    @property
+    def warm_decode_buckets(self) -> Tuple[Tuple[str, int], ...]:
+        return tuple(sorted(self._decode_compiled))
+
+    # -- the decode request path --------------------------------------------
+    def _put(self, a: np.ndarray):
+        return jax.device_put(a, self._replicated)
+
+    def _run(self, key: Tuple[str, int], params, tokens, lengths, tables):
+        fn = self._decode_compiled.get(key)
+        args = (
+            params,
+            self._put(tokens),
+            self._put(lengths),
+            self.pool.kpool,
+            self.pool.vpool,
+            self._put(tables),
+        )
+        try:
+            with self.mesh:
+                if fn is not None:
+                    ids, kp, vp = fn(*args)
+                else:
+                    # Cold bucket (counted at the backend_compile seam)
+                    # — steady state never lands here once warm() ran.
+                    jfn = (
+                        self._prefill_jit
+                        if key[0] == "prefill"
+                        else self._decode_jit
+                    )
+                    ids, kp, vp = jfn(*args)
+        except BaseException:
+            # The pools were DONATED: after a failed dispatch the old
+            # buffers may already be consumed, so keeping them would
+            # poison every later call ("buffer has been deleted").
+            # Rebuild fresh zeros and bump the cache epoch — the
+            # batcher re-prefills every live sequence.
+            self.pool.rebuild()
+            self.cache_epoch += 1
+            raise
+        # Rebind the (donated) pools: the returned buffers ARE the
+        # cache after this token.
+        self.pool.kpool = kp
+        self.pool.vpool = vp
+        return np.asarray(jax.device_get(ids))
+
+    def prefill(
+        self, weights: _Weights, prompt: np.ndarray, table_row: np.ndarray
+    ) -> int:
+        """Run one sequence's prompt (1-D int32, true length) through
+        the prefill executable for its padded bucket.  ``table_row``:
+        the sequence's block table [blocks_per_seq] (unallocated tail
+        = trash block 0).  Returns the first generated token."""
+        plen = int(prompt.shape[0])
+        bucket = self.prompt_bucket_for(plen)
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :plen] = prompt
+        ids = self._run(
+            ("prefill", bucket),
+            weights.params,
+            tok,
+            np.asarray([plen], np.int32),
+            np.asarray(table_row, np.int32)[None],
+        )
+        return int(ids[0])
+
+    def decode_step(
+        self,
+        weights: _Weights,
+        tokens: np.ndarray,
+        lengths: np.ndarray,
+        tables: np.ndarray,
+    ) -> np.ndarray:
+        """One token of compute for a padded decode batch.  ``tokens``
+        [n]: each row's last token; ``lengths`` [n]: its position;
+        ``tables`` [n, blocks_per_seq].  Padding rows point at the
+        trash block with length 0.  Returns the next ids [n]."""
+        n = int(tokens.shape[0])
+        return self._run(
+            ("decode", n),
+            weights.params,
+            np.asarray(tokens, np.int32),
+            np.asarray(lengths, np.int32),
+            np.asarray(tables, np.int32),
+        )

@@ -6,6 +6,15 @@ One stdlib ``ThreadingHTTPServer`` per replica:
   ``{"outputs": {...}, "weights_step": N, ...}``; 429 + ``Retry-After``
   on admission backpressure, 503 before weights load, 504 past
   deadline.
+- ``POST /generate`` — autoregressive decode on the token batcher:
+  ``{"inputs": {"tokens": [...]}, "max_new_tokens": 16,
+  "deadline_ms": 30000, "eos_id": 1, "stream": true}``.  Non-stream
+  replies one JSON object (``tokens`` + weight generation/step);
+  ``stream: true`` replies chunked ``application/x-ndjson`` — one line
+  per token as it decodes, a ``{"restart": true}`` line when a hot
+  swap voids prior tokens (the sequence re-prefills on the new
+  weights), and a final ``{"done": true, "tokens": [...]}`` line that
+  is the authoritative output.  Same 429/504/503 mapping as /predict.
 - ``GET /healthz``   — readiness: weights step, warmed buckets, depth.
 - ``GET /metrics``   — Prometheus exposition of the process registry
   (the serving counters/histograms live there, so one scrape config
@@ -39,21 +48,33 @@ from edl_tpu.serving.engine import InferenceEngine, NotReadyError
 
 
 class ServingServer:
-    """Serve one ContinuousBatcher over HTTP."""
+    """Serve one ContinuousBatcher (and, for decode-capable models, a
+    TokenContinuousBatcher on ``/generate``) over HTTP."""
 
     def __init__(
         self,
         batcher: ContinuousBatcher,
         host: str = "0.0.0.0",
         port: int = 0,
+        gen_batcher=None,
     ):
         self.batcher = batcher
+        self.gen_batcher = gen_batcher
         engine = batcher.engine
+        self_server = self
         from edl_tpu import telemetry
 
         registry = telemetry.get_registry()
 
         class Handler(BaseHTTPRequestHandler):
+            # /generate streaming uses Transfer-Encoding: chunked,
+            # which RFC 7230 only defines for HTTP/1.1 — the default
+            # HTTP/1.0 response line would make strict clients and
+            # intermediaries buffer (or mis-parse) the stream.  Every
+            # response here carries Content-Length or chunked framing,
+            # so 1.1 keep-alive is safe.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # quiet
                 pass
 
@@ -69,17 +90,27 @@ class ServingServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._reply(
-                        {
-                            "ok": engine.ready,
-                            "model": engine.model.name,
-                            "weights_step": engine.weights_step,
-                            "weights_generation": engine.weights_generation,
-                            "warm_buckets": list(engine.warm_buckets),
-                            "queue_depth": self.server_batcher.depth,
-                        },
-                        200 if engine.ready else 503,
-                    )
+                    health = {
+                        "ok": engine.ready,
+                        "model": engine.model.name,
+                        "weights_step": engine.weights_step,
+                        "weights_generation": engine.weights_generation,
+                        "warm_buckets": list(engine.warm_buckets),
+                        "queue_depth": self.server_batcher.depth,
+                    }
+                    gen = self.server_gen_batcher
+                    if gen is not None:
+                        health["decode"] = {
+                            "max_seqs": engine.max_seqs,
+                            "max_context": engine.max_context,
+                            "block_tokens": engine.block_tokens,
+                            "active_sequences": gen.active_count,
+                            "decode_queue_depth": gen.depth,
+                            "kv_occupancy": round(
+                                engine.pool.occupancy(), 4
+                            ),
+                        }
+                    self._reply(health, 200 if engine.ready else 503)
                 elif self.path == "/metrics":
                     body = registry.render().encode()
                     self.send_response(200)
@@ -96,7 +127,18 @@ class ServingServer:
             def server_batcher(self):
                 return batcher
 
+            @property
+            def server_gen_batcher(self):
+                return self_server.gen_batcher
+
+            def _read_json(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
             def do_POST(self):
+                if self.path == "/generate":
+                    self._do_generate()
+                    return
                 if self.path != "/predict":
                     self._reply({"error": "not found"}, 404)
                     return
@@ -155,6 +197,120 @@ class ServingServer:
                     }
                 )
 
+            def _do_generate(self):
+                gen = self.server_gen_batcher
+                if gen is None:
+                    self._reply(
+                        {
+                            "error": f"model {engine.model.name!r} has no "
+                            "decode path (single-shot /predict only)"
+                        },
+                        404,
+                    )
+                    return
+                try:
+                    req = self._read_json()
+                except ValueError:
+                    self._reply({"error": "bad json"}, 400)
+                    return
+                deadline_ms = req.get("deadline_ms")
+                deadline_s = (
+                    float(deadline_ms) / 1000.0
+                    if deadline_ms is not None
+                    else None
+                )
+                stream = bool(req.get("stream"))
+                t0 = time.monotonic()
+                events = None
+                if stream:
+                    import queue as _q
+
+                    events = _q.Queue()
+                try:
+                    ticket = gen.submit_generate(
+                        req.get("inputs") or {},
+                        max_new_tokens=req.get("max_new_tokens"),
+                        deadline_s=deadline_s,
+                        eos_id=req.get("eos_id"),
+                        on_event=events.put if stream else None,
+                    )
+                except QueueFullError as e:
+                    self._reply(
+                        {"error": str(e), "retry_after_s": e.retry_after},
+                        429,
+                        headers=(
+                            ("Retry-After", f"{e.retry_after:.3f}"),
+                        ),
+                    )
+                    return
+                except ValueError as e:
+                    self._reply({"error": str(e)}, 400)
+                    return
+                budget = (deadline_s or gen.default_deadline_s) + 1.0
+                if stream:
+                    # Chunked JSON lines: one object per event as the
+                    # worker emits it; the final done/error line is the
+                    # authoritative result.
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "application/x-ndjson"
+                    )
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def chunk(obj):
+                        data = (json.dumps(obj) + "\n").encode()
+                        self.wfile.write(
+                            f"{len(data):x}\r\n".encode()
+                            + data
+                            + b"\r\n"
+                        )
+                        self.wfile.flush()
+
+                    end = time.monotonic() + budget
+                    try:
+                        while True:
+                            try:
+                                ev = events.get(
+                                    timeout=max(
+                                        0.05, end - time.monotonic()
+                                    )
+                                )
+                            except Exception:
+                                chunk(
+                                    {"error": "generation timed out"}
+                                )
+                                break
+                            chunk(ev)
+                            if "done" in ev or "error" in ev:
+                                break
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (BrokenPipeError, ConnectionError):
+                        pass  # client went away; worker resolves anyway
+                    return
+                try:
+                    tokens, meta = ticket.result(timeout=budget)
+                except (DeadlineExceededError, TimeoutError) as e:
+                    self._reply({"error": str(e)}, 504)
+                    return
+                except NotReadyError as e:
+                    self._reply({"error": str(e)}, 503)
+                    return
+                except Exception as e:
+                    self._reply({"error": str(e)}, 500)
+                    return
+                self._reply(
+                    {
+                        "tokens": tokens,
+                        "weights_step": meta["weights_step"],
+                        "weights_generation": meta["weights_generation"],
+                        "restarts": meta["restarts"],
+                        "latency_ms": round(
+                            (time.monotonic() - t0) * 1000.0, 3
+                        ),
+                    }
+                )
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -197,9 +353,22 @@ class ServingReplica:
         address: str = "",
         heartbeat_interval: float = 2.0,
         telemetry_interval: float = 5.0,
+        gen_batcher=None,
     ):
         self.engine = engine
         self.batcher = batcher or ContinuousBatcher(engine)
+        # Decode-capable engines get the token-iteration batcher too
+        # (the /generate path).  BOTH batchers drive refresh() — it is
+        # serialized and step-gated engine-side, and the single-shot
+        # worker only refreshes while ITS queue has traffic, so a
+        # generate-only fleet would otherwise never observe training's
+        # newer spills (verified live: /generate stuck on the old step
+        # while ckpt-24 sat in the durable dir).
+        if gen_batcher is None and getattr(engine, "spec", None) is not None:
+            from edl_tpu.serving.batcher import TokenContinuousBatcher
+
+            gen_batcher = TokenContinuousBatcher(engine)
+        self.gen_batcher = gen_batcher
         self.server = server
         self.coordinator = coordinator
         self.replica_id = replica_id or f"serve-{uuid.uuid4().hex[:8]}"
@@ -223,9 +392,14 @@ class ServingReplica:
         loaded = self.engine.load()
         # Warm BEFORE register: see the class doc (the prewarm/scale-up
         # contract).  Warming needs no weights — it lowers from
-        # abstract shapes — so even a not-yet-ready replica boots hot.
+        # abstract shapes — so even a not-yet-ready replica boots hot
+        # (DecodeEngine.warm also holds every prefill/decode bucket).
         self.engine.warm()
         self.batcher.start()
+        if self.gen_batcher is not None:
+            self.gen_batcher.start()
+            if self.server is not None and self.server.gen_batcher is None:
+                self.server.gen_batcher = self.gen_batcher
         if self.server is not None:
             self.server.start()
         if self.coordinator is not None:
@@ -254,6 +428,8 @@ class ServingReplica:
             except Exception:
                 pass
         self.batcher.stop()
+        if self.gen_batcher is not None:
+            self.gen_batcher.stop()
         if self.server is not None:
             self.server.stop()
 
@@ -349,11 +525,23 @@ def serve_run(
     )
     spill = checkpoint_dir or cfg["checkpoint_dir"]
     store = HostDRAMStore(spill_dir=spill or None)
-    engine = InferenceEngine(
-        model,
-        store,
-        max_batch=max_batch or cfg["serve_max_batch"],
-    )
+    if model.decode is not None:
+        # Generative family: the decode stack (KV pool + /generate)
+        # rides the same replica; /predict keeps working through the
+        # single-shot buckets.
+        from edl_tpu.serving.engine import DecodeEngine
+
+        engine = DecodeEngine(
+            model,
+            store,
+            max_batch=max_batch or cfg["serve_max_batch"],
+        )
+    else:
+        engine = InferenceEngine(
+            model,
+            store,
+            max_batch=max_batch or cfg["serve_max_batch"],
+        )
     batcher = ContinuousBatcher(
         engine,
         queue_limit=queue_limit or cfg["serve_queue_limit"],

@@ -408,6 +408,65 @@ CATALOG = {
         "help": "Training step of the checkpoint currently serving.",
         "labels": (),
     },
+    # -- autoregressive decode serving (DecodeEngine + token batcher) --------
+    "edl_serve_tokens_total": {
+        "type": "counter",
+        "help": "Generated tokens emitted by the decode path (prefill "
+        "first tokens + decode-iteration tokens).",
+        "labels": (),
+    },
+    "edl_serve_prefills_total": {
+        "type": "counter",
+        "help": "Sequences prefilled (one bucketed prompt forward per "
+        "admitted request; swap re-prefills count again).",
+        "labels": (),
+    },
+    "edl_serve_decode_iterations_total": {
+        "type": "counter",
+        "help": "Per-token decode iterations dispatched (one batched "
+        "decode executable call each).",
+        "labels": (),
+    },
+    "edl_serve_restarts_total": {
+        "type": "counter",
+        "help": "In-flight sequences re-prefilled because a checkpoint "
+        "hot-swap landed mid-generation (their partial output is void "
+        "- one sequence never mixes weight generations).",
+        "labels": (),
+    },
+    "edl_serve_decode_queue_depth": {
+        "type": "gauge",
+        "help": "Generate requests waiting for a decode slot/KV blocks "
+        "(the decode-path backpressure / autoscaling signal).",
+        "labels": (),
+    },
+    "edl_serve_active_sequences": {
+        "type": "gauge",
+        "help": "Sequences currently in the running decode batch.",
+        "labels": (),
+    },
+    "edl_serve_kv_occupancy": {
+        "type": "gauge",
+        "help": "Fraction of the paged KV pool's usable blocks "
+        "currently owned by live sequences.",
+        "labels": (),
+    },
+    "edl_serve_ttft_seconds": {
+        "type": "histogram",
+        "help": "Time to first token: admission to the prefill's first "
+        "generated token (the serving lane's decode overload signal).",
+        "labels": (),
+    },
+    "edl_serve_intertoken_seconds": {
+        "type": "histogram",
+        "help": "Gap between consecutive tokens of one sequence "
+        "(decode-iteration cadence as the client experiences it).",
+        "buckets": (
+            0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+            0.25, 0.5, 1.0, 2.5,
+        ),
+        "labels": (),
+    },
     # -- multi-job fleet market (edl_tpu.fleet) ------------------------------
     "edl_fleet_chips_total": {
         "type": "gauge",
@@ -512,6 +571,7 @@ KNOWN_EVENT_KINDS = {
     "serve.swap": "a newer verified checkpoint hot-swapped in",
     "serve.swap.rejected": "a hot-swap candidate failed verification",
     "serve.replica": "a serving replica registered / took traffic",
+    "serve.restart": "a hot swap re-prefilled in-flight sequences",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

@@ -1,0 +1,841 @@
+"""True-Orca decode serving (ISSUE 13): KV-cached autoregressive
+decode with per-token continuous batching.
+
+Key guarantees under test:
+
+- the KV-cached prefill+decode path emits EXACTLY the tokens the
+  single-shot full-recompute greedy path emits (the correctness anchor
+  for the incremental cache);
+- decode results are batch-invariant: a sequence's tokens do not
+  depend on which other sequences share its decode iterations (the
+  purity precondition for continuous batching);
+- steady-state decode performs ZERO XLA compiles (prefill + decode
+  executables AOT-held per bucket, pools donated);
+- requests JOIN and LEAVE the running batch at token boundaries;
+  finished sequences (EOS / budget) release their KV blocks the same
+  iteration;
+- a checkpoint hot swap mid-generation RE-PREFILLS affected sequences
+  against the new weights: every finished sequence's tokens are the
+  pure function of the one generation it reports (never mixed), and
+  zero sequences drop (the ISSUE 13 soak);
+- admission semantics carry over: bounded-queue 429, deadline expiry;
+- the ServingLane observes TTFT/decode-queue signals, and its replica
+  retargets push into the serving Deployment via the kube glue.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.runtime.train import TrainState
+from edl_tpu.serving import (
+    DecodeEngine,
+    KVBlockPool,
+    QueueFullError,
+    TokenContinuousBatcher,
+)
+
+_OPT = optax.adam(1e-3)
+
+
+def _lm_state(model, step: int, seed: int) -> TrainState:
+    """TrainState whose params are the pure function of ``seed`` —
+    each hot-swap generation in these tests uses seed == step, so a
+    finished sequence's reported ``weights_step`` names exactly one
+    parameter set to recompute its reference output with."""
+    p = model.init_params(jax.random.key(seed))
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params=p,
+        opt_state=_OPT.init(p),
+    )
+
+
+def _reference_decode(model, params, prompt, n, engine):
+    """Greedy reference through the SAME prefill/decode functions on a
+    fresh single-sequence pool (the pure function a finished
+    sequence's tokens must equal).  Uses the engine's prompt bucket so
+    padding matches the serving path exactly."""
+    spec = model.decode
+    bt = engine.block_tokens
+    mb = engine.blocks_per_seq
+    kp = jnp.zeros(
+        (spec.layers, mb + 1, bt, spec.heads, spec.head_dim),
+        spec.cache_dtype,
+    )
+    vp = jnp.zeros_like(kp)
+    tab = np.arange(1, mb + 1, dtype=np.int32)[None]
+    plen = len(prompt)
+    P = engine.prompt_bucket_for(plen)
+    tok = np.zeros((1, P), np.int32)
+    tok[0, :plen] = prompt
+    ids, kp, vp = jax.jit(spec.prefill_fn)(
+        params, tok, np.asarray([plen], np.int32), kp, vp, tab
+    )
+    out = [int(ids[0])]
+    ln = np.asarray([plen], np.int32)
+    dec = jax.jit(spec.decode_fn)
+    while len(out) < n:
+        ids, kp, vp = dec(
+            params, np.asarray([out[-1]], np.int32), ln, kp, vp, tab
+        )
+        out.append(int(ids[0]))
+        ln = ln + 1
+    return out
+
+
+@pytest.fixture(scope="module")
+def lm_decode():
+    """One warmed transformer_lm DecodeEngine (step 1 / seed 1) — the
+    bucket compiles are the expensive part.  Tests that hot-swap build
+    their own store+engine instead of mutating this one."""
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+    )
+    assert engine.load()
+    engine.warm()
+    return model, store, engine
+
+
+# -- model-layer contract ----------------------------------------------------
+
+
+def test_decode_spec_on_the_three_lm_families():
+    for name in ("transformer_lm", "moe_lm", "longcontext_lm"):
+        m = get_model(name, tiny=True)
+        spec = m.decode
+        assert spec is not None, name
+        assert spec.layers >= 1 and spec.heads >= 1, name
+        assert spec.max_len >= 64, name
+    # single-shot families stay single-shot
+    assert get_model("mnist").decode is None
+    assert get_model("fit_a_line").decode is None
+    # longcontext_lm is the long-context registry entry
+    assert get_model("longcontext_lm", tiny=True).decode.max_len == 128
+
+
+@pytest.mark.parametrize("name", ["transformer_lm", "longcontext_lm"])
+def test_kv_decode_matches_naive_full_recompute(name):
+    """The correctness anchor: the incremental path's tokens == the
+    single-shot predict path's greedy loop (which recomputes the whole
+    prefix every token — the quadratic cost the KV cache retires)."""
+    model = get_model(name, tiny=True)
+    spec = model.decode
+    params = model.init_params(jax.random.key(0))
+    L = spec.max_len
+    bt = 16
+    mb = L // bt
+    kp = jnp.zeros(
+        (spec.layers, mb + 1, bt, spec.heads, spec.head_dim),
+        spec.cache_dtype,
+    )
+    vp = jnp.zeros_like(kp)
+    tab = np.arange(1, mb + 1, dtype=np.int32)[None]
+    rng = np.random.RandomState(0)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :20]
+    P = 32
+    tok = np.zeros((1, P), np.int32)
+    tok[0, :20] = prompt
+    ids, kp, vp = jax.jit(spec.prefill_fn)(
+        params, tok, np.asarray([20], np.int32), kp, vp, tab
+    )
+    seq = [int(ids[0])]
+    ln = np.asarray([20], np.int32)
+    dec = jax.jit(spec.decode_fn)
+    for _ in range(11):
+        ids, kp, vp = dec(
+            params, np.asarray([seq[-1]], np.int32), ln, kp, vp, tab
+        )
+        seq.append(int(ids[0]))
+        ln = ln + 1
+    naive = list(prompt)
+    pf = jax.jit(model.predict_fn)
+    for _ in range(12):
+        row = np.zeros((1, L + 1), np.int32)
+        row[0, : len(naive)] = naive
+        out = pf(params, {"tokens": row})["tokens"]
+        naive.append(int(out[0, len(naive) - 1]))
+    assert seq == naive[20:]
+
+
+def test_moe_decode_batch_invariant_ragged_lengths():
+    """MoE decode routes PER TOKEN (group 1), so a sequence's tokens
+    cannot depend on which strangers share its decode batch — the
+    capacity-grouping coupling that would break continuous batching is
+    compiled out of the decode path."""
+    model = get_model("moe_lm", tiny=True)
+    spec = model.decode
+    params = model.init_params(jax.random.key(1))
+    bt = 16
+    mb = spec.max_len // bt
+    B = 3
+    kp = jnp.zeros(
+        (spec.layers, B * mb + 1, bt, spec.heads, spec.head_dim),
+        spec.cache_dtype,
+    )
+    vp = jnp.zeros_like(kp)
+    rng = np.random.RandomState(2)
+    prompts = [
+        model.synth_batch(rng, 1)["tokens"][0, :n] for n in (9, 17, 30)
+    ]
+    pre = jax.jit(spec.prefill_fn)
+    tabs = np.zeros((B, mb), np.int32)
+    lens = np.zeros(B, np.int32)
+    seqs = []
+    for i, pr in enumerate(prompts):
+        tabs[i] = np.arange(1 + i * mb, 1 + (i + 1) * mb)
+        tok = np.zeros((1, 32), np.int32)
+        tok[0, : len(pr)] = pr
+        ids, kp, vp = pre(
+            params,
+            tok,
+            np.asarray([len(pr)], np.int32),
+            kp,
+            vp,
+            jnp.asarray(tabs[i : i + 1]),
+        )
+        lens[i] = len(pr)
+        seqs.append([int(ids[0])])
+    dec = jax.jit(spec.decode_fn)
+    kp3, vp3 = kp, vp
+    l3 = lens.copy()
+    for _ in range(6):
+        t = np.asarray([s[-1] for s in seqs], np.int32)
+        ids, kp3, vp3 = dec(params, t, l3, kp3, vp3, jnp.asarray(tabs))
+        for i in range(B):
+            seqs[i].append(int(ids[i]))
+        l3 = l3 + 1
+    # row 1 decoded ALONE from the same post-prefill cache must emit
+    # identical tokens
+    kp1, vp1 = kp, vp
+    lone = [seqs[1][0]]
+    ln = lens[1:2].copy()
+    for _ in range(6):
+        ids, kp1, vp1 = dec(
+            params,
+            np.asarray([lone[-1]], np.int32),
+            ln,
+            kp1,
+            vp1,
+            jnp.asarray(tabs[1:2]),
+        )
+        lone.append(int(ids[0]))
+        ln = ln + 1
+    assert lone == seqs[1]
+
+
+# -- KV pool -----------------------------------------------------------------
+
+
+def test_kv_pool_free_list_all_or_nothing_and_trash():
+    pool = KVBlockPool(
+        2, 4, 16, num_blocks=5, block_tokens=16, dtype=jnp.bfloat16,
+        sharding=None,
+    )
+    assert pool.usable_blocks == 4 and pool.free_blocks == 4
+    a = pool.alloc(3)
+    assert a is not None and 0 not in a
+    assert pool.alloc(2) is None  # only 1 left: no partial grant
+    assert pool.free_blocks == 1
+    b = pool.alloc(1)
+    assert pool.occupancy() == 1.0
+    pool.free(a)
+    pool.free(b)
+    assert pool.free_blocks == 4 and pool.used_blocks == 0
+    with pytest.raises(ValueError):
+        pool.free([0])  # the trash block is never owned
+    with pytest.raises(ValueError):
+        KVBlockPool(2, 4, 16, num_blocks=1, block_tokens=16,
+                    dtype=jnp.bfloat16, sharding=None)
+
+
+# -- engine ------------------------------------------------------------------
+
+
+def test_decode_engine_buckets_and_prompt_validation(lm_decode):
+    _, _, engine = lm_decode
+    assert engine.decode_buckets == (1, 2, 4)
+    assert engine.prompt_buckets == (16, 32, 64)
+    assert engine.max_context == 64 and engine.max_prompt == 63
+    assert engine.prompt_bucket_for(5) == 16
+    assert engine.prompt_bucket_for(17) == 32
+    with pytest.raises(ValueError, match="context"):
+        engine.prompt_bucket_for(65)
+    with pytest.raises(ValueError, match="missing"):
+        engine.coerce_prompt({})
+    with pytest.raises(ValueError, match="outside"):
+        engine.coerce_prompt({"tokens": list(range(64))})
+    with pytest.raises(ValueError, match="one token row"):
+        engine.coerce_prompt({"tokens": [[1, 2], [3, 4]]})
+    # decode warm-held executables cover every bucket pair
+    kinds = dict.fromkeys(k for k, _ in engine.warm_decode_buckets)
+    assert list(kinds) == ["decode", "prefill"]
+    assert len(engine.warm_decode_buckets) == 6
+
+
+def test_decode_steady_state_zero_xla_compiles(lm_decode):
+    """Warm engine + varied prompt lengths / join patterns: the whole
+    token-iteration path must dispatch held executables only."""
+    model, _, engine = lm_decode
+    import jax._src.compiler as _compiler
+
+    batcher = TokenContinuousBatcher(engine, default_max_new=5).start()
+    rng = np.random.RandomState(7)
+    corpus = model.synth_batch(rng, 16)["tokens"]
+    real = _compiler.backend_compile
+    count = [0]
+
+    def counting(*a, **k):
+        count[0] += 1
+        return real(*a, **k)
+
+    _compiler.backend_compile = counting
+    try:
+        tickets = [
+            batcher.submit_generate(
+                {"tokens": corpus[i][: 3 + 5 * i]}, max_new_tokens=4 + i
+            )
+            for i in range(6)
+        ]
+        for t in tickets:
+            t.result(timeout=60)
+    finally:
+        _compiler.backend_compile = real
+        batcher.stop()
+    assert count[0] == 0, f"{count[0]} XLA compiles on the decode path"
+    assert engine.pool.used_blocks == 0
+
+
+# -- token-iteration scheduling ----------------------------------------------
+
+
+def test_join_and_leave_at_token_boundaries(lm_decode):
+    """A request arriving mid-generation joins the RUNNING batch at
+    the next token boundary (it finishes while the earlier longer
+    sequence is still decoding), and its joining does not perturb the
+    earlier sequence's output."""
+    model, _, engine = lm_decode
+    batcher = TokenContinuousBatcher(engine).start()
+    rng = np.random.RandomState(3)
+    pa = model.synth_batch(rng, 1)["tokens"][0, :12]
+    pb = model.synth_batch(rng, 1)["tokens"][0, :7]
+    a_events = []
+    try:
+        ta = batcher.submit_generate(
+            {"tokens": pa}, max_new_tokens=40, on_event=a_events.append
+        )
+        # wait until A is demonstrably mid-generation
+        deadline = time.monotonic() + 30
+        while len(a_events) < 3:
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        tb = batcher.submit_generate({"tokens": pb}, max_new_tokens=3)
+        b_tokens, b_meta = tb.result(timeout=60)
+        # B finished while A was still active: token-boundary join+leave
+        assert ta.state == "decoding"
+        assert len(b_tokens) == 3
+        a_tokens, _ = ta.result(timeout=60)
+    finally:
+        batcher.stop()
+    w = engine.current_weights()
+    ref_a = _reference_decode(
+        model, w.params, list(pa), len(a_tokens), engine
+    )
+    ref_b = _reference_decode(model, w.params, list(pb), 3, engine)
+    assert a_tokens == ref_a  # the join never perturbed A
+    assert b_tokens == ref_b
+    assert engine.pool.used_blocks == 0
+
+
+def test_eos_releases_slots_the_same_iteration(lm_decode):
+    """A sequence emitting its EOS leaves the batch and frees its
+    blocks the same iteration; non-EOS runs cap at max_new_tokens."""
+    model, _, engine = lm_decode
+    rng = np.random.RandomState(5)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :10]
+    batcher = TokenContinuousBatcher(engine).start()
+    try:
+        probe, _ = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=8
+        ).result(timeout=60)
+        assert len(probe) == 8
+        eos = probe[2]  # a token the run provably emits, now EOS
+        toks, meta = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=8, eos_id=eos
+        ).result(timeout=60)
+        # stopped AT the first eos emission (inclusive)
+        assert toks == probe[: probe.index(eos) + 1]
+        assert engine.pool.used_blocks == 0  # released on finish
+    finally:
+        batcher.stop()
+
+
+def test_context_cap_uses_the_full_window(lm_decode):
+    """A prompt of max_prompt tokens may still write its first decode
+    token at the final cache position: the cap fires only when the
+    NEXT write would fall outside the window (regression: an
+    off-by-one truncated every near-context generation one token
+    early)."""
+    model, _, engine = lm_decode
+    rng = np.random.RandomState(11)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, : engine.max_prompt]
+    batcher = TokenContinuousBatcher(engine).start()
+    try:
+        toks, _ = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=5
+        ).result(timeout=60)
+    finally:
+        batcher.stop()
+    # prefill emits 1 (no write), the one remaining position takes one
+    # decode write: exactly 2 tokens for a max_prompt prompt
+    assert len(toks) == 2
+    assert engine.pool.used_blocks == 0
+
+
+def test_failed_dispatch_rebuilds_donated_pools_and_recovers():
+    """The pools are DONATED into every dispatch: a call failing at
+    execution time may already have consumed them, so the engine must
+    rebuild fresh buffers (bumping cache_epoch) instead of keeping
+    dangling ones — and the batcher must keep serving afterwards."""
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model, store, devices=jax.devices()[:1], max_batch=1, max_seqs=4
+    )
+    assert engine.load()
+    engine.warm()
+    w = engine.current_weights()
+    rng = np.random.RandomState(0)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :10]
+
+    def boom(*a, **k):
+        raise RuntimeError("device fell over")
+
+    real = engine._decode_compiled[("decode", 1)]
+    engine._decode_compiled[("decode", 1)] = boom
+    epoch0 = engine.cache_epoch
+    with pytest.raises(RuntimeError, match="fell over"):
+        engine.decode_step(
+            w,
+            np.zeros(1, np.int32),
+            np.zeros(1, np.int32),
+            np.zeros((1, engine.blocks_per_seq), np.int32),
+        )
+    engine._decode_compiled[("decode", 1)] = real
+    assert engine.cache_epoch == epoch0 + 1  # cache declared lost
+    # the engine is still serviceable end to end (no dangling buffers)
+    batcher = TokenContinuousBatcher(engine).start()
+    try:
+        toks, meta = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=4
+        ).result(timeout=60)
+    finally:
+        batcher.stop()
+    ref = _reference_decode(
+        model, jax.device_get(w.params), list(prompt), 4, engine
+    )
+    assert toks == ref
+    assert engine.pool.used_blocks == 0
+
+
+def test_generate_admission_429_and_deadline_expiry(lm_decode):
+    model, _, engine = lm_decode
+    with telemetry.scoped() as (reg, _):
+        chaos = FaultSchedule(
+            seed=1, events=[FaultEvent(step=0, point="serve.queue.full")]
+        )
+        chaos.advance(0)
+        batcher = TokenContinuousBatcher(engine, chaos=chaos)
+        rng = np.random.RandomState(0)
+        prompt = model.synth_batch(rng, 1)["tokens"][0, :8]
+        # chaos[serve.queue.full]: forced rejection with a retry hint
+        with pytest.raises(QueueFullError) as ei:
+            batcher.submit_generate({"tokens": prompt})
+        assert ei.value.retry_after > 0
+        # queued-dead request: expires, never computes
+        from edl_tpu.serving.batcher import DeadlineExceededError
+
+        t = batcher.submit_generate(
+            {"tokens": prompt}, deadline_s=0.01
+        )
+        time.sleep(0.05)
+        batcher.start()
+        with pytest.raises(DeadlineExceededError):
+            t.result(timeout=30)
+        batcher.stop()
+        req = reg.counter("edl_serve_requests_total")
+        assert req.value(status="rejected") == 1
+        assert req.value(status="expired") == 1
+
+
+# -- the ISSUE 13 soak: hot swaps under decode load --------------------------
+
+
+def test_soak_swaps_under_decode_load_generation_purity():
+    """Seeded soak with >= 2 hot swaps landing while sequences are
+    mid-generation: every finished sequence's tokens must equal the
+    pure function (greedy decode) of the ONE generation it reports —
+    a swap re-prefills, never blends — and zero sequences drop."""
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+    )
+    assert engine.load()
+    engine.warm()
+    with telemetry.scoped() as (reg, rec):
+        batcher = TokenContinuousBatcher(
+            engine, default_deadline_s=120.0
+        ).start()
+        rng = np.random.RandomState(0)
+        prompts = [
+            model.synth_batch(rng, 1)["tokens"][0, : 6 + (i * 5) % 30]
+            for i in range(12)
+        ]
+        # Two swaps triggered FROM token events of in-flight sequences:
+        # each lands deterministically mid-generation (the save runs on
+        # the worker thread inside an iteration; the swap is observed
+        # at the next token boundary and re-prefills).
+        fired = []
+
+        def saver(step):
+            def on_event(ev):
+                if "token" in ev and ev["i"] == 2 and step not in fired:
+                    fired.append(step)
+                    store.save_async(
+                        _lm_state(model, step, step), generation=step
+                    )
+                    store.wait()
+
+            return on_event
+
+        results = []
+        errors = []
+
+        def client(i, on_event=None):
+            try:
+                toks, meta = batcher.submit_generate(
+                    {"tokens": prompts[i]},
+                    max_new_tokens=10,
+                    on_event=on_event,
+                ).result(timeout=120)
+            except BaseException as e:
+                errors.append(e)
+                return
+            results.append((i, toks, meta))
+
+        threads = [
+            threading.Thread(
+                target=client,
+                args=(i,),
+                kwargs={
+                    "on_event": (
+                        saver(2) if i == 2 else saver(3) if i == 7 else None
+                    )
+                },
+            )
+            for i in range(12)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.004)
+        for t in threads:
+            t.join(timeout=120)
+        batcher.stop()
+        assert not errors, f"sequences dropped/failed: {errors[:3]}"
+        assert len(results) == 12
+        assert len(fired) == 2  # both swaps landed
+        restarts = reg.counter("edl_serve_restarts_total").value()
+        assert restarts >= 1, "no sequence was mid-generation at a swap"
+        kinds = [e.kind for e in rec.events()]
+        assert "serve.restart" in kinds
+    # purity: each sequence == greedy decode under the generation it
+    # reports (seed == step by construction)
+    params_by_step = {
+        s: jax.device_get(_lm_state(model, s, s).params) for s in (1, 2, 3)
+    }
+    gens_seen = set()
+    for i, toks, meta in results:
+        gens_seen.add(meta["weights_step"])
+        ref = _reference_decode(
+            model,
+            params_by_step[meta["weights_step"]],
+            list(prompts[i]),
+            len(toks),
+            engine,
+        )
+        assert toks == ref, (i, meta)
+    assert len(gens_seen) >= 2  # the soak actually crossed generations
+    assert engine.pool.used_blocks == 0
+
+
+# -- HTTP front --------------------------------------------------------------
+
+
+def test_http_generate_stream_and_nonstream(lm_decode):
+    from edl_tpu.serving import ContinuousBatcher, ServingServer
+
+    model, _, engine = lm_decode
+    sb = ContinuousBatcher(engine).start()
+    gb = TokenContinuousBatcher(engine, refresh=False).start()
+    server = ServingServer(sb, host="127.0.0.1", gen_batcher=gb).start()
+    base = f"http://127.0.0.1:{server.port}"
+    rng = np.random.RandomState(0)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :10].tolist()
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"{base}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        return urllib.request.urlopen(req, timeout=30)
+
+    try:
+        r = json.loads(
+            post({"inputs": {"tokens": prompt}, "max_new_tokens": 5}).read()
+        )
+        assert len(r["tokens"]) == 5
+        assert r["weights_step"] == engine.weights_step
+        lines = [
+            json.loads(line)
+            for line in post(
+                {
+                    "inputs": {"tokens": prompt},
+                    "max_new_tokens": 5,
+                    "stream": True,
+                }
+            ).read().splitlines()
+        ]
+        assert lines[-1]["done"] and lines[-1]["tokens"] == r["tokens"]
+        assert [ln["token"] for ln in lines[:-1]] == r["tokens"]
+        # /healthz carries the decode section
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as h:
+            health = json.loads(h.read())
+        assert health["decode"]["max_seqs"] == engine.max_seqs
+        # bad prompt -> 400
+        try:
+            post({"inputs": {}})
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.stop()
+        sb.stop()
+        gb.stop()
+
+
+def test_replica_token_batcher_owns_refresh(lm_decode):
+    """Regression (found driving the live flow): a generate-only fleet
+    gets refresh() from NOBODY unless the token batcher drives it —
+    the single-shot worker only refreshes while ITS queue has traffic,
+    so training's newer durable spills were never observed."""
+    from edl_tpu.serving import ServingReplica
+
+    _, _, engine = lm_decode
+    replica = ServingReplica(engine, replica_id="serve-x")
+    assert replica.gen_batcher is not None
+    assert replica.gen_batcher.refresh  # the swap path for /generate
+
+
+# -- autoscaler + kube glue --------------------------------------------------
+
+
+def test_serving_lane_observes_ttft_and_decode_queue():
+    """The lane reads the decode fleet's signals: TTFT p95 over the
+    window delta actuates when ttft_high_s is set, and decode-queue
+    depth folds into the queue-pressure band."""
+    from edl_tpu.autoscaler.serving import ServingLane
+
+    with telemetry.scoped():
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("edl_serve_ttft_seconds")
+        for _ in range(30):
+            h.observe(1.2)
+
+        class _Coord:
+            target = 1
+            calls = []
+
+            def telemetry(self):
+                return {
+                    "merged": {
+                        "counters": {},
+                        "gauges": {
+                            "edl_serve_decode_queue_depth": {"": 2},
+                            "edl_serve_kv_occupancy": {"": 0.8},
+                        },
+                        "histograms": {
+                            "edl_serve_ttft_seconds": reg.snapshot()[
+                                "histograms"
+                            ]["edl_serve_ttft_seconds"]
+                        },
+                    }
+                }
+
+            def metrics(self):
+                return {"target_world": self.target}
+
+            def set_prewarm(self, n, trace_id=""):
+                pass
+
+            def set_target_world(self, n, trace_id=""):
+                self.target = n
+
+        coord = _Coord()
+        lane = ServingLane(
+            coord, min_replicas=1, max_replicas=4, ttft_high_s=0.5
+        )
+        entry = lane.run_once()
+        obs = entry["observed"]
+        assert obs["ttft_p95_s"] > 0.5
+        assert obs["decode_queue_depth"] == 2
+        assert obs["kv_occupancy"] == 0.8
+        assert entry["dry_run"]["proposed"] == 2 and entry["actuated"]
+        assert "ttft" in entry["reason"]
+        # without the threshold the same TTFT is observe-only: depth 2
+        # is under the band and nothing else is hot, so no actuation
+        lane2 = ServingLane(coord, min_replicas=1, max_replicas=4)
+        e2 = lane2.run_once()
+        assert e2["observed"]["ttft_p95_s"] is not None
+        assert e2["reason"] == "within band" and not e2["actuated"]
+
+
+def test_kube_replica_glue_moves_the_serving_deployment():
+    """ISSUE 13 satellite: a ServingLane retarget pushes the decided
+    replica count into the serving replica Deployment through the
+    bounded-retry update_serving_replicas idiom (not just the
+    coordinator target)."""
+    from edl_tpu.autoscaler.serving import ServingLane, kube_replica_glue
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.cluster.kube import FakeKube, NodeInfo
+    from edl_tpu.controller.jobparser import parse_to_serving_manifests
+    from edl_tpu.resource.training_job import TrainingJob
+
+    with telemetry.scoped():
+        job = TrainingJob.from_yaml(
+            """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: serve-glue}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  checkpoint_dir: /ckpts
+  trainer:
+    entrypoint: mnist
+    min_instance: 1
+    max_instance: 4
+    slice_topology: cpu
+  serving:
+    min_replicas: 1
+    max_replicas: 4
+"""
+        ).validate()
+        kube = FakeKube(
+            [NodeInfo(name="n0", cpu_milli=64000, memory_mega=262144,
+                      tpu_chips=8)]
+        )
+        cluster = Cluster(kube)
+        kube.apply_manifests(parse_to_serving_manifests(job))
+        dep = kube.get_workload(job.serving_name(), kind="Deployment")
+        assert dep is not None and dep.parallelism == 1
+
+        class _Coord:
+            target = 1
+
+            def telemetry(self):
+                return {
+                    "merged": {
+                        "counters": {},
+                        "gauges": {"edl_serve_queue_depth": {"": 50}},
+                        "histograms": {},
+                    }
+                }
+
+            def metrics(self):
+                return {"target_world": self.target}
+
+            def set_prewarm(self, n, trace_id=""):
+                pass
+
+            def set_target_world(self, n, trace_id=""):
+                self.target = n
+
+        coord = _Coord()
+        lane = ServingLane(
+            coord,
+            min_replicas=1,
+            max_replicas=4,
+            on_scale=kube_replica_glue(cluster, job),
+        )
+        entry = lane.run_once()
+        assert entry["actuated"] and entry["dry_run"]["proposed"] == 2
+        after = kube.get_workload(job.serving_name(), kind="Deployment")
+        assert after.parallelism == 2  # the Deployment followed
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_metrics_prints_decode_stats(capsys):
+    from edl_tpu.cli import main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    coord = LocalCoordinator(target_world=1, max_world=2)
+    coord.register("serve-0")
+    reg = telemetry.MetricsRegistry()
+    reg.counter("edl_serve_tokens_total").inc(480)
+    h = reg.histogram("edl_serve_ttft_seconds")
+    for _ in range(10):
+        h.observe(0.012)
+    it = reg.histogram("edl_serve_intertoken_seconds")
+    for _ in range(470):
+        it.observe(0.002)
+    reg.gauge("edl_serve_kv_occupancy").set(0.625)
+    coord.report_telemetry("serve-0", snapshot=reg.snapshot(), seq=1)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    try:
+        assert main(["metrics", f"127.0.0.1:{server.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "tokens_total" in out and "480" in out
+        assert "decode_tokens_per_s" in out
+        assert "ttft_p50" in out and "ttft_p95" in out
+        assert "intertoken_p95" in out
+        assert "kv_slot_occupancy" in out and "0.625" in out
+    finally:
+        server.stop()

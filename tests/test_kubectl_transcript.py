@@ -180,6 +180,105 @@ def test_golden_transcript_submit_scale_delete(transcript_api):
     assert all(r["stdin"] == "" for r in records[1:])
 
 
+SERVE_DEPLOYMENT = {
+    "apiVersion": "apps/v1",
+    "kind": "Deployment",
+    "metadata": {"name": "gj-serve", "labels": {"edl-owner": "gj"}},
+    "spec": {
+        "replicas": 2,
+        "template": {
+            "spec": {
+                "containers": [
+                    {
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"},
+                        }
+                    }
+                ]
+            }
+        },
+    },
+}
+
+
+def test_golden_transcript_serving_replica_scale(transcript_api):
+    """The ServingLane's kube half (ISSUE 13 satellite): scaling the
+    serving replica Deployment pins the SAME optimistic-concurrency
+    read-modify-patch-reread shape as trainer parallelism, against the
+    deployment resource with the spec.replicas knob."""
+    api, transcript = transcript_api
+    api.apply_manifests([SERVE_DEPLOYMENT])
+    w = api.get_workload("gj-serve", kind="Deployment")
+    assert w is not None and w.kind == "Deployment" and w.parallelism == 2
+    w.parallelism = 4
+    after = api.update_workload(w)
+    assert after.parallelism == 4 and after.kind == "Deployment"
+
+    records = _read(transcript)
+    golden_argv = [
+        ["-n", "default", "apply", "-f", "-"],
+        ["-n", "default", "get", "deployment", "gj-serve", "-o", "json"],
+        [
+            "-n",
+            "default",
+            "patch",
+            "deployment",
+            "gj-serve",
+            "--type=merge",
+            "-p",
+            json.dumps(
+                {
+                    "metadata": {"resourceVersion": "1"},
+                    "spec": {"replicas": 4},
+                }
+            ),
+        ],
+        ["-n", "default", "get", "deployment", "gj-serve", "-o", "json"],
+    ]
+    assert [r["argv"] for r in records] == golden_argv
+    # a Job-kind lookup must NOT find the Deployment (kind-scoped API)
+    assert api.get_workload("gj-serve", kind="Job") is None
+
+
+def test_cluster_update_serving_replicas_conflict_retry(transcript_api):
+    """Cluster.update_serving_replicas drives the transcript-pinned
+    patch through the bounded conflict_retry idiom and reports a
+    missing fleet as False, not an exception."""
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.resource.training_job import TrainingJob
+
+    api, transcript = transcript_api
+    api.apply_manifests([SERVE_DEPLOYMENT])
+    job = TrainingJob.from_yaml(
+        """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: gj}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  checkpoint_dir: /ckpts
+  trainer:
+    entrypoint: mnist
+    min_instance: 1
+    max_instance: 4
+    slice_topology: cpu
+  serving:
+    min_replicas: 1
+    max_replicas: 5
+"""
+    ).validate()
+    cluster = Cluster(api)
+    assert cluster.update_serving_replicas(job, 3)
+    w = api.get_workload("gj-serve", kind="Deployment")
+    assert w.parallelism == 3
+    # spec.serving unset -> False without touching kubectl
+    before = len(_read(transcript))
+    job.spec.serving = None
+    assert not cluster.update_serving_replicas(job, 2)
+    assert len(_read(transcript)) == before
+
+
 def test_golden_transcript_conflict_surfaces(transcript_api):
     """A stale resourceVersion must round-trip to ConflictError through
     the recorded patch invocation (the retry loop's trigger)."""

@@ -17,11 +17,38 @@ inter-token p95 — with steady-state decode asserted at ZERO XLA
 compiles (prefill + decode executables are AOT-held per bucket), and
 a hot swap under decode load completing with zero failed/dropped
 sequences.
+
+The INTERFERENCE sweep (ISSUE 14) measures the prefill/decode
+interference chunked prefill exists to bound: a steady short-prompt
+decode load takes periodic LONG-prompt admissions (the 2k-4k-token
+shape at full size; scaled to the tiny context on a CPU box) under
+both monolithic and chunked admission — inter-token p95 during
+admissions vs the no-admission baseline (the stall ratio the
+thresholds gate at <= 2x for chunked), long-prompt TTFT, the new
+per-iteration stall histogram's p95, a mid-sweep hot swap, ZERO
+steady-state compiles and ZERO dropped sequences.
 """
 
 from __future__ import annotations
 
 from bench_lib.load import arrival_offsets, run_open_loop
+
+
+def _hist_delta(after, before):
+    """Per-phase view of a cumulative histogram series: after - before
+    (the quantiles of just the window between two snapshots)."""
+    if after is None:
+        return None
+    if before is None:
+        return after
+    return {
+        "buckets": list(after["buckets"]),
+        "counts": [
+            a - b for a, b in zip(after["counts"], before["counts"])
+        ],
+        "sum": after["sum"] - before["sum"],
+        "count": after["count"] - before["count"],
+    }
 
 
 def bench_serving() -> dict:
@@ -66,20 +93,6 @@ def bench_serving() -> dict:
     batcher = ContinuousBatcher(
         engine, queue_limit=8192, default_deadline_s=60.0
     ).start()
-
-    def _hist_delta(after, before):
-        if after is None:
-            return None
-        if before is None:
-            return after
-        return {
-            "buckets": list(after["buckets"]),
-            "counts": [
-                a - b for a, b in zip(after["counts"], before["counts"])
-            ],
-            "sum": after["sum"] - before["sum"],
-            "count": after["count"] - before["count"],
-        }
 
     rng = np.random.RandomState(0)
     pool = model.synth_batch(rng, 64)["image"]
@@ -240,6 +253,7 @@ def bench_serving() -> dict:
         "hot_swap": hot_swap,
         "scale_up": scale_up,
         "decode": bench_decode(),
+        "interference": bench_interference(),
     }
 
 
@@ -302,20 +316,6 @@ def bench_decode() -> dict:
     batcher = TokenContinuousBatcher(
         engine, queue_limit=8192, default_deadline_s=120.0
     ).start()
-
-    def _hist_delta(after, before):
-        if after is None:
-            return None
-        if before is None:
-            return after
-        return {
-            "buckets": list(after["buckets"]),
-            "counts": [
-                a - b for a, b in zip(after["counts"], before["counts"])
-            ],
-            "sum": after["sum"] - before["sum"],
-            "count": after["count"] - before["count"],
-        }
 
     rng = np.random.RandomState(0)
     corpus = model.synth_batch(rng, 64)["tokens"]
@@ -457,4 +457,230 @@ def bench_decode() -> dict:
         "intertoken_p95_ms": sweep[-1]["intertoken_p95_ms"],
         "steady_state_xla_compiles": steady_compiles,
         "hot_swap": hot_swap,
+    }
+
+
+def bench_interference() -> dict:
+    """Long-prompt interference sweep (ISSUE 14): a steady short-prompt
+    decode load takes periodic long-prompt admissions under monolithic
+    AND chunked prefill.  Publishes, per mode: inter-token p95 with no
+    admissions (baseline) and during admissions, their ratio (the
+    stall the running batch experienced), long-prompt TTFT p50/p95 and
+    the per-iteration prefill-stall p95.  Chunked mode also lands a
+    mid-sweep hot swap.  Asserted: 0 XLA compiles across the whole
+    sweep, 0 dropped sequences."""
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import DecodeEngine, TokenContinuousBatcher
+    from edl_tpu.telemetry.aggregate import histogram_quantile
+
+    on_tpu = jax.default_backend() == "tpu"
+    # The long-context family IS the workload chunking exists for: 4k
+    # contexts with 2k-4k-token admissions at full size; the tiny
+    # 128-token context scales the same shape onto a CPU box (long
+    # prompts at 3/4 .. all-but-one of the window).
+    model = get_model("longcontext_lm", tiny=not on_tpu)
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adam(1e-3)
+
+    def state_at(step: int, seed: int = 0) -> TrainState:
+        p = (
+            params
+            if seed == 0
+            else model.init_params(jax.random.key(seed))
+        )
+        return TrainState(
+            step=jnp.asarray(step, jnp.int32),
+            params=p,
+            opt_state=opt.init(p),
+        )
+
+    store = HostDRAMStore()
+    store.save_async(state_at(1))
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=8,
+        block_tokens=16,
+        max_chunk_tokens=32,
+    )
+    engine.load()
+    engine.warm()
+    ctx = engine.max_context
+    long_lens = (ctx * 3 // 4, engine.max_prompt)
+
+    reg = telemetry.get_registry()
+    m_requests = reg.counter("edl_serve_requests_total")
+    h_intertoken = reg.histogram("edl_serve_intertoken_seconds")
+    h_ttft = reg.histogram("edl_serve_ttft_seconds")
+    h_stall = reg.histogram("edl_serve_prefill_stall_seconds")
+
+    rng = np.random.RandomState(0)
+    corpus = model.synth_batch(rng, 64)["tokens"]
+
+    def _failures():
+        return (
+            m_requests.value(status="error")
+            + m_requests.value(status="expired")
+            + m_requests.value(status="rejected")
+        )
+
+    import jax._src.compiler as _compiler
+
+    m_compiles = reg.counter("edl_xla_compiles_total")
+    compiles_before = m_compiles.value()
+    _real_bc = _compiler.backend_compile
+
+    def _counting_bc(*args, **kwargs):
+        m_compiles.inc()
+        return _real_bc(*args, **kwargs)
+
+    err0 = _failures()
+    restarted_mid_swap = [0]
+    _compiler.backend_compile = _counting_bc
+    try:
+        modes = {}
+        for mode in ("monolithic", "chunked"):
+            batcher = TokenContinuousBatcher(
+                engine,
+                queue_limit=8192,
+                default_deadline_s=120.0,
+                chunked_prefill=(mode == "chunked"),
+                prefill_token_budget=32,
+            ).start()
+            # -- steady short-prompt decode load (4 sequences kept in
+            # flight by a driver thread for the whole phase pair)
+            stop = threading.Event()
+            load_tickets = []
+
+            def load_driver():
+                i = 0
+                inflight = []
+                while not stop.is_set():
+                    while len(inflight) < 4 and not stop.is_set():
+                        plen = 6 + (i * 5) % 20
+                        t = batcher.submit_generate(
+                            {"tokens": corpus[i % len(corpus)][:plen]},
+                            max_new_tokens=24,
+                        )
+                        load_tickets.append(t)
+                        inflight.append(t)
+                        i += 1
+                    inflight = [
+                        t for t in inflight if not t._done.is_set()
+                    ]
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=load_driver, daemon=True)
+            th.start()
+            time.sleep(0.3)  # cadence settled
+            # -- phase 1: no admissions (baseline inter-token p95)
+            it0 = h_intertoken.series()
+            time.sleep(1.0)
+            base = _hist_delta(h_intertoken.series(), it0)
+            base_p95 = histogram_quantile(base, 0.95)
+            # -- phase 2: periodic long admissions under the same load
+            it1 = h_intertoken.series()
+            ttft0 = h_ttft.series()
+            stall0 = h_stall.series()
+            gen0 = engine.weights_generation
+            long_tickets = []
+            for j in range(6):
+                plen = long_lens[j % len(long_lens)]
+                long_tickets.append(
+                    batcher.submit_generate(
+                        {"tokens": corpus[(7 * j) % len(corpus)][:plen]},
+                        max_new_tokens=4,
+                    )
+                )
+                if mode == "chunked" and j == 2:
+                    # mid-sweep hot swap: a new verified checkpoint
+                    # lands while long prompts are chunking AND the
+                    # short load is decoding
+                    store.save_async(state_at(100, seed=7))
+                    store.wait()
+                time.sleep(0.25)
+            for t in long_tickets:
+                t.result(timeout=240)
+            during = _hist_delta(h_intertoken.series(), it1)
+            during_p95 = histogram_quantile(during, 0.95)
+            ttft = _hist_delta(h_ttft.series(), ttft0)
+            stall = _hist_delta(h_stall.series(), stall0)
+            stall_p95 = histogram_quantile(stall, 0.95)
+            stop.set()
+            th.join(timeout=10)
+            results = [t.result(timeout=240) for t in load_tickets]
+            if mode == "chunked":
+                assert engine.weights_generation > gen0, (
+                    "mid-sweep hot swap never installed"
+                )
+                restarted_mid_swap[0] = sum(
+                    1 for _, meta in results if meta["restarts"]
+                ) + sum(
+                    1
+                    for t in long_tickets
+                    if t.result()[1]["restarts"]
+                )
+            batcher.stop()
+            ratio = (
+                round(during_p95 / base_p95, 3)
+                if base_p95 and during_p95
+                else None
+            )
+            modes[mode] = {
+                "baseline_intertoken_p95_ms": (
+                    round(base_p95 * 1000, 3) if base_p95 else None
+                ),
+                "admission_intertoken_p95_ms": (
+                    round(during_p95 * 1000, 3) if during_p95 else None
+                ),
+                "intertoken_p95_ratio": ratio,
+                "long_ttft_p50_ms": (
+                    lambda v: round(v * 1000, 3) if v else None
+                )(histogram_quantile(ttft, 0.5)),
+                "long_ttft_p95_ms": (
+                    lambda v: round(v * 1000, 3) if v else None
+                )(histogram_quantile(ttft, 0.95)),
+                "prefill_stall_p95_ms": (
+                    round(stall_p95 * 1000, 3) if stall_p95 else None
+                ),
+                "long_admissions": len(long_tickets),
+                "long_prompt_tokens": list(long_lens),
+            }
+        dropped = int(_failures() - err0)
+        steady_compiles = int(m_compiles.value() - compiles_before)
+        assert dropped == 0, f"{dropped} sequences dropped in the sweep"
+        assert steady_compiles == 0, (
+            f"{steady_compiles} XLA compiles in the interference sweep"
+        )
+    finally:
+        _compiler.backend_compile = _real_bc
+
+    return {
+        "model": model.name,
+        "max_context": ctx,
+        "block_tokens": engine.block_tokens,
+        "max_chunk_tokens": engine.max_chunk_tokens,
+        "prefill_token_budget": 32,
+        "monolithic": modes["monolithic"],
+        "chunked": modes["chunked"],
+        "hot_swap": {
+            "swapped": True,
+            "restarted_mid_generation": restarted_mid_swap[0],
+        },
+        "dropped_sequences": dropped,
+        "steady_state_xla_compiles": steady_compiles,
     }

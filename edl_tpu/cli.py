@@ -380,6 +380,37 @@ def cmd_metrics(args) -> int:
                 print(
                     f"  {'kv_slot_occupancy':<24} {max(kv.values()):.3f}"
                 )
+            # Chunked-prefill stats (ISSUE 14): admission pressure and
+            # the stall it imposed on the running decode batch.
+            chunks = counters_all.get(
+                "edl_serve_prefill_chunks_total"
+            ) or {}
+            if chunks:
+                print(
+                    f"  {'prefill_chunks_total':<24} "
+                    f"{sum(chunks.values()):g}"
+                )
+                ptok = counters_all.get(
+                    "edl_serve_prefill_tokens_total"
+                ) or {}
+                if ptok:
+                    print(
+                        f"  {'prefill_tokens_total':<24} "
+                        f"{sum(ptok.values()):g}"
+                    )
+            pq = gauges_all.get("edl_serve_prefill_queued_tokens") or {}
+            if pq:
+                print(
+                    f"  {'queued_prefill_tokens':<24} "
+                    f"{max(pq.values()):g}"
+                )
+            stall = hists_all.get("edl_serve_prefill_stall_seconds")
+            if stall:
+                s95 = histogram_quantile(stall, 0.95)
+                print(
+                    f"  {'prefill_stall_p95':<24} "
+                    f"{f'{s95 * 1000:.2f} ms' if s95 is not None else 'n/a'}"
+                )
         req = counters_all.get("edl_serve_requests_total") or {}
         for key in sorted(req):
             print(f"  requests{{{key}}}{'':<10} {req[key]:g}")

@@ -45,6 +45,16 @@ class DecodeSpec:
       tables) -> (ids[B], kpool', vpool')`` — one token of compute:
       write the token's K/V at position ``lengths[i]``, attend over
       the cache through the block table, return the next greedy id.
+    - ``chunk_fn(params, tokens[B,C], offsets[B], lengths[B], kpool,
+      vpool, tables) -> (ids[B], kpool', vpool')`` — chunked prefill
+      (ISSUE 14): one block-aligned prompt slice carrying an explicit
+      cache offset; scatters its K/V at ``offsets`` and attends
+      causally over all previously-filled positions through the
+      (window-truncated) block table.  ``lengths`` = total filled
+      positions after the chunk; the returned id is the first sampled
+      token when the chunk is the prompt's last (exact-match contract
+      vs monolithic ``prefill_fn``).  None = the family predates
+      chunked prefill and the engine falls back to monolithic only.
 
     Pools are ``[layers, num_blocks, block_tokens, heads, head_dim]``
     of ``cache_dtype``; ``max_len`` bounds prompt + generated length
@@ -58,6 +68,7 @@ class DecodeSpec:
     cache_dtype: Any
     prefill_fn: Callable[..., Tuple[Any, Any, Any]]
     decode_fn: Callable[..., Tuple[Any, Any, Any]]
+    chunk_fn: Optional[Callable[..., Tuple[Any, Any, Any]]] = None
 
 
 @dataclass(frozen=True)

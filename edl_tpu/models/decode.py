@@ -59,6 +59,57 @@ def write_prefill_kv(kpool_l, vpool_l, tables, k, v):
     )
 
 
+def write_chunk_kv(kpool_l, vpool_l, tables, offsets, k, v):
+    """Scatter one prompt CHUNK's per-layer K/V ``[B, C, H, D]`` into
+    the pool at each row's block-aligned cache offset ``offsets[i]``
+    (the positions already filled by earlier chunks).  ``C`` must be a
+    multiple of block_tokens and ``offsets`` block-aligned — the
+    chunked-prefill scheduler only splits prompts at block boundaries
+    (the final chunk pads to its bucket like monolithic prefill).
+    Returns the updated pools."""
+    nb, bt, h, d = kpool_l.shape
+    b, c = k.shape[0], k.shape[1]
+    nblk = c // bt
+    idx = (offsets // bt)[:, None] + jnp.arange(nblk)[None, :]
+    blocks = jnp.take_along_axis(tables, idx, axis=1)  # [B, nblk]
+    k_b = k.reshape(b, nblk, bt, h, d)
+    v_b = v.reshape(b, nblk, bt, h, d)
+    return (
+        kpool_l.at[blocks].set(k_b.astype(kpool_l.dtype)),
+        vpool_l.at[blocks].set(v_b.astype(vpool_l.dtype)),
+    )
+
+
+def paged_chunk_attention(q, kpool_l, vpool_l, tables, offsets):
+    """Chunk-prefill attention over the paged cache.
+
+    ``q``: [B, C, H, D] — a prompt chunk whose global positions are
+    ``offsets[i] + c`` (its own K/V already written to the pool, so it
+    attends to itself AND every previously-filled position).  Gathers
+    each row's cache window through its (window-truncated) block table
+    — the engine passes only the first ``past_bucket + chunk_bucket``
+    blocks, so compute scales with the filled prefix, not the full
+    context — masks keys beyond each query's global position (causal
+    over the whole prefix), and returns [B, C, H, D] in f32."""
+    nb, bt, h, d = kpool_l.shape
+    b, mb = tables.shape
+    c = q.shape[1]
+    m = mb * bt
+    k_g = kpool_l[tables].reshape(b, m, h, d)
+    v_g = vpool_l[tables].reshape(b, m, h, d)
+    scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q.astype(jnp.float32),
+        k_g.astype(jnp.float32),
+    ) * scale
+    qpos = offsets[:, None] + jnp.arange(c)[None, :]  # [B, C] global
+    mask = jnp.arange(m)[None, None, :] <= qpos[:, :, None]  # [B, C, m]
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v_g.astype(jnp.float32))
+
+
 def write_decode_kv(kpool_l, vpool_l, tables, lengths, k, v):
     """Scatter one new token's K/V ``[B, H, D]`` at each row's current
     position ``lengths[i]`` through its block table.  Padding rows
@@ -122,31 +173,54 @@ def cache_abstract(
 
 class LayerKV:
     """Per-layer cache view threaded through a model's attention
-    modules.  ``prefill`` switches the two phases (a STATIC flag: the
-    engine compiles prefill and decode as separate executables).
+    modules.  ``mode`` switches the three phases (STATIC: the engine
+    compiles prefill, chunk and decode as separate executables):
+
+    - ``"prefill"`` — monolithic prompt forward (ISSUE 13): attention
+      stays the module's own causal path, K/V scatter from position 0.
+    - ``"chunk"``   — one block-aligned prompt SLICE at an explicit
+      cache offset (ISSUE 14): K/V scatter at ``offsets``, attention
+      runs over the gathered cache window (self + every previously-
+      filled position, causally masked).
+    - ``"decode"``  — one token per row at ``lengths``.
 
     Attention modules call exactly two hooks:
 
     - ``write(k, v)`` — scatter this layer's new K/V; returns the
       updated (kpool_l, vpool_l) which the module must thread back out.
-    - ``attend(q, kpool_l, vpool_l)`` — decode-phase paged attention
-      ([B, 1, H, D] query -> [B, 1, H, D] f32); prefill-phase attention
-      stays the module's own causal path (the math the train step
-      uses).
+    - ``attend(q, kpool_l, vpool_l)`` — paged attention for the
+      chunk/decode phases ([B, T, H, D] query -> [B, T, H, D] f32);
+      prefill-phase attention stays the module's own causal path (the
+      math the train step uses), gated module-side on ``prefill``.
     """
 
-    def __init__(self, kpool_l, vpool_l, tables, lengths, prefill: bool):
+    def __init__(
+        self, kpool_l, vpool_l, tables, lengths, prefill, offsets=None
+    ):
         self.kpool_l = kpool_l
         self.vpool_l = vpool_l
         self.tables = tables
         self.lengths = lengths
-        self.prefill = prefill
+        #: accepts the legacy bool (True = monolithic prefill, False =
+        #: decode) or the string "chunk"
+        self.mode = (
+            "chunk"
+            if prefill == "chunk"
+            else ("prefill" if prefill else "decode")
+        )
+        self.prefill = self.mode == "prefill"
+        self.offsets = offsets
 
     def write(self, k, v):
-        """k, v: [B, P, H, D] (prefill) or [B, 1, H, D] (decode)."""
-        if self.prefill:
+        """k, v: [B, P, H, D] (prefill), [B, C, H, D] (chunk) or
+        [B, 1, H, D] (decode)."""
+        if self.mode == "prefill":
             return write_prefill_kv(
                 self.kpool_l, self.vpool_l, self.tables, k, v
+            )
+        if self.mode == "chunk":
+            return write_chunk_kv(
+                self.kpool_l, self.vpool_l, self.tables, self.offsets, k, v
             )
         return write_decode_kv(
             self.kpool_l,
@@ -158,7 +232,13 @@ class LayerKV:
         )
 
     def attend(self, q, kpool_l, vpool_l):
-        """Decode-phase paged attention (q: [B, 1, H, D])."""
+        """Paged attention: chunk phase (q: [B, C, H, D]) attends over
+        the whole filled prefix; decode phase (q: [B, 1, H, D]) over
+        the cache at ``lengths``."""
+        if self.mode == "chunk":
+            return paged_chunk_attention(
+                q, kpool_l, vpool_l, self.tables, self.offsets
+            )
         out = paged_decode_attention(
             q[:, 0], kpool_l, vpool_l, self.tables, self.lengths
         )

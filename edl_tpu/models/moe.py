@@ -197,13 +197,18 @@ class MoEBlock(nn.Module):
             x = x + attn(h)
             pools = None
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
-        # Decode routes PER TOKEN (group 1): capacity grouping couples
-        # tokens within a group, and a decode batch groups UNRELATED
-        # sequences — per-token routing keeps each sequence's output a
-        # pure function of its own history (and capacity never binds:
-        # cap = max(1, 1.25/E) = 1 with position always 0).  The group
-        # width is routing-only (no params), so the swap is free.
-        group = 1 if (kv is not None and not kv.prefill) else self.group
+        # The WHOLE serving path routes PER TOKEN (group 1): capacity
+        # grouping couples tokens within a group — a decode batch
+        # groups UNRELATED sequences, and a chunked prefill (ISSUE 14)
+        # regroups the SAME sequence differently per chunk split — so
+        # per-token routing keeps each sequence's output a pure
+        # function of its own tokens, independent of batch-mates AND
+        # of where the scheduler cut its prompt (capacity never binds:
+        # cap = max(1, 1.25/E) = 1 with position always 0, so chunked
+        # and monolithic prefill emit identical tokens).  The group
+        # width is routing-only (no params), so the swap is free;
+        # training keeps the capacity grouping.
+        group = 1 if kv is not None else self.group
         out = x + MoEMlp(
             self.d_model,
             self.d_ff,
@@ -246,9 +251,18 @@ class MoELM(nn.Module):
         )
         if kv is not None:
             # Incremental decode (see TransformerLM.__call__): cache
-            # tuple threaded per layer, features + pools returned.
-            kpool, vpool, tables, lengths, prefill = kv
-            if prefill:
+            # tuple threaded per layer, features + pools returned; the
+            # six-tuple form selects chunked prefill at ``offsets``.
+            offsets = None
+            if len(kv) == 6:
+                kpool, vpool, tables, lengths, offsets, prefill = kv
+            else:
+                kpool, vpool, tables, lengths, prefill = kv
+            if prefill == "chunk":
+                T = tokens.shape[1]
+                cpos = offsets[:, None] + jnp.arange(T)[None, :]
+                x = (embed(tokens) + pos[cpos]).astype(self.dtype)
+            elif prefill:
                 T = tokens.shape[1]
                 x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
             else:
@@ -257,7 +271,8 @@ class MoELM(nn.Module):
                 ).astype(self.dtype)
             for i in range(self.num_layers):
                 layer_kv = LayerKV(
-                    kpool[i], vpool[i], tables, lengths, prefill
+                    kpool[i], vpool[i], tables, lengths, prefill,
+                    offsets=offsets,
                 )
                 x, (kl, vl) = MoEBlock(
                     self.num_heads,

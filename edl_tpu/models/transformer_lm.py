@@ -119,7 +119,12 @@ class TransformerLM(nn.Module):
         forward over the prompt while scattering every layer's K/V
         into the pool; decode takes ``tokens`` [B] (ONE token per row,
         embedded at position ``lengths[i]``) and attends through the
-        block table.  Returns (features, kpool', vpool')."""
+        block table.  A SIX-tuple ``(kpool, vpool, tables, lengths,
+        offsets, "chunk")`` selects chunked prefill (ISSUE 14):
+        ``tokens`` [B, C] is one block-aligned prompt slice embedded
+        at positions ``offsets[i] + c``, scattered at its offset, and
+        attending causally over every previously-filled position.
+        Returns (features, kpool', vpool')."""
         from edl_tpu.models.decode import LayerKV
 
         embed = nn.Embed(
@@ -134,8 +139,16 @@ class TransformerLM(nn.Module):
             (self.max_len, self.d_model),
         )
         if kv is not None:
-            kpool, vpool, tables, lengths, prefill = kv
-            if prefill:
+            offsets = None
+            if len(kv) == 6:
+                kpool, vpool, tables, lengths, offsets, prefill = kv
+            else:
+                kpool, vpool, tables, lengths, prefill = kv
+            if prefill == "chunk":
+                T = tokens.shape[1]
+                cpos = offsets[:, None] + jnp.arange(T)[None, :]
+                x = (embed(tokens) + pos[cpos]).astype(self.dtype)
+            elif prefill:
                 T = tokens.shape[1]
                 x = (embed(tokens) + pos[None, :T]).astype(self.dtype)
             else:
@@ -144,7 +157,8 @@ class TransformerLM(nn.Module):
                 ).astype(self.dtype)
             for i in range(self.num_layers):
                 layer_kv = LayerKV(
-                    kpool[i], vpool[i], tables, lengths, prefill
+                    kpool[i], vpool[i], tables, lengths, prefill,
+                    offsets=offsets,
                 )
                 x, (kl, vl) = LMBlock(
                     self.num_heads,
@@ -345,6 +359,22 @@ def lm_decode_spec(module, heads: int, d_model: int, L: int) -> DecodeSpec:
         ids = greedy_from_features(feats, params["embed"]["embedding"])
         return ids, kp, vp
 
+    def chunk_fn(params, tokens, offsets, lengths, kpool, vpool, tables):
+        # Chunked prefill (ISSUE 14): one block-aligned prompt slice at
+        # an explicit cache offset.  ``lengths`` = the TOTAL filled
+        # positions after this chunk (offset + true chunk length), so
+        # the greedy read lands on the prompt's last real position when
+        # this is the final chunk (the first sampled token — the one
+        # that must match monolithic prefill exactly).
+        feats, kp, vp = _apply(
+            params, tokens, (kpool, vpool, tables, lengths, offsets, "chunk")
+        )
+        last = jnp.clip(lengths - 1 - offsets, 0, tokens.shape[1] - 1)
+        ids = greedy_from_features(
+            feats, params["embed"]["embedding"], positions=last
+        )
+        return ids, kp, vp
+
     return DecodeSpec(
         layers=module.num_layers,
         heads=heads,
@@ -353,6 +383,7 @@ def lm_decode_spec(module, heads: int, d_model: int, L: int) -> DecodeSpec:
         cache_dtype=module.dtype,
         prefill_fn=prefill_fn,
         decode_fn=decode_fn,
+        chunk_fn=chunk_fn,
     )
 
 

@@ -19,6 +19,10 @@ iterations: requests join/leave the running batch at token
 boundaries, finished sequences release their blocks the same
 iteration they emit EOS, and a checkpoint hot swap re-prefills
 in-flight sequences so no sequence ever mixes weight generations.
+Long prompts prefill in block-aligned CHUNKS under a per-iteration
+token budget riding beside the decode step (Sarathi-Serve's
+stall-free batching), so an admission never stalls the running
+batch's token cadence.
 """
 
 from edl_tpu.serving.batcher import (
@@ -34,6 +38,7 @@ from edl_tpu.serving.engine import (
     InferenceEngine,
     KVBlockPool,
     NotReadyError,
+    PromptTooLongError,
 )
 from edl_tpu.serving.server import ServingReplica, ServingServer, serve_run
 
@@ -45,6 +50,7 @@ __all__ = [
     "InferenceEngine",
     "KVBlockPool",
     "NotReadyError",
+    "PromptTooLongError",
     "QueueFullError",
     "ServingReplica",
     "ServingServer",

@@ -286,7 +286,9 @@ def jax_tree_slice(outputs: Dict[str, np.ndarray], lo: int, hi: int):
 # -- per-token continuous batching (the true-Orca path) ----------------------
 
 #: GenerateTicket lifecycle states
-_QUEUED, _DECODING, _DONE = "queued", "decoding", "done"
+_QUEUED, _PREFILLING, _DECODING, _DONE = (
+    "queued", "prefilling", "decoding", "done",
+)
 
 
 class GenerateTicket:
@@ -304,7 +306,8 @@ class GenerateTicket:
     __slots__ = (
         "prompt", "max_new", "deadline", "eos_id", "enqueued", "on_event",
         "state", "blocks", "table", "length", "last_token", "tokens",
-        "restarts", "last_time", "_done", "_result", "_error",
+        "restarts", "last_time", "prefilled", "chunks", "first_time",
+        "_done", "_result", "_error",
     )
 
     def __init__(
@@ -331,6 +334,16 @@ class GenerateTicket:
         self.tokens: List[int] = []
         self.restarts = 0
         self.last_time = 0.0
+        #: prompt positions already written by prefill chunks (chunked
+        #: admission splits the prompt; a hot swap resets this to 0)
+        self.prefilled = 0
+        #: prefill dispatches this request paid (monolithic = 1 per
+        #: prefill; chunked = one per chunk, cumulative over restarts)
+        self.chunks = 0
+        #: wall time of the FIRST ever emitted token — TTFT spans
+        #: enqueue -> first token across ALL chunks (ISSUE 14
+        #: satellite), and a restart never moves it
+        self.first_time: Optional[float] = None
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -376,17 +389,30 @@ class TokenContinuousBatcher:
        generation a finished sequence reports produced every one of
        its tokens.
     2. **join** — queued requests are admitted while decode slots and
-       KV blocks last; each pays its own bucketed prefill and emits
-       its first token (the TTFT moment).
+       KV blocks last.  With **chunked prefill** (ISSUE 14, the
+       default when the model declares a ``chunk_fn``) an admitted
+       request enters a FIFO of partially-prefilled sequences and its
+       prompt is fed through chunk executables under a per-iteration
+       **token budget** — at most ``prefill_token_budget`` prompt
+       tokens per iteration ride beside the decode step, so a long
+       admission NEVER blocks the token cadence (Sarathi-Serve's
+       stall-free posture, PAPERS.md); the sequence joins decode only
+       when its last chunk lands (the TTFT moment, measured from
+       enqueue across ALL chunks).  With ``chunked_prefill=False``
+       each join pays one monolithic bucketed prefill (the PR 13
+       posture — kept as the bench interference A/B).
     3. **decode** — ONE token of compute for every active sequence
        (bucketed by count; block tables absorb ragged lengths).
        Finished sequences (EOS / token budget / context cap / past
        deadline) resolve and release their KV blocks the SAME
-       iteration.
+       iteration — half-prefilled sequences release theirs at expiry
+       too.
 
     Admission semantics carry over from the single-shot batcher
     unchanged: bounded queue -> ``QueueFullError`` (HTTP 429 +
-    Retry-After), queued-dead requests expire instead of computing.
+    Retry-After), queued-dead requests expire instead of computing; a
+    prompt longer than the context cap raises the engine's typed
+    ``PromptTooLongError`` at submit, never mid-chunk.
     """
 
     def __init__(
@@ -397,6 +423,8 @@ class TokenContinuousBatcher:
         default_max_new: int = 16,
         refresh: bool = True,
         chaos=None,
+        chunked_prefill: Optional[bool] = None,
+        prefill_token_budget: int = 0,
     ):
         self.engine = engine
         self.queue_limit = int(queue_limit)
@@ -406,8 +434,35 @@ class TokenContinuousBatcher:
         #: this one still observes generation changes and re-prefills
         self.refresh = refresh
         self.chaos = chaos if chaos is not None else engine.chaos
+        spec = getattr(engine, "spec", None)
+        if chunked_prefill is None:
+            chunked_prefill = getattr(spec, "chunk_fn", None) is not None
+        elif chunked_prefill and getattr(spec, "chunk_fn", None) is None:
+            raise ValueError(
+                f"model {engine.model.name!r} declares no chunk_fn; "
+                "chunked prefill unavailable"
+            )
+        self.chunked_prefill = bool(chunked_prefill)
+        #: prompt tokens one iteration may spend on prefill chunks
+        #: beside its decode step (0 -> the engine's max chunk size);
+        #: clamped so every iteration can dispatch at least one block
+        self.prefill_token_budget = int(
+            prefill_token_budget
+            or getattr(engine, "max_chunk_tokens", 0)
+            or 64
+        )
         self._cv = threading.Condition()
         self._queue: deque = deque()
+        #: FIFO of admitted, partially-prefilled sequences (chunked
+        #: mode): the head is fed chunk-by-chunk under the budget; a
+        #: sequence joins ``_active`` when its last chunk lands
+        self._prefilling: deque = deque()
+        #: running token counters behind ``queued_prefill_tokens``:
+        #: queue prompt tokens (mutated under _cv beside every queue
+        #: mutation) and the FIFO's remaining tokens (worker-thread
+        #: owned, decremented per chunk)
+        self._queued_tokens = 0
+        self._prefilling_tokens = 0
         self._active: List[GenerateTicket] = []
         self._stop = False
         self._thread: Optional[threading.Thread] = None
@@ -415,7 +470,7 @@ class TokenContinuousBatcher:
         self._bound_step = -1
         self._bound_epoch = 0  # engine.cache_epoch last observed
         self.stats = {"iterations": 0, "prefills": 0, "swaps": 0,
-                      "restarts": 0}
+                      "restarts": 0, "chunks": 0}
 
         from edl_tpu import telemetry
 
@@ -434,6 +489,14 @@ class TokenContinuousBatcher:
         self._m_ttft = reg.histogram("edl_serve_ttft_seconds")
         self._m_intertoken = reg.histogram("edl_serve_intertoken_seconds")
         self._m_occupancy = reg.histogram("edl_serve_batch_occupancy")
+        self._m_chunks = reg.counter("edl_serve_prefill_chunks_total")
+        self._m_prefill_tokens = reg.counter(
+            "edl_serve_prefill_tokens_total"
+        )
+        self._g_prefill_queued = reg.gauge(
+            "edl_serve_prefill_queued_tokens"
+        )
+        self._m_stall = reg.histogram("edl_serve_prefill_stall_seconds")
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "TokenContinuousBatcher":
@@ -462,6 +525,20 @@ class TokenContinuousBatcher:
     @property
     def active_count(self) -> int:
         return len(self._active)
+
+    @property
+    def prefilling_count(self) -> int:
+        return len(self._prefilling)
+
+    @property
+    def queued_prefill_tokens(self) -> int:
+        """Prompt tokens still awaiting prefill (the chunk FIFO's
+        remaining work + every queued prompt) — the /healthz and
+        autoscaler pressure signal for chunked admission.  Running
+        counters, not a scan: /healthz threads read two ints (no deque
+        iteration racing the worker's mutations), and the worker's
+        per-token gauge update costs O(1), not O(queue depth)."""
+        return self._queued_tokens + self._prefilling_tokens
 
     # -- admission ----------------------------------------------------------
     def submit_generate(
@@ -500,6 +577,7 @@ class TokenContinuousBatcher:
                     retry_after=max(0.01, budget / 4),
                 )
             self._queue.append(ticket)
+            self._queued_tokens += int(prompt.shape[0])
             self._g_depth.set(len(self._queue))
             self._cv.notify()
         return ticket
@@ -525,6 +603,12 @@ class TokenContinuousBatcher:
                 "weights_generation": w_gen,
                 "restarts": t.restarts,
                 "prompt_tokens": int(t.prompt.shape[0]),
+                "prefill_chunks": t.chunks,
+                "ttft_s": (
+                    round(t.first_time - t.enqueued, 6)
+                    if t.first_time is not None
+                    else None
+                ),
             }
         )
 
@@ -544,13 +628,27 @@ class TokenContinuousBatcher:
         contract forbids."""
         restarted = list(self._active)
         self._active = []
+        # Half-prefilled sequences restart their chunking from ZERO
+        # too: their cache holds old-generation K/V.  They streamed no
+        # tokens, so no restart event and no restart count — requeue
+        # with progress reset is the whole story.
+        rewound = list(self._prefilling)
+        self._prefilling.clear()
+        self._prefilling_tokens = 0
         with self._cv:
+            for t in reversed(rewound):
+                self._free_blocks(t)
+                t.state = _QUEUED
+                t.prefilled = 0
+                self._queue.appendleft(t)
+                self._queued_tokens += int(t.prompt.shape[0])
             for t in reversed(restarted):
                 self._free_blocks(t)
                 t.state = _QUEUED
                 t.tokens = []
                 t.length = 0
                 t.last_token = 0
+                t.prefilled = 0
                 t.restarts += 1
                 t._event(
                     {
@@ -560,6 +658,7 @@ class TokenContinuousBatcher:
                     }
                 )
                 self._queue.appendleft(t)  # keep arrival order
+                self._queued_tokens += int(t.prompt.shape[0])
             self._g_depth.set(len(self._queue))
         if restarted:
             self.stats["restarts"] += len(restarted)
@@ -588,6 +687,7 @@ class TokenContinuousBatcher:
                 now = time.monotonic()
                 if t.deadline <= now:
                     self._queue.popleft()
+                    self._queued_tokens -= int(t.prompt.shape[0])
                     self._g_depth.set(len(self._queue))
                     self._m_requests.inc(status="expired")
                     t._reject(
@@ -600,6 +700,7 @@ class TokenContinuousBatcher:
                 if blocks is None:
                     return joined  # KV pressure: no more joins now
                 self._queue.popleft()
+                self._queued_tokens -= plen
                 self._g_depth.set(len(self._queue))
             t.blocks = blocks
             t.table = np.zeros(self.engine.blocks_per_seq, np.int32)
@@ -611,22 +712,160 @@ class TokenContinuousBatcher:
                 self._m_requests.inc(status="error")
                 t._reject(e)
                 continue
-            self.stats["prefills"] += 1
-            self._m_prefills.inc()
-            now = time.monotonic()
-            self._m_ttft.observe(now - t.enqueued)
-            t.state = _DECODING
-            t.length = plen
-            t.last_token = first
-            t.last_time = now
-            t.tokens.append(first)
-            t._event({"token": first, "i": 0})
-            self._m_tokens.inc()
-            self._active.append(t)
+            t.chunks += 1
+            self._join_decode(t, first, plen)
             joined += 1
-            if self._seq_finished(t):
-                self._finish(t)
         return joined
+
+    def _join_decode(self, t: GenerateTicket, first: int, plen: int) -> None:
+        """The TTFT moment: a fully-prefilled sequence emits its first
+        token and joins the running decode batch.  Shared by monolithic
+        join and the final chunk of a chunked prefill — TTFT is
+        observed from ``enqueued`` either way (never from the last
+        chunk's dispatch)."""
+        self.stats["prefills"] += 1
+        self._m_prefills.inc()
+        now = time.monotonic()
+        if t.first_time is None:
+            # TTFT observes ONCE per request, enqueue -> first EVER
+            # token (the catalog contract) — a hot-swap restart
+            # re-joins here but must not inject a second, inflated
+            # sample.
+            self._m_ttft.observe(now - t.enqueued)
+            t.first_time = now
+        t.state = _DECODING
+        t.length = plen
+        t.last_token = first
+        t.last_time = now
+        t.tokens.append(first)
+        t._event({"token": first, "i": 0})
+        self._m_tokens.inc()
+        self._active.append(t)
+        if self._seq_finished(t):
+            self._finish(t)
+
+    def _admit_chunked(self) -> int:
+        """Chunked-mode JOIN: pop queued requests into the prefill
+        FIFO while decode slots last (a prefilling sequence holds a
+        slot — it will join decode).  KV blocks are taken per CHUNK,
+        not up front, so admission itself is instant."""
+        joined = 0
+        while (
+            len(self._active) + len(self._prefilling)
+            < self.engine.max_seqs
+        ):
+            with self._cv:
+                if not self._queue:
+                    return joined
+                t = self._queue[0]
+                now = time.monotonic()
+                if t.deadline <= now:
+                    self._queue.popleft()
+                    self._queued_tokens -= int(t.prompt.shape[0])
+                    self._g_depth.set(len(self._queue))
+                    self._m_requests.inc(status="expired")
+                    t._reject(
+                        DeadlineExceededError("deadline passed while queued")
+                    )
+                    continue
+                self._queue.popleft()
+                self._queued_tokens -= int(t.prompt.shape[0])
+                self._g_depth.set(len(self._queue))
+            self._prefilling_tokens += int(t.prompt.shape[0]) - t.prefilled
+            t.state = _PREFILLING
+            if t.table is None:
+                t.table = np.zeros(self.engine.blocks_per_seq, np.int32)
+            self._prefilling.append(t)
+            joined += 1
+        return joined
+
+    def _prefill_iteration(self, weights) -> int:
+        """Feed the prefill FIFO's head at most ``prefill_token_budget``
+        prompt tokens of chunk dispatches (FIFO: a sequence's chunks
+        stay in admission order; the head finishes before the next
+        starts).  Non-final chunks are block-aligned so every chunk's
+        offset stays block-aligned; the final chunk pads to its bucket
+        and emits the first token (the sequence joins decode).
+        Returns how many chunks dispatched."""
+        eng = self.engine
+        bt = eng.block_tokens
+        budget = max(self.prefill_token_budget, bt)
+        epoch0 = getattr(eng, "cache_epoch", 0)
+        dispatched = 0
+        while budget > 0 and self._prefilling:
+            t = self._prefilling[0]
+            now = time.monotonic()
+            if t.deadline <= now:
+                # Expiry frees a half-prefilled sequence's blocks too.
+                self._prefilling.popleft()
+                self._prefilling_tokens -= (
+                    int(t.prompt.shape[0]) - t.prefilled
+                )
+                self._free_blocks(t)
+                self._m_requests.inc(status="expired")
+                t._reject(
+                    DeadlineExceededError("deadline passed mid-prefill")
+                )
+                continue
+            plen = int(t.prompt.shape[0])
+            rem = plen - t.prefilled
+            # Cap the chunk so its PADDED bucket still fits the context
+            # window: near the window's end, chunk_bucket_for(rem)
+            # could otherwise overshoot max_context and overflow the
+            # block table (offset is block-aligned and < max_context,
+            # so at least one block of room always exists).
+            room = eng.max_context - t.prefilled
+            cap = bt
+            for c in eng.chunk_buckets:
+                if c <= room:
+                    cap = c
+            clen = min(rem, cap, budget)
+            if clen < rem:
+                clen = (clen // bt) * bt
+                if clen == 0:
+                    break  # budget slice under one block: next iteration
+            bucket = eng.chunk_bucket_for(clen)
+            need = (t.prefilled + bucket) // bt - len(t.blocks)
+            if need > 0:
+                blocks = eng.pool.alloc(need)
+                if blocks is None:
+                    break  # KV pressure: the FIFO head waits its turn
+                for b in blocks:
+                    t.table[len(t.blocks)] = b
+                    t.blocks.append(b)
+            try:
+                first = eng.prefill_chunk(
+                    weights,
+                    t.prompt[t.prefilled : t.prefilled + clen],
+                    t.prefilled,
+                    t.table,
+                )
+            except BaseException as e:
+                self._prefilling.popleft()
+                self._prefilling_tokens -= plen - t.prefilled
+                self._free_blocks(t)
+                self._m_requests.inc(status="error")
+                t._reject(e)
+                if getattr(eng, "cache_epoch", 0) != epoch0:
+                    # The failed dispatch rebuilt the (donated) pools:
+                    # every other live sequence's cached K/V is gone.
+                    # Stop dispatching — the worker loop's epoch check
+                    # rewinds the FIFO and the active batch next
+                    # iteration.
+                    break
+                continue
+            t.prefilled += clen
+            t.chunks += 1
+            self._prefilling_tokens -= clen
+            budget -= clen
+            dispatched += 1
+            self.stats["chunks"] += 1
+            self._m_chunks.inc()
+            self._m_prefill_tokens.inc(clen)
+            if t.prefilled >= plen:
+                self._prefilling.popleft()
+                self._join_decode(t, first, plen)
+        return dispatched
 
     def _seq_finished(self, t: GenerateTicket) -> bool:
         if t.eos_id is not None and t.tokens and t.tokens[-1] == t.eos_id:
@@ -708,12 +947,14 @@ class TokenContinuousBatcher:
                 while (
                     not self._queue
                     and not self._active
+                    and not self._prefilling
                     and not self._stop
                 ):
                     self._cv.wait(timeout=0.5)
                 if self._stop:
                     queued = list(self._queue)
                     self._queue.clear()
+                    self._queued_tokens = 0
                     self._g_depth.set(0)
                     break
             # 1. swap check — at the token boundary only.  Guarded:
@@ -731,6 +972,7 @@ class TokenContinuousBatcher:
                 with self._cv:
                     queued = list(self._queue)
                     self._queue.clear()
+                    self._queued_tokens = 0
                     self._g_depth.set(0)
                 for t in queued:
                     self._m_requests.inc(status="error")
@@ -747,20 +989,47 @@ class TokenContinuousBatcher:
                 self._bound_gen = w.generation
                 self._bound_step = w.step
                 self._bound_epoch = epoch
-            # 2. token-boundary join; 3. one decode iteration.
-            progress = self._admit(w)
+            # 2. token-boundary join + budgeted prefill work;
+            # 3. one decode iteration for the active batch.  The time
+            # admission work holds up an already-running batch is the
+            # STALL the chunked scheduler exists to bound — measured
+            # here, per iteration, only when both sides were live.
+            had_active = bool(self._active)
+            t_pre = time.monotonic()
+            if self.chunked_prefill:
+                progress = self._admit_chunked()
+                prefill_work = self._prefill_iteration(w)
+            else:
+                progress = prefill_work = self._admit(w)
+            pre_dt = time.monotonic() - t_pre
+            if had_active and prefill_work:
+                self._m_stall.observe(pre_dt)
+            progress += prefill_work if self.chunked_prefill else 0
+            if getattr(self.engine, "cache_epoch", 0) != epoch:
+                # A failed (donated) dispatch during admission rebuilt
+                # the pools: the active batch's cached K/V is zeroed,
+                # so decoding it now would emit garbage — and a
+                # sequence finishing on that garbage token would
+                # resolve WRONG before the next iteration's epoch
+                # check could rewind it.  Skip straight to the rewind.
+                continue
             progress += self._decode_iteration(w)
             self._g_active.set(len(self._active))
             self._g_kv.set(self.engine.pool.occupancy())
-            if not progress and (self._active or self._queue):
+            self._g_prefill_queued.set(self.queued_prefill_tokens)
+            if not progress and (
+                self._active or self._queue or self._prefilling
+            ):
                 # Every live sequence is stalled (KV-block exhaustion)
                 # and nobody could join: nothing can change until a
                 # deadline expires or blocks free, so don't busy-spin.
                 time.sleep(0.01)
-        # stopped: nothing queued or active survives, resolve all.
-        for t in queued + list(self._active):
+        # stopped: nothing queued, prefilling or active survives.
+        for t in queued + list(self._prefilling) + list(self._active):
             self._free_blocks(t)
             self._m_requests.inc(status="error")
             t._reject(RuntimeError("batcher stopped"))
+        self._prefilling.clear()
+        self._prefilling_tokens = 0
         self._active = []
         self._g_active.set(0)

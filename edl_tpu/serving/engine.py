@@ -44,6 +44,14 @@ class NotReadyError(RuntimeError):
     """No verified checkpoint has been loaded yet (the /healthz 503)."""
 
 
+class PromptTooLongError(ValueError):
+    """The prompt exceeds the engine's context window.  Raised at
+    ADMISSION (coerce_prompt) — a too-long prompt must be rejected
+    before it costs any compute or KV blocks, never discovered
+    mid-chunk.  Subclasses ValueError so the HTTP front's existing
+    400 mapping applies."""
+
+
 @dataclass(frozen=True)
 class _Weights:
     """One installed weight set.  Immutable and swapped atomically:
@@ -622,6 +630,14 @@ class DecodeEngine(InferenceEngine):
     - **decode** compiles per active-sequence-count bucket (powers of
       two up to ``max_seqs``); ragged sequence lengths ride ONE
       executable because the block tables absorb the raggedness.
+    - **chunk** (ISSUE 14) compiles per (chunk-bucket x past-length-
+      bucket): a block-aligned prompt SLICE carrying an explicit cache
+      offset, attending causally over every previously-filled position
+      through a window-truncated table.  The token batcher feeds these
+      under a per-iteration token budget so a long admission never
+      stalls the running decode cadence (Sarathi-Serve's stall-free
+      posture); the first sampled token is exact vs monolithic
+      prefill.
 
     Weights are passed EXPLICITLY (``current_weights()`` record): the
     token batcher binds one record per iteration, so a hot swap can
@@ -643,6 +659,7 @@ class DecodeEngine(InferenceEngine):
         block_tokens: int = 16,
         max_context: Optional[int] = None,
         num_blocks: Optional[int] = None,
+        max_chunk_tokens: Optional[int] = None,
     ):
         if model.decode is None:
             raise ValueError(
@@ -713,13 +730,44 @@ class DecodeEngine(InferenceEngine):
             p *= 2
         pbuckets.append(self.max_context)
         self.prompt_buckets: Tuple[int, ...] = tuple(pbuckets)
+        #: chunked-prefill chunk buckets (ISSUE 14): block-aligned
+        #: powers of two up to ``max_chunk_tokens`` — the largest
+        #: prompt slice one dispatch may carry.  Small by design: the
+        #: chunk IS the prefill/decode interference quantum, so its cap
+        #: bounds how long one admission can stall the running batch.
+        if max_chunk_tokens is None:
+            max_chunk_tokens = 4 * self.block_tokens
+        mc = max(
+            self.block_tokens,
+            min(
+                (max_chunk_tokens // self.block_tokens)
+                * self.block_tokens,
+                self.max_context,
+            ),
+        )
+        self.max_chunk_tokens = mc
+        cbuckets = []
+        c = self.block_tokens
+        while c < mc:
+            cbuckets.append(c)
+            c *= 2
+        cbuckets.append(mc)
+        self.chunk_buckets: Tuple[int, ...] = tuple(cbuckets)
         # Pools donated (argnums 3, 4 of (params, tokens, lengths,
         # kpool, vpool, tables)): steady-state decode reuses the cache
         # buffers in place instead of copying the pool every token.
         self._prefill_jit = jax.jit(spec.prefill_fn, donate_argnums=(3, 4))
         self._decode_jit = jax.jit(spec.decode_fn, donate_argnums=(3, 4))
-        #: ("prefill", P) / ("decode", B) -> held AOT executable
-        self._decode_compiled: Dict[Tuple[str, int], Any] = {}
+        # chunk_fn's pools sit after the extra offsets arg: (params,
+        # tokens, offsets, lengths, kpool, vpool, tables).
+        self._chunk_jit = (
+            jax.jit(spec.chunk_fn, donate_argnums=(4, 5))
+            if spec.chunk_fn is not None
+            else None
+        )
+        #: ("prefill", P) / ("decode", B) / ("chunk", C, window_blocks)
+        #: -> held AOT executable
+        self._decode_compiled: Dict[Tuple, Any] = {}
         #: bumped whenever the cache contents were lost (pool rebuilt
         #: after a failed dispatch): the token batcher re-prefills
         #: every live sequence when it sees a new epoch, exactly like
@@ -750,6 +798,25 @@ class DecodeEngine(InferenceEngine):
             f"{n} active sequences exceed max_seqs {self.max_seqs}"
         )
 
+    def chunk_bucket_for(self, n: int) -> int:
+        for c in self.chunk_buckets:
+            if n <= c:
+                return c
+        raise ValueError(
+            f"chunk of {n} tokens exceeds max_chunk_tokens "
+            f"{self.max_chunk_tokens}"
+        )
+
+    def chunk_window_blocks(self, offset: int, chunk_bucket: int) -> int:
+        """Table columns a chunk executable at ``offset`` gathers: the
+        smallest prompt bucket covering offset + chunk (so compute
+        scales with the filled prefix), in blocks.  This is the
+        past-length-bucket half of the (chunk-bucket x past-bucket)
+        executable key."""
+        return self.prompt_bucket_for(
+            min(offset + chunk_bucket, self.max_context)
+        ) // self.block_tokens
+
     def coerce_prompt(self, inputs: Dict[str, Any]) -> np.ndarray:
         """Validate one generate request's prompt: a 1-D (or [1, n])
         int token row, 1 <= n <= max_prompt."""
@@ -766,7 +833,15 @@ class DecodeEngine(InferenceEngine):
             )
         if not np.issubdtype(a.dtype, np.integer):
             raise ValueError(f"prompt dtype {a.dtype} is not integral")
-        if not 1 <= a.shape[0] <= self.max_prompt:
+        if a.shape[0] > self.max_prompt:
+            # Typed admission rejection (ISSUE 14 satellite): the HTTP
+            # front 400s it and the chunked scheduler never starts a
+            # prompt it could not finish.
+            raise PromptTooLongError(
+                f"prompt of {a.shape[0]} tokens exceeds max_prompt "
+                f"{self.max_prompt} (context {self.max_context})"
+            )
+        if a.shape[0] < 1:
             raise ValueError(
                 f"prompt of {a.shape[0]} tokens outside [1, "
                 f"{self.max_prompt}] (context {self.max_context})"
@@ -781,7 +856,8 @@ class DecodeEngine(InferenceEngine):
         warmed = super().warm(buckets)
         return warmed + self.warm_decode()
 
-    def _abs_decode_args(self, kind: str, n: int):
+    def _abs_decode_args(self, key: Tuple):
+        kind = key[0]
         spec = self.spec
         rep = self._replicated
         pool = jax.ShapeDtypeStruct(
@@ -791,33 +867,60 @@ class DecodeEngine(InferenceEngine):
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep),
             getattr(self._abstract_params, "params", self._abstract_params),
         )
-        if kind == "prefill":
-            tokens = jax.ShapeDtypeStruct((1, n), np.int32, sharding=rep)
+        if kind in ("prefill", "chunk"):
+            tokens = jax.ShapeDtypeStruct(
+                (1, key[1]), np.int32, sharding=rep
+            )
             rows = 1
         else:
-            tokens = jax.ShapeDtypeStruct((n,), np.int32, sharding=rep)
-            rows = n
+            tokens = jax.ShapeDtypeStruct((key[1],), np.int32, sharding=rep)
+            rows = key[1]
         lengths = jax.ShapeDtypeStruct((rows,), np.int32, sharding=rep)
+        if kind == "chunk":
+            # The chunk executable's table is TRUNCATED to its window
+            # (past-bucket + chunk-bucket blocks): the gather — and so
+            # the attention compute — scales with the filled prefix.
+            fn = spec.chunk_fn
+            offsets = jax.ShapeDtypeStruct((rows,), np.int32, sharding=rep)
+            tables = jax.ShapeDtypeStruct(
+                (rows, key[2]), np.int32, sharding=rep
+            )
+            return fn, (
+                abs_params, tokens, offsets, lengths, pool, pool, tables
+            ), (4, 5)
         tables = jax.ShapeDtypeStruct(
             (rows, self.blocks_per_seq), np.int32, sharding=rep
         )
         fn = spec.prefill_fn if kind == "prefill" else spec.decode_fn
-        return fn, (abs_params, tokens, lengths, pool, pool, tables)
+        return fn, (abs_params, tokens, lengths, pool, pool, tables), (3, 4)
+
+    def _chunk_keys(self) -> List[Tuple]:
+        """Every (chunk-bucket x past-length-bucket) executable key:
+        chunk buckets cross the window buckets (prompt buckets, in
+        blocks) that can contain them."""
+        keys = []
+        for c in self.chunk_buckets:
+            for w in self.prompt_buckets:
+                if w >= c:
+                    keys.append(("chunk", c, w // self.block_tokens))
+        return keys
 
     def warm_decode(self) -> int:
-        """AOT-compile + HOLD every prefill/decode bucket from abstract
-        shapes (zero device allocation).  Idempotent."""
+        """AOT-compile + HOLD every prefill/decode/chunk bucket from
+        abstract shapes (zero device allocation).  Idempotent."""
         warmed = 0
-        todo = [("prefill", p) for p in self.prompt_buckets]
+        todo: List[Tuple] = [("prefill", p) for p in self.prompt_buckets]
         todo += [("decode", b) for b in self.decode_buckets]
+        if self.spec.chunk_fn is not None:
+            todo += self._chunk_keys()
         for key in todo:
             if key in self._decode_compiled:
                 continue
-            fn, abs_args = self._abs_decode_args(*key)
+            fn, abs_args, donate = self._abs_decode_args(key)
             t0 = time.perf_counter()
             with self.mesh:
                 self._decode_compiled[key] = jax.jit(
-                    fn, donate_argnums=(3, 4)
+                    fn, donate_argnums=donate
                 ).lower(*abs_args).compile()
             dt = time.perf_counter() - t0
             self._m_compile_seconds.observe(dt)
@@ -841,16 +944,19 @@ class DecodeEngine(InferenceEngine):
     def _put(self, a: np.ndarray):
         return jax.device_put(a, self._replicated)
 
-    def _run(self, key: Tuple[str, int], params, tokens, lengths, tables):
-        fn = self._decode_compiled.get(key)
-        args = (
-            params,
-            self._put(tokens),
+    def _run(
+        self, key: Tuple, params, tokens, lengths, tables, offsets=None
+    ):
+        head = (params, self._put(tokens))
+        if key[0] == "chunk":
+            head = head + (self._put(offsets),)
+        args = head + (
             self._put(lengths),
             self.pool.kpool,
             self.pool.vpool,
             self._put(tables),
         )
+        fn = self._decode_compiled.get(key)
         try:
             with self.mesh:
                 if fn is not None:
@@ -858,11 +964,11 @@ class DecodeEngine(InferenceEngine):
                 else:
                     # Cold bucket (counted at the backend_compile seam)
                     # — steady state never lands here once warm() ran.
-                    jfn = (
-                        self._prefill_jit
-                        if key[0] == "prefill"
-                        else self._decode_jit
-                    )
+                    jfn = {
+                        "prefill": self._prefill_jit,
+                        "chunk": self._chunk_jit,
+                        "decode": self._decode_jit,
+                    }[key[0]]
                     ids, kp, vp = jfn(*args)
         except BaseException:
             # The pools were DONATED: after a failed dispatch the old
@@ -896,6 +1002,57 @@ class DecodeEngine(InferenceEngine):
             tok,
             np.asarray([plen], np.int32),
             np.asarray(table_row, np.int32)[None],
+        )
+        return int(ids[0])
+
+    def prefill_chunk(
+        self,
+        weights: _Weights,
+        chunk: np.ndarray,
+        offset: int,
+        table_row: np.ndarray,
+    ) -> int:
+        """Run ONE block-aligned prompt slice (1-D int32, true length)
+        at cache ``offset`` through the chunk executable for its
+        (chunk-bucket x past-length-bucket) pair.  Non-final chunks
+        must be block_tokens multiples so the next chunk's offset stays
+        block-aligned; the final chunk pads to its bucket like
+        monolithic prefill.  ``table_row`` is the sequence's FULL block
+        table — the window truncation happens here.  Returns the greedy
+        id read at the chunk's last real position (the first sampled
+        token when this is the prompt's final chunk)."""
+        if self.spec.chunk_fn is None:
+            raise ValueError(
+                f"model {self.model.name!r} declares no chunk_fn; use "
+                "monolithic prefill"
+            )
+        clen = int(chunk.shape[0])
+        offset = int(offset)
+        if offset % self.block_tokens != 0:
+            raise ValueError(
+                f"chunk offset {offset} not block-aligned "
+                f"(block_tokens {self.block_tokens})"
+            )
+        bucket = self.chunk_bucket_for(clen)
+        if offset + bucket > self.max_context:
+            # A padded bucket past the window would clamp the scatter's
+            # table gather and silently corrupt the last block's K/V —
+            # fail loudly instead; the batcher caps its chunks so the
+            # bucket always fits.
+            raise ValueError(
+                f"chunk bucket {bucket} at offset {offset} overruns the "
+                f"context window {self.max_context}; split the chunk"
+            )
+        wblk = self.chunk_window_blocks(offset, bucket)
+        tok = np.zeros((1, bucket), np.int32)
+        tok[0, :clen] = chunk
+        ids = self._run(
+            ("chunk", bucket, wblk),
+            weights.params,
+            tok,
+            np.asarray([offset + clen], np.int32),
+            np.asarray(table_row, np.int32)[None, :wblk],
+            offsets=np.asarray([offset], np.int32),
         )
         return int(ids[0])
 

@@ -14,7 +14,13 @@ One stdlib ``ThreadingHTTPServer`` per replica:
   per token as it decodes, a ``{"restart": true}`` line when a hot
   swap voids prior tokens (the sequence re-prefills on the new
   weights), and a final ``{"done": true, "tokens": [...]}`` line that
-  is the authoritative output.  Same 429/504/503 mapping as /predict.
+  is the authoritative output and carries the chunked-admission
+  receipts (``prefill_chunks``, ``ttft_s`` spanning enqueue to first
+  token across all chunks).  A long prompt prefills in block-aligned
+  chunks BESIDE the running batch's decode steps (ISSUE 14), so no
+  token line of another stream stalls behind this admission.  Same
+  429/504/503 mapping as /predict; a prompt over the context cap is a
+  typed 400 at admission, never a mid-generation error.
 - ``GET /healthz``   — readiness: weights step, warmed buckets, depth.
 - ``GET /metrics``   — Prometheus exposition of the process registry
   (the serving counters/histograms live there, so one scrape config
@@ -108,6 +114,16 @@ class ServingServer:
                             "decode_queue_depth": gen.depth,
                             "kv_occupancy": round(
                                 engine.pool.occupancy(), 4
+                            ),
+                            # chunked-prefill posture (ISSUE 14): how
+                            # admission shares iterations with decode
+                            "chunked_prefill": gen.chunked_prefill,
+                            "prefill_token_budget": (
+                                gen.prefill_token_budget
+                            ),
+                            "prefilling_sequences": gen.prefilling_count,
+                            "queued_prefill_tokens": (
+                                gen.queued_prefill_tokens
                             ),
                         }
                     self._reply(health, 200 if engine.ready else 503)
@@ -305,6 +321,16 @@ class ServingServer:
                         "weights_step": meta["weights_step"],
                         "weights_generation": meta["weights_generation"],
                         "restarts": meta["restarts"],
+                        # chunked-admission receipts (ISSUE 14): how
+                        # many prefill dispatches the prompt took, and
+                        # the enqueue->first-token TTFT the server
+                        # accounts for it (spans ALL chunks)
+                        "prefill_chunks": meta.get("prefill_chunks", 0),
+                        "ttft_ms": (
+                            round(meta["ttft_s"] * 1000.0, 3)
+                            if meta.get("ttft_s") is not None
+                            else None
+                        ),
                         "latency_ms": round(
                             (time.monotonic() - t0) * 1000.0, 3
                         ),

@@ -453,8 +453,42 @@ CATALOG = {
     },
     "edl_serve_ttft_seconds": {
         "type": "histogram",
-        "help": "Time to first token: admission to the prefill's first "
-        "generated token (the serving lane's decode overload signal).",
+        "help": "Time to first token: request ENQUEUE to the first "
+        "generated token — across every prefill chunk for chunked "
+        "admission, never from the last chunk's dispatch (the serving "
+        "lane's decode overload signal).",
+        "labels": (),
+    },
+    "edl_serve_prefill_chunks_total": {
+        "type": "counter",
+        "help": "Prefill chunk dispatches (ISSUE 14): block-aligned "
+        "prompt slices fed beside the decode step under the "
+        "per-iteration token budget.",
+        "labels": (),
+    },
+    "edl_serve_prefill_tokens_total": {
+        "type": "counter",
+        "help": "Prompt tokens prefilled through chunk dispatches "
+        "(true tokens, bucket padding excluded).",
+        "labels": (),
+    },
+    "edl_serve_prefill_queued_tokens": {
+        "type": "gauge",
+        "help": "Prompt tokens still awaiting prefill (queued prompts "
+        "+ the chunk FIFO's remaining work) — the chunked-admission "
+        "backpressure signal.",
+        "labels": (),
+    },
+    "edl_serve_prefill_stall_seconds": {
+        "type": "histogram",
+        "help": "Time one scheduler iteration's admission/prefill work "
+        "held up an already-running decode batch (the prefill/decode "
+        "interference quantum the chunked scheduler bounds; observed "
+        "only on iterations where both sides were live).",
+        "buckets": (
+            0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+            0.25, 0.5, 1.0, 2.5,
+        ),
         "labels": (),
     },
     "edl_serve_intertoken_seconds": {

@@ -1,0 +1,554 @@
+"""Chunked, decode-piggybacked prefill (ISSUE 14): stall-free token
+scheduling for long prompts.
+
+Key guarantees under test:
+
+- **exactness**: chunked prefill's first sampled token EXACTLY matches
+  monolithic prefill for every decode-capable family (transformer_lm,
+  moe_lm, longcontext_lm) — including prompts exactly at, one below
+  and one above a chunk boundary (the K/V a chunk scatters and the
+  causal window it attends over are the same math, split differently);
+- **stall-freedom**: a long admission's prompt rides chunk dispatches
+  under a per-iteration token budget BESIDE the running batch's decode
+  steps — already-active sequences keep emitting tokens while the
+  long prompt prefills (the Sarathi-Serve property the PR exists for);
+- **TTFT accounting**: TTFT spans ENQUEUE -> first token across every
+  chunk, never last-chunk-dispatch -> first token (regression);
+- **typed admission**: a prompt over the context cap raises
+  ``PromptTooLongError`` at submit, before any chunk runs;
+- **swap/expiry hygiene**: a hot swap mid-chunking restarts the
+  prompt's chunking from zero on the new weights; a deadline expiry
+  frees a half-prefilled sequence's KV blocks the same iteration;
+- **zero compiles**: the chunk executables are AOT-held per
+  (chunk-bucket x past-length-bucket) and the steady chunked path
+  performs zero XLA compiles.
+"""
+
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.serving import DecodeEngine, TokenContinuousBatcher
+from edl_tpu.serving.engine import PromptTooLongError
+
+from tests.test_decode_serving import _lm_state, _reference_decode
+
+_OPT = optax.adam(1e-3)
+
+
+def _engine_for(model, step=1, seed=1, **kw):
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, step, seed), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        **kw,
+    )
+    assert engine.load()
+    engine.warm()
+    return store, engine
+
+
+@pytest.fixture(scope="module")
+def chunked_lm():
+    """One warmed transformer_lm DecodeEngine with a SMALL chunk cap
+    (16 = one block) so modest prompts split into several chunks."""
+    model = get_model("transformer_lm", tiny=True)
+    store, engine = _engine_for(model, max_chunk_tokens=16)
+    return model, store, engine
+
+
+def _chunked_first_token(engine, weights, prompt, chunk=None):
+    """Drive engine.prefill_chunk over the whole prompt (the batcher's
+    split discipline: non-final chunks block-aligned, final chunk any
+    length) and return the last chunk's sampled id."""
+    bt = engine.block_tokens
+    chunk = chunk or engine.max_chunk_tokens
+    table = np.zeros(engine.blocks_per_seq, np.int32)
+    blocks = []
+    off, first = 0, None
+    plen = len(prompt)
+    while off < plen:
+        clen = min(chunk, plen - off)
+        if plen - off > clen:
+            clen = (clen // bt) * bt
+        bucket = engine.chunk_bucket_for(clen)
+        need = (off + bucket) // bt - len(blocks)
+        if need > 0:
+            got = engine.pool.alloc(need)
+            assert got is not None
+            for b in got:
+                table[len(blocks)] = b
+                blocks.append(b)
+        first = engine.prefill_chunk(
+            weights, np.asarray(prompt[off : off + clen]), off, table
+        )
+        off += clen
+    engine.pool.free(blocks)
+    return first
+
+
+# -- exactness: the acceptance criterion -------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name", ["transformer_lm", "moe_lm", "longcontext_lm"]
+)
+def test_chunked_first_token_exact_vs_monolithic_per_family(name):
+    """ISSUE 14 acceptance: chunked prefill's first sampled token ==
+    monolithic prefill's, per family, seeded.  Prompt lengths cover
+    exactly at / one below / one above a chunk boundary (32 with
+    chunk 16) plus a multi-chunk tail case.  MoE routing is per-token
+    on BOTH serving prefill paths, so the chunk split cannot move a
+    token between routing groups."""
+    model = get_model(name, tiny=True)
+    store, engine = _engine_for(model, max_chunk_tokens=16)
+    w = engine.current_weights()
+    rng = np.random.RandomState(3)
+    for plen in (31, 32, 33, 17, 50):
+        prompt = model.synth_batch(rng, 1)["tokens"][0, :plen]
+        blocks = engine.pool.alloc(engine.prompt_bucket_for(plen) // 16)
+        table = np.zeros(engine.blocks_per_seq, np.int32)
+        table[: len(blocks)] = blocks
+        mono = engine.prefill(w, prompt, table)
+        engine.pool.free(blocks)
+        chunked = _chunked_first_token(engine, w, list(prompt))
+        assert chunked == mono, (name, plen)
+    assert engine.pool.used_blocks == 0
+
+
+def test_chunk_boundary_prompts_end_to_end(chunked_lm):
+    """Prompts at/below/above the chunk boundary serve correctly
+    through the batcher: full token purity vs the reference decode,
+    and the chunk count is exactly ceil-by-bucket of the prompt."""
+    model, _, engine = chunked_lm
+    batcher = TokenContinuousBatcher(engine).start()
+    rng = np.random.RandomState(5)
+    try:
+        for plen, want_chunks in ((15, 1), (16, 1), (17, 2), (33, 3)):
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :plen]
+            toks, meta = batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=4
+            ).result(timeout=60)
+            assert meta["prefill_chunks"] == want_chunks, plen
+            w = engine.current_weights()
+            ref = _reference_decode(model, w.params, list(prompt), 4, engine)
+            assert toks == ref, plen
+    finally:
+        batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_prompt_over_context_cap_typed_error_at_admission(chunked_lm):
+    """A prompt longer than the context cap is rejected AT ADMISSION
+    with the typed error — before any chunk dispatches or any KV block
+    is taken — and the batcher keeps serving."""
+    model, _, engine = chunked_lm
+    batcher = TokenContinuousBatcher(engine)
+    too_long = list(range(engine.max_context))  # max_prompt + 1
+    chunks0 = batcher.stats["chunks"]
+    with pytest.raises(PromptTooLongError, match="max_prompt"):
+        batcher.submit_generate({"tokens": too_long})
+    assert isinstance(PromptTooLongError("x"), ValueError)  # HTTP 400
+    assert batcher.stats["chunks"] == chunks0
+    assert engine.pool.used_blocks == 0
+    rng = np.random.RandomState(0)
+    ok = model.synth_batch(rng, 1)["tokens"][0, :8]
+    batcher.start()
+    try:
+        toks, _ = batcher.submit_generate(
+            {"tokens": ok}, max_new_tokens=2
+        ).result(timeout=60)
+        assert len(toks) == 2
+    finally:
+        batcher.stop()
+
+
+# -- stall-freedom ------------------------------------------------------------
+
+
+def test_long_admission_never_stalls_running_decode(chunked_lm):
+    """The tentpole property: while a long prompt prefills chunk by
+    chunk, an already-running sequence keeps emitting tokens — at
+    least one decode token lands BETWEEN the long request's admission
+    and its first token (monolithic admission serializes instead: the
+    whole prompt runs before the next decode step)."""
+    model, _, engine = chunked_lm
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16
+    ).start()
+    rng = np.random.RandomState(7)
+    short = model.synth_batch(rng, 1)["tokens"][0, :5]
+    long = model.synth_batch(rng, 1)["tokens"][0, :48]
+    events = []
+    try:
+        ta = batcher.submit_generate(
+            {"tokens": short},
+            max_new_tokens=40,
+            on_event=lambda e: events.append(("a", time.monotonic(), e)),
+        )
+        deadline = time.monotonic() + 30
+        while not any("token" in e for _, _, e in events):
+            assert time.monotonic() < deadline
+            time.sleep(0.002)
+        tl = batcher.submit_generate(
+            {"tokens": long},
+            max_new_tokens=3,
+            on_event=lambda e: events.append(("l", time.monotonic(), e)),
+        )
+        toks_l, meta_l = tl.result(timeout=60)
+        toks_a, _ = ta.result(timeout=60)
+    finally:
+        batcher.stop()
+    assert meta_l["prefill_chunks"] == 3  # 48 tokens / 16-token chunks
+    t_l_first = next(
+        t for who, t, e in events if who == "l" and "token" in e
+    )
+    interleaved = sum(
+        1
+        for who, t, e in events
+        if who == "a" and "token" in e and t < t_l_first
+    )
+    assert interleaved >= 2, "running batch stalled behind the admission"
+    # and neither sequence's output was perturbed by the interleave
+    w = engine.current_weights()
+    assert toks_l == _reference_decode(
+        model, w.params, list(long), len(toks_l), engine
+    )
+    assert toks_a == _reference_decode(
+        model, w.params, list(short), len(toks_a), engine
+    )
+    assert engine.pool.used_blocks == 0
+
+
+def test_ttft_spans_enqueue_to_first_token_across_chunks(chunked_lm):
+    """Regression (ISSUE 14 satellite): with each chunk dispatch slowed
+    30ms, a 3-chunk prompt's reported TTFT must cover ALL chunks
+    (>= ~90ms) — an accounting that starts at the last chunk's
+    dispatch would report ~30ms."""
+    model, _, engine = chunked_lm
+    real = engine.prefill_chunk
+
+    def slow_chunk(weights, chunk, offset, table_row):
+        time.sleep(0.03)
+        return real(weights, chunk, offset, table_row)
+
+    engine.prefill_chunk = slow_chunk
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16
+    ).start()
+    rng = np.random.RandomState(9)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :48]
+    try:
+        toks, meta = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=2
+        ).result(timeout=60)
+    finally:
+        batcher.stop()
+        engine.prefill_chunk = real
+    assert meta["prefill_chunks"] == 3
+    assert meta["ttft_s"] >= 0.085, meta
+    assert engine.pool.used_blocks == 0
+
+
+# -- swap / expiry hygiene ----------------------------------------------------
+
+
+def test_hot_swap_mid_chunking_restarts_from_zero():
+    """A hot swap landing while a prompt is half-prefilled rewinds its
+    chunking to zero: the old-generation K/V is never mixed with new
+    weights, and the finished tokens equal the NEW generation's pure
+    reference decode."""
+    model = get_model("transformer_lm", tiny=True)
+    store, engine = _engine_for(model, max_chunk_tokens=16)
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16, default_deadline_s=120.0
+    )
+    rng = np.random.RandomState(11)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :48]
+    real = engine.prefill_chunk
+    swapped = []
+
+    def swapping_chunk(weights, chunk, offset, table_row):
+        if offset == 16 and not swapped:
+            # The long prompt is demonstrably mid-chunking: land a new
+            # verified checkpoint NOW.  The worker observes it at the
+            # next token boundary and must rewind this prompt.
+            swapped.append(True)
+            store.save_async(_lm_state(model, 2, 2), generation=2)
+            store.wait()
+        return real(weights, chunk, offset, table_row)
+
+    engine.prefill_chunk = swapping_chunk
+    batcher.start()
+    try:
+        toks, meta = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=4
+        ).result(timeout=120)
+    finally:
+        batcher.stop()
+        engine.prefill_chunk = real
+    assert swapped, "the swap never fired"
+    assert meta["weights_step"] == 2
+    # chunking restarted from zero: 2 chunks pre-swap + 3 post-swap
+    assert meta["prefill_chunks"] == 5, meta
+    ref = _reference_decode(
+        model,
+        jax.device_get(_lm_state(model, 2, 2).params),
+        list(prompt),
+        len(toks),
+        engine,
+    )
+    assert toks == ref
+    assert engine.pool.used_blocks == 0
+
+
+def test_expiry_frees_blocks_of_half_prefilled_sequence():
+    """A sequence whose deadline passes mid-chunking is expired and its
+    KV blocks freed the same iteration (half-prefilled sequences must
+    not leak pool blocks)."""
+    from edl_tpu.serving.batcher import DeadlineExceededError
+
+    model = get_model("transformer_lm", tiny=True)
+    _, engine = _engine_for(model, max_chunk_tokens=16)
+    real = engine.prefill_chunk
+
+    def slow_chunk(weights, chunk, offset, table_row):
+        time.sleep(0.05)
+        return real(weights, chunk, offset, table_row)
+
+    engine.prefill_chunk = slow_chunk
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16
+    ).start()
+    rng = np.random.RandomState(13)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :48]
+    try:
+        t = batcher.submit_generate(
+            {"tokens": prompt}, deadline_s=0.08
+        )
+        with pytest.raises(DeadlineExceededError):
+            t.result(timeout=30)
+        # give the worker one iteration to settle the gauge
+        deadline = time.monotonic() + 10
+        while engine.pool.used_blocks and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        batcher.stop()
+        engine.prefill_chunk = real
+    assert engine.pool.used_blocks == 0
+
+
+def test_pool_rebuild_mid_iteration_never_decodes_zeroed_cache():
+    """Review regression: a failed chunk dispatch rebuilds the DONATED
+    pools (cache_epoch bump) — the same worker iteration must NOT run
+    the decode step over the zeroed cache, or an active sequence
+    finishing on that garbage token resolves WRONG before the next
+    iteration's epoch check can rewind it.  Timed deterministically:
+    the failing admission is submitted from the active sequence's
+    4th-token event, so the corrupted decode would have been its 5th
+    and FINAL token."""
+    model = get_model("transformer_lm", tiny=True)
+    _, engine = _engine_for(model, max_chunk_tokens=16)
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16, default_deadline_s=60.0
+    )
+    rng = np.random.RandomState(29)
+    pa = model.synth_batch(rng, 1)["tokens"][0, :10]
+    pb = model.synth_batch(rng, 1)["tokens"][0, :20]
+    real = engine.prefill_chunk
+    boom = []
+
+    def failing_chunk(weights, chunk, offset, table_row):
+        # fail ONLY B's first chunk (16 tokens of its 20-token prompt;
+        # A's single chunk is 10) — A must already be decoding
+        if not boom and len(chunk) == 16:
+            boom.append(True)
+            # what engine._run does when a donated dispatch fails
+            engine.pool.rebuild()
+            engine.cache_epoch += 1
+            raise RuntimeError("device fell over mid-chunk")
+        return real(weights, chunk, offset, table_row)
+
+    engine.prefill_chunk = failing_chunk
+    errors = []
+
+    def on_a_event(ev):
+        if "token" in ev and ev["i"] == 3 and not boom:
+            try:
+                batcher.submit_generate({"tokens": pb}, max_new_tokens=2)
+            except BaseException as e:  # resolved later via its ticket
+                errors.append(e)
+
+    batcher.start()
+    try:
+        toks_a, meta_a = batcher.submit_generate(
+            {"tokens": pa}, max_new_tokens=5, on_event=on_a_event
+        ).result(timeout=60)
+    finally:
+        batcher.stop()
+        engine.prefill_chunk = real
+    assert boom and not errors
+    assert meta_a["restarts"] >= 1  # A was rewound, not served garbage
+    ref = _reference_decode(
+        model,
+        jax.device_get(engine.current_weights().params),
+        list(pa),
+        5,
+        engine,
+    )
+    assert toks_a == ref
+    assert engine.pool.used_blocks == 0
+    assert batcher.queued_prefill_tokens == 0
+
+
+def test_ttft_histogram_observes_once_despite_restart():
+    """Review regression: a hot-swap restart re-joins through
+    _join_decode but must not inject a second, inflated TTFT sample —
+    the histogram's contract is enqueue -> first EVER token, once."""
+    model = get_model("transformer_lm", tiny=True)
+    store, engine = _engine_for(model, max_chunk_tokens=16)
+    with telemetry.scoped() as (reg, _):
+        batcher = TokenContinuousBatcher(
+            engine, default_deadline_s=60.0
+        ).start()
+        rng = np.random.RandomState(31)
+        prompt = model.synth_batch(rng, 1)["tokens"][0, :10]
+        fired = []
+
+        def on_event(ev):
+            if "token" in ev and ev["i"] == 2 and not fired:
+                fired.append(True)
+                store.save_async(_lm_state(model, 2, 2), generation=2)
+                store.wait()
+
+        try:
+            toks, meta = batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=8, on_event=on_event
+            ).result(timeout=60)
+        finally:
+            batcher.stop()
+        assert meta["restarts"] >= 1
+        h = reg.histogram("edl_serve_ttft_seconds").series()
+        assert h["count"] == 1, h  # one sample despite the re-join
+        assert meta["ttft_s"] is not None
+
+
+def test_final_chunk_near_context_edge_cannot_overflow_table():
+    """Review regression: with a large chunk cap, the FINAL chunk's
+    padded bucket must not overshoot the context window — a 113-token
+    prompt in a 128-token window whose tail chunk would bucket to 64
+    at offset 80 previously overflowed the block table (IndexError on
+    the worker thread -> every request hung).  The scheduler must cap
+    the chunk to the room left and the engine must refuse an
+    overshooting bucket loudly."""
+    model = get_model("longcontext_lm", tiny=True)  # ctx 128
+    _, engine = _engine_for(model, max_chunk_tokens=64)
+    with pytest.raises(ValueError, match="overruns"):
+        engine.prefill_chunk(
+            engine.current_weights(),
+            np.zeros(33, np.int32),
+            80,
+            np.zeros(engine.blocks_per_seq, np.int32),
+        )
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=80
+    ).start()
+    rng = np.random.RandomState(23)
+    prompt = model.synth_batch(rng, 1)["tokens"][0, :113]
+    try:
+        toks, meta = batcher.submit_generate(
+            {"tokens": prompt}, max_new_tokens=3
+        ).result(timeout=60)
+    finally:
+        batcher.stop()
+    # 64 @ 0, 16 @ 64 (budget tail), then room caps: 32 @ 80, 1 @ 112
+    assert meta["prefill_chunks"] == 4, meta
+    w = engine.current_weights()
+    assert toks == _reference_decode(model, w.params, list(prompt), 3, engine)
+    assert engine.pool.used_blocks == 0
+    assert batcher.queued_prefill_tokens == 0
+
+
+# -- zero compiles ------------------------------------------------------------
+
+
+def test_chunked_steady_state_zero_xla_compiles(chunked_lm):
+    """Warm engine: the whole chunked path — multi-chunk admissions at
+    varied prompt lengths riding beside decode — dispatches held
+    (chunk-bucket x past-length-bucket) executables only."""
+    model, _, engine = chunked_lm
+    import jax._src.compiler as _compiler
+
+    batcher = TokenContinuousBatcher(
+        engine, prefill_token_budget=16, default_max_new=4
+    ).start()
+    rng = np.random.RandomState(17)
+    corpus = model.synth_batch(rng, 8)["tokens"]
+    real = _compiler.backend_compile
+    count = [0]
+
+    def counting(*a, **k):
+        count[0] += 1
+        return real(*a, **k)
+
+    _compiler.backend_compile = counting
+    try:
+        tickets = [
+            batcher.submit_generate(
+                {"tokens": corpus[i][: 7 + 8 * i]}, max_new_tokens=3 + i
+            )
+            for i in range(6)
+        ]
+        for t in tickets:
+            t.result(timeout=60)
+    finally:
+        _compiler.backend_compile = real
+        batcher.stop()
+    assert count[0] == 0, f"{count[0]} XLA compiles on the chunked path"
+    assert engine.pool.used_blocks == 0
+
+
+def test_stall_and_queued_token_metrics_published(chunked_lm):
+    """The new catalog metrics move: chunk dispatches counted, prompt
+    tokens counted unpadded, and the stall histogram observes only
+    when admission work held up a live batch."""
+    model, _, engine = chunked_lm
+    with telemetry.scoped() as (reg, _):
+        batcher = TokenContinuousBatcher(
+            engine, prefill_token_budget=16
+        ).start()
+        rng = np.random.RandomState(19)
+        short = model.synth_batch(rng, 1)["tokens"][0, :5]
+        long = model.synth_batch(rng, 1)["tokens"][0, :40]
+        try:
+            ta = batcher.submit_generate(
+                {"tokens": short}, max_new_tokens=30
+            )
+            time.sleep(0.02)  # let it start decoding
+            tl = batcher.submit_generate(
+                {"tokens": long}, max_new_tokens=2
+            )
+            tl.result(timeout=60)
+            ta.result(timeout=60)
+        finally:
+            batcher.stop()
+        assert reg.counter("edl_serve_prefill_chunks_total").value() >= 3
+        # true prompt tokens, not bucket padding: 40-token prompt =
+        # 16 + 16 + 8
+        assert (
+            reg.counter("edl_serve_prefill_tokens_total").value() >= 40
+        )
+        stall = reg.histogram("edl_serve_prefill_stall_seconds").series()
+        assert stall is not None and stall["count"] >= 1

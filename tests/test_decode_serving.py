@@ -284,14 +284,15 @@ def test_decode_engine_buckets_and_prompt_validation(lm_decode):
         engine.prompt_bucket_for(65)
     with pytest.raises(ValueError, match="missing"):
         engine.coerce_prompt({})
-    with pytest.raises(ValueError, match="outside"):
+    with pytest.raises(ValueError, match="max_prompt"):
         engine.coerce_prompt({"tokens": list(range(64))})
     with pytest.raises(ValueError, match="one token row"):
         engine.coerce_prompt({"tokens": [[1, 2], [3, 4]]})
-    # decode warm-held executables cover every bucket pair
-    kinds = dict.fromkeys(k for k, _ in engine.warm_decode_buckets)
-    assert list(kinds) == ["decode", "prefill"]
-    assert len(engine.warm_decode_buckets) == 6
+    # decode warm-held executables cover every bucket: 3 prefill +
+    # 3 decode + 6 chunked-prefill (chunk-bucket x window) pairs
+    kinds = dict.fromkeys(k[0] for k in engine.warm_decode_buckets)
+    assert list(kinds) == ["chunk", "decode", "prefill"]
+    assert len(engine.warm_decode_buckets) == 12
 
 
 def test_decode_steady_state_zero_xla_compiles(lm_decode):
@@ -825,6 +826,14 @@ def test_cli_metrics_prints_decode_stats(capsys):
     for _ in range(470):
         it.observe(0.002)
     reg.gauge("edl_serve_kv_occupancy").set(0.625)
+    # chunked-prefill stats (ISSUE 14 satellite): queued tokens, chunk
+    # iterations, and the stall the admission imposed
+    reg.counter("edl_serve_prefill_chunks_total").inc(37)
+    reg.counter("edl_serve_prefill_tokens_total").inc(1850)
+    reg.gauge("edl_serve_prefill_queued_tokens").set(96)
+    st = reg.histogram("edl_serve_prefill_stall_seconds")
+    for _ in range(20):
+        st.observe(0.004)
     coord.report_telemetry("serve-0", snapshot=reg.snapshot(), seq=1)
     server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
         evict=False
@@ -837,5 +846,9 @@ def test_cli_metrics_prints_decode_stats(capsys):
         assert "ttft_p50" in out and "ttft_p95" in out
         assert "intertoken_p95" in out
         assert "kv_slot_occupancy" in out and "0.625" in out
+        assert "prefill_chunks_total" in out and "37" in out
+        assert "prefill_tokens_total" in out and "1850" in out
+        assert "queued_prefill_tokens" in out and "96" in out
+        assert "prefill_stall_p95" in out
     finally:
         server.stop()

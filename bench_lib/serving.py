@@ -254,6 +254,142 @@ def bench_serving() -> dict:
         "scale_up": scale_up,
         "decode": bench_decode(),
         "interference": bench_interference(),
+        "drain": bench_drain(),
+    }
+
+
+def bench_drain() -> dict:
+    """Graceful-drain section (ISSUE 15): repeated drain rounds of a
+    replica under live open-loop load, with a survivor replica taking
+    the redirected traffic.  Per round: admission closes (later
+    submissions raise the typed DrainingError and are resubmitted to
+    the survivor — the client 503-retry contract), every in-flight
+    request completes, and the drain latency (admission close ->
+    drained + deregister-ready) is measured.  Gated: dropped == 0 and
+    drain latency p95 under the threshold — "scale-down never deletes
+    an undrained replica" as a structural bench invariant."""
+    import threading
+    import time
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import (
+        ContinuousBatcher,
+        DrainingError,
+        InferenceEngine,
+        ServingReplica,
+    )
+
+    model = get_model("mnist")
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adam(1e-3)
+    store = HostDRAMStore()
+    store.save_async(
+        TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt.init(params),
+        )
+    )
+    store.wait()
+
+    def _engine():
+        e = InferenceEngine(
+            model, store, devices=jax.devices()[:1], max_batch=32
+        )
+        e.load()
+        e.warm()
+        return e
+
+    victim_engine = _engine()
+    survivor = ContinuousBatcher(
+        _engine(), queue_limit=8192, default_deadline_s=60.0
+    ).start()
+    reg = telemetry.get_registry()
+    m_requests = reg.counter("edl_serve_requests_total")
+
+    def _failures():
+        return (
+            m_requests.value(status="error")
+            + m_requests.value(status="expired")
+            + m_requests.value(status="rejected")
+        )
+
+    rng = np.random.RandomState(0)
+    pool = model.synth_batch(rng, 64)["image"]
+    rounds = 5
+    err0 = _failures()
+    latencies_ms = []
+    redirected_total = 0
+    drained_all = True
+    completed_in_flight = 0
+    try:
+        for n in range(rounds):
+            batcher = ContinuousBatcher(
+                victim_engine, queue_limit=8192, default_deadline_s=60.0
+            )
+            replica = ServingReplica(
+                victim_engine,
+                batcher=batcher,
+                replica_id=f"bench-drain-{n}",
+                heartbeat_interval=60.0,
+            )
+            replica.start()
+            stop = threading.Event()
+            tickets = []
+            redirected = [0]
+
+            def driver():
+                i = 0
+                while not stop.is_set():
+                    row = pool[i % len(pool)][None]
+                    try:
+                        tickets.append(batcher.submit({"image": row}))
+                    except DrainingError:
+                        # the 503-retry contract: route to a survivor
+                        redirected[0] += 1
+                        tickets.append(
+                            survivor.submit({"image": row})
+                        )
+                    i += 1
+                    time.sleep(0.001)
+
+            th = threading.Thread(target=driver, daemon=True)
+            th.start()
+            time.sleep(0.05)  # load genuinely in flight
+            in_flight = batcher.in_flight
+            r = replica.drain(budget_s=30.0)
+            stop.set()
+            th.join(timeout=10)
+            for t in tickets:
+                t.result(timeout=120)  # every request completes SOMEWHERE
+            drained_all = drained_all and bool(r["drained"])
+            latencies_ms.append(round(r["seconds"] * 1000.0, 3))
+            redirected_total += redirected[0]
+            completed_in_flight += in_flight
+            replica.stop()
+    finally:
+        survivor.stop()
+    dropped = int(_failures() - err0)
+    assert drained_all, "a bench drain missed its budget"
+    assert dropped == 0, f"{dropped} requests dropped across drains"
+    ordered = sorted(latencies_ms)
+    return {
+        "rounds": rounds,
+        "drain_latency_ms": latencies_ms,
+        "drain_latency_p50_ms": ordered[len(ordered) // 2],
+        "drain_latency_p95_ms": ordered[-1],
+        "in_flight_completed": completed_in_flight,
+        "redirected_during_drain": redirected_total,
+        "dropped": dropped,
+        "drained_all": drained_all,
     }
 
 

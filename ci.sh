@@ -26,7 +26,7 @@ python tools/lint.py
 # thresholds, over the committed BENCH snapshot (or a fresh record
 # via EDL_BENCH_RECORD=path).  Milliseconds; a violated baseline
 # fails before the suite spends its budget.
-python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r09.json}" \
+python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r10.json}" \
   --thresholds bench_thresholds.json
 
 # Stress lane (EDL_STRESS=1): rerun the multipod elastic scale-down
@@ -34,7 +34,10 @@ python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r09.json}" \
 # 2/5 runs on a loaded box before the consensus step bus (data-plane
 # stop-step agreement), now expected green every iteration.  The
 # delayed-poll chaos test rides along: it provokes the exact poll-skew
-# shape deterministically.
+# shape deterministically.  Since ISSUE 15 the SERVING chaos soak
+# (kills + torn swap + wedged dispatch + drains + coordinator restart,
+# bit-identical journals per seed) reruns in the same loop — drain/
+# watchdog races are exactly the class a single green run can hide.
 if [ "${EDL_STRESS:-0}" = "1" ]; then
   N="${EDL_STRESS_N:-5}"
   # Post-mortem wiring: each iteration leaves a metrics snapshot +
@@ -44,8 +47,10 @@ if [ "${EDL_STRESS:-0}" = "1" ]; then
   export EDL_METRICS_ARTIFACT="${EDL_METRICS_ARTIFACT:-${TMPDIR:-/tmp}/edl-stress-metrics.prom}"
   for i in $(seq 1 "$N"); do
     echo "[stress] multipod scale-down iteration $i/$N"
-    if ! timeout -k 10 870 python -m pytest tests/test_multipod.py -x -q \
-      -k "elastic_1_2_1 or delayed_poll" -p no:cacheprovider "$@"; then
+    if ! timeout -k 10 870 python -m pytest \
+      tests/test_multipod.py tests/test_serving_chaos.py -x -q \
+      -k "elastic_1_2_1 or delayed_poll or serving_chaos" \
+      -p no:cacheprovider "$@"; then
       echo "[stress] FAILED iteration $i/$N"
       events="${EDL_METRICS_ARTIFACT%.prom}.events.jsonl"
       trace_out="${EDL_METRICS_ARTIFACT%.prom}.trace.json"

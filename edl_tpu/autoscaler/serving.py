@@ -31,6 +31,32 @@ from typing import Dict, List, Optional
 from edl_tpu.telemetry.aggregate import histogram_quantile
 
 
+def post_drain(
+    address: str, budget_s: float, timeout: Optional[float] = None
+) -> dict:
+    """POST /drain to one serving replica and block for its ack (the
+    reply carries ``drained``).  The scale-down actuators call this
+    per victim BEFORE touching the Deployment — drain-victim-ack-then-
+    patch, mirroring training's consensus victim-drain wait."""
+    import json
+    import urllib.request
+
+    if "://" not in address:
+        address = f"http://{address}"
+    req = urllib.request.Request(
+        address.rstrip("/") + "/drain",
+        data=json.dumps(
+            {"budget_ms": int(budget_s * 1000.0), "wait": True}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(
+        req, timeout=timeout if timeout is not None else budget_s + 5.0
+    ) as r:
+        return json.loads(r.read())
+
+
 class ServingLane:
     """One serving fleet's scaling loop (drive ``run_once`` from the
     controller tick, or ``run`` on a thread).
@@ -53,6 +79,7 @@ class ServingLane:
         hold_ticks: int = 2,
         on_scale=None,
         ttft_high_s: Optional[float] = None,
+        victim_drain_timeout: float = 10.0,
     ):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError(
@@ -73,6 +100,11 @@ class ServingLane:
         #: (None = TTFT is observed/journaled but does not actuate —
         #: single-shot fleets have no TTFT series at all)
         self.ttft_high_s = ttft_high_s
+        #: drain budget per scale-down victim (the serving analog of
+        #: the training scaler's victim_drain_timeout): a victim gets
+        #: this long to finish its in-flight generations before the
+        #: lane gives up for this tick and retries next tick
+        self.victim_drain_timeout = victim_drain_timeout
         self._low_ticks = 0
         #: cumulative rejected-request count at the previous tick: the
         #: overload signal is the per-tick DELTA, not the lifetime
@@ -245,6 +277,56 @@ class ServingLane:
             snap.get("target_world") or snap.get("world_size") or 0
         ) or self.min_replicas
 
+    # -- graceful scale-down (ISSUE 15) --------------------------------------
+    def drain_victims(self, current: int, proposed: int) -> dict:
+        """Drain-victim-ack-then-patch: before a scale-down's retarget
+        (and long before its Deployment patch), POST /drain to every
+        victim replica and wait for the ack — so the patch can never
+        yank a replica with live generations.  Victims are the plan's
+        rank-order tail (the members the coordinator drops when the
+        target shrinks); a victim with no address (in-process tests,
+        pre-drain fleets) or an UNREACHABLE one (already dead — there
+        is nothing live to yank) counts as acked.  A reachable victim
+        that could NOT finish inside ``victim_drain_timeout`` does
+        not: the caller skips the actuation this tick and retries —
+        the drain it started keeps running, so the retry usually
+        finds it finished."""
+        info: dict = {"victims": [], "acked": True}
+        if proposed >= current:
+            return info
+        plan_fn = getattr(self.coordinator, "plan", None)
+        plan = plan_fn() if callable(plan_fn) else None
+        if plan is None:
+            return info
+        members = list(plan.members)
+        addresses = list(plan.addresses)
+        addresses += [""] * (len(members) - len(addresses))
+        for rid, addr in list(zip(members, addresses))[proposed:]:
+            entry = {"replica": rid, "address": addr, "acked": True}
+            if addr:
+                try:
+                    r = post_drain(addr, self.victim_drain_timeout)
+                    entry["acked"] = bool(r.get("drained"))
+                except Exception as e:
+                    # ONLY connection-refused is evidence of death
+                    # (nothing listening -> nothing live to yank; the
+                    # lease reaper will drop it from the plan).  A
+                    # TIMEOUT is evidence of the opposite — a live
+                    # replica still draining — and any other error is
+                    # unknown: both fail CLOSED (not acked, patch
+                    # blocked, retried next tick; a genuinely dead
+                    # victim leaves the plan via lease eviction, so
+                    # blocking converges either way).
+                    reason = getattr(e, "reason", e)
+                    entry["acked"] = isinstance(
+                        reason, ConnectionRefusedError
+                    ) or isinstance(e, ConnectionRefusedError)
+                    entry["unreachable"] = True
+                    entry["error"] = type(e).__name__
+            info["victims"].append(entry)
+        info["acked"] = all(v["acked"] for v in info["victims"])
+        return info
+
     # -- one decision cycle -------------------------------------------------
     def run_once(self) -> Optional[dict]:
         """Observe -> propose -> actuate -> journal.  Returns the
@@ -258,32 +340,56 @@ class ServingLane:
         proposed, reason = self.desired_replicas(obs, current)
         actuated = False
         trace_id = ""
+        drain = None
         if proposed != current:
             from edl_tpu import telemetry
 
             trace_id = telemetry.new_trace_id()
-            # Prewarm FIRST (same ordering as the training lane's
-            # zero-stall handshake): a joining replica warms its
-            # bucketed forwards before the retarget routes traffic.
-            try:
-                self.coordinator.set_prewarm(proposed, trace_id=trace_id)
-            except Exception:
-                pass  # advisory; the retarget still scales
-            try:
-                self.coordinator.set_target_world(
-                    proposed, trace_id=trace_id
-                )
-                actuated = True
-                self._m_actuations.inc(
-                    direction="up" if proposed > current else "down"
-                )
-                if self.on_scale is not None:
-                    try:
-                        self.on_scale(current, proposed)
-                    except Exception:
-                        pass  # kube glue is best-effort; journal stands
-            except Exception as e:
-                reason += f"; retarget failed ({e})"
+            blocked = False
+            if proposed < current:
+                # Scale-down: drain-victim-ack-then-patch.  Victims
+                # close admission and finish their generations BEFORE
+                # the retarget drops them from the plan and the
+                # Deployment patch deletes their pods.  No ack inside
+                # the budget -> no actuation this tick (the started
+                # drain keeps running; next tick retries and patches).
+                try:
+                    drain = self.drain_victims(current, proposed)
+                except Exception as e:
+                    # A safety interlock fails CLOSED: if the drain
+                    # handshake itself broke (plan fetch raised, a
+                    # bug), the patch is blocked this tick — never
+                    # "drain skipped, delete anyway".
+                    drain = {"victims": [], "acked": False,
+                             "error": str(e)}
+                if not drain["acked"]:
+                    reason += "; victim drain not acked (retry next tick)"
+                    blocked = True
+            if not blocked:
+                # Prewarm FIRST (same ordering as the training lane's
+                # zero-stall handshake): a joining replica warms its
+                # bucketed forwards before the retarget routes traffic.
+                try:
+                    self.coordinator.set_prewarm(
+                        proposed, trace_id=trace_id
+                    )
+                except Exception:
+                    pass  # advisory; the retarget still scales
+                try:
+                    self.coordinator.set_target_world(
+                        proposed, trace_id=trace_id
+                    )
+                    actuated = True
+                    self._m_actuations.inc(
+                        direction="up" if proposed > current else "down"
+                    )
+                    if self.on_scale is not None:
+                        try:
+                            self.on_scale(current, proposed)
+                        except Exception:
+                            pass  # kube glue best-effort; journal stands
+                except Exception as e:
+                    reason += f"; retarget failed ({e})"
         entry = {
             "lane": "serving",
             "dry_run": {
@@ -296,6 +402,8 @@ class ServingLane:
             "reason": reason,
             "trace_id": trace_id,
         }
+        if drain is not None:
+            entry["drain"] = drain
         self.decision_log.append(entry)
         del self.decision_log[: -self.decision_log_max]
         data = {k: v for k, v in entry.items() if k != "trace_id"}

@@ -90,6 +90,21 @@ KNOWN_POINTS = (
     "serve.queue.full",          # force one admission rejection (the
                                  # reject-with-retry-after backpressure
                                  # path, independent of real depth)
+    # (8b) serving-plane fault tolerance (ISSUE 15)
+    "serve.replica.die",         # replica killed mid-generation, no
+                                 # drain (SIGKILL shape: in-flight
+                                 # requests fail and clients must
+                                 # retry against survivors)
+    "serve.dispatch.wedged",     # next prefill/chunk/decode dispatch
+                                 # treated as wedged (the serving
+                                 # watchdog's deterministic trip into
+                                 # pool-rebuild + re-prefill recovery)
+    "serve.drain.slow",          # drain wait stalls arg s per poll
+                                 # (exercises the bounded drain budget)
+    "serve.coord.unreachable",   # replica's serving coordinator
+                                 # vanishes for arg seconds — it must
+                                 # keep serving last-verified weights
+                                 # and reconverge on return
 )
 
 

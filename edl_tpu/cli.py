@@ -342,6 +342,27 @@ def cmd_metrics(args) -> int:
         wstep = gauges_all.get("edl_serve_weights_step") or {}
         if wstep:
             print(f"  {'weights_step':<24} {max(wstep.values()):g}")
+        # Per-replica drain posture (ISSUE 15): which replicas are
+        # serving / draining / drained, plus the drain counters — the
+        # operator view of a rolling scale-down.
+        drg = gauges_all.get("edl_serve_draining") or {}
+        _DRAIN_STATES = {0: "serving", 1: "draining", 2: "drained"}
+        for key in sorted(drg):
+            state = _DRAIN_STATES.get(int(drg[key]), "?")
+            print(f"  drain{{{key}}}{'':<8} {state}")
+        drains = counters_all.get("edl_serve_drains_total") or {}
+        if drains:
+            print(
+                f"  {'drains_total':<24} {sum(drains.values()):g}"
+            )
+        dsec = hists_all.get("edl_serve_drain_seconds") or {}
+        dcount = sum(h["count"] for h in dsec.values())
+        if dcount:
+            dsum = sum(h["sum"] for h in dsec.values())
+            print(
+                f"  {'drain_seconds_mean':<24} "
+                f"{dsum / dcount:.3f}"
+            )
         tok = counters_all.get("edl_serve_tokens_total") or {}
         if tok:
             # Decode stats (the token-iteration path): tokens/s is the

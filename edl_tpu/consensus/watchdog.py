@@ -32,9 +32,25 @@ class CollectiveTimeout(RuntimeError):
 class CollectiveWatchdog:
     """Deadline-guarded fetches.  ``timeout <= 0`` disables the guard
     (fetches run inline — the single-process default, where a wedge is
-    impossible and the thread hop would be pure overhead)."""
+    impossible and the thread hop would be pure overhead).
 
-    def __init__(self, timeout: float = 0.0, chaos=None, registry=None, recorder=None):
+    Reusers outside the consensus lane (the serving engine's decode
+    dispatch watchdog, ISSUE 15) keep the deadline-fetch machinery but
+    swap the *names*: ``chaos_check`` replaces the default
+    ``consensus.watchdog.trip`` chaos probe and ``on_trip`` replaces
+    the default consensus counter + flight event — both are plain
+    callables so every metric / event / chaos literal stays at ITS
+    call site (the lint gates check literals, not plumbing)."""
+
+    def __init__(
+        self,
+        timeout: float = 0.0,
+        chaos=None,
+        registry=None,
+        recorder=None,
+        chaos_check: Optional[Callable[[], bool]] = None,
+        on_trip: Optional[Callable[[str, float], None]] = None,
+    ):
         from edl_tpu import telemetry
 
         self.timeout = timeout
@@ -44,6 +60,8 @@ class CollectiveWatchdog:
         self._m_trips = self.registry.counter(
             "edl_consensus_watchdog_trips_total"
         )
+        self.chaos_check = chaos_check
+        self.on_trip = on_trip
         self.trips = 0
         self._lock = threading.Lock()
         self._q: Optional[queue.SimpleQueue] = None
@@ -86,6 +104,13 @@ class CollectiveWatchdog:
 
     def _trip(self, what: str, waited: float) -> None:
         self.trips += 1
+        if self.on_trip is not None:
+            # Reuser-owned accounting (e.g. the serving dispatch
+            # watchdog's edl_serve_dispatch_wedged_total +
+            # serve.watchdog event) — the consensus names stay out of
+            # lanes that aren't the consensus lane.
+            self.on_trip(what, waited)
+            return
         self._m_trips.inc()
         self.recorder.record(
             "consensus.watchdog",
@@ -99,14 +124,20 @@ class CollectiveWatchdog:
         ``consensus.watchdog.trip`` chaos event; otherwise returns
         ``fn()``'s value (exceptions propagate unchanged)."""
         chaos = self.chaos
-        if chaos is not None and chaos.due("consensus.watchdog.trip"):
-            # chaos[consensus.watchdog.trip]: the collective is wedged —
-            # the fetch would never return.  Report expiry without
-            # consuming the future (a dead world's future has no value).
+        tripped = (
+            self.chaos_check()
+            if self.chaos_check is not None
+            else chaos is not None and chaos.due("consensus.watchdog.trip")
+        )
+        if tripped:
+            # chaos[consensus.watchdog.trip] (or the reuser's probe):
+            # the collective is wedged — the fetch would never return.
+            # Report expiry without consuming the future (a dead
+            # world's future has no value).
             self._trip(what, 0.0)
             raise CollectiveTimeout(
-                f"chaos[consensus.watchdog.trip]: {what} fetch treated "
-                "as wedged"
+                f"chaos: {what} fetch treated as wedged (deterministic "
+                "watchdog trip)"
             )
         if self.timeout <= 0:
             return fn()

@@ -393,6 +393,14 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
     return [deployment, service]
 
 
+#: graceful-drain budget for serving replicas (EDL_SERVE_DRAIN_MS) and
+#: the pod grace period sized above it: SIGTERM -> drain (close
+#: admission, finish in-flight, free KV, deregister) -> exit, with the
+#: kubelet's SIGKILL arriving only after the budget + margin
+SERVE_DRAIN_MS = 30000
+SERVE_TERMINATION_GRACE_S = 45
+
+
 def serving_pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
     """Serving-replica pod environment: the ``EDL_SERVE_*`` contract
     (``edl_tpu.serving.server.serve_run`` reads it) plus the shared
@@ -416,6 +424,11 @@ def serving_pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
         {"name": "EDL_SERVE_MAX_BATCH", "value": str(sv.max_batch)},
         {"name": "EDL_SERVE_QUEUE_LIMIT", "value": str(sv.queue_limit)},
         {"name": "EDL_SERVE_DEADLINE_MS", "value": str(sv.deadline_ms)},
+        # graceful-drain budget: the SIGTERM handler closes admission
+        # and lets in-flight generations finish for this long before
+        # the replica exits (terminationGracePeriodSeconds below is
+        # sized ABOVE it so the kubelet's SIGKILL never beats a drain)
+        {"name": "EDL_SERVE_DRAIN_MS", "value": str(SERVE_DRAIN_MS)},
         {
             "name": "EDL_POD_NAME",
             "valueFrom": {"fieldRef": {"fieldPath": "metadata.name"}},
@@ -519,6 +532,11 @@ def parse_to_serving_manifests(job: TrainingJob) -> List[Dict[str, Any]]:
             "template": {
                 "metadata": {"labels": dict(labels)},
                 "spec": {
+                    # pod deletion = SIGTERM -> graceful drain; SIGKILL
+                    # only after the drain budget + margin has passed
+                    "terminationGracePeriodSeconds": (
+                        SERVE_TERMINATION_GRACE_S
+                    ),
                     "containers": [
                         {
                             "name": "server",

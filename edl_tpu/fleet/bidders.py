@@ -287,6 +287,23 @@ class ServingBidder:
             before = self.lane.current_replicas()
         except Exception:
             before = self.min_units
+        if units < before:
+            # Drain-victim-ack-then-patch (ISSUE 15): the market's
+            # serving scale-downs follow the SAME contract as the
+            # lane's — victims finish their in-flight generations
+            # before the retarget drops them and the Deployment patch
+            # deletes their pods.  No ack -> no actuation this tick;
+            # the arbiter's fixed point re-proposes next tick and the
+            # already-started drain is usually finished by then.
+            try:
+                drain = self.lane.drain_victims(before, units)
+            except Exception:
+                # fail CLOSED: a broken drain handshake blocks the
+                # actuation (the arbiter re-proposes next tick) —
+                # never "drain skipped, delete anyway"
+                drain = {"acked": False}
+            if not drain.get("acked", True):
+                return False
         try:
             self.coordinator.set_prewarm(units, trace_id=trace_id)
         except Exception:
@@ -303,6 +320,7 @@ class ServingBidder:
         return True
 
     def wait_drain(self, timeout: float) -> bool:
-        """Serving scale-downs have no training collective to quiesce;
-        chips free as soon as the retarget lands."""
+        """Serving scale-downs drain their victims inside ``actuate``
+        (drain-ack-then-patch), so by the time the arbiter asks, the
+        chips are genuinely free — no extra wait."""
         return True

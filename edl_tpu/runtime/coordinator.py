@@ -380,6 +380,15 @@ class LocalCoordinator:
             ]
             for tid in dead:
                 del self._members[tid]
+                # Lease expiry evicts the TELEMETRY too (ISSUE 15): a
+                # dead replica's frozen snapshot must stop feeding
+                # merged observations — its queue-depth gauge would
+                # pin the merged max and its latency histogram would
+                # haunt every quantile window (a ghost p95 steering
+                # the serving lane).  A live-but-evicted member
+                # re-registers and re-reports its cumulative snapshot,
+                # so the drop always reconverges.
+                self._telemetry.drop_source(tid)
             if dead:
                 self._recorder.record(
                     "coord.evict",

@@ -28,6 +28,7 @@ batch's token cadence.
 from edl_tpu.serving.batcher import (
     ContinuousBatcher,
     DeadlineExceededError,
+    DrainingError,
     GenerateTicket,
     QueueFullError,
     Ticket,
@@ -35,6 +36,7 @@ from edl_tpu.serving.batcher import (
 )
 from edl_tpu.serving.engine import (
     DecodeEngine,
+    DispatchWedgedError,
     InferenceEngine,
     KVBlockPool,
     NotReadyError,
@@ -46,6 +48,8 @@ __all__ = [
     "ContinuousBatcher",
     "DeadlineExceededError",
     "DecodeEngine",
+    "DispatchWedgedError",
+    "DrainingError",
     "GenerateTicket",
     "InferenceEngine",
     "KVBlockPool",

@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from edl_tpu.serving.engine import NotReadyError
+from edl_tpu.serving.engine import DispatchWedgedError, NotReadyError
 
 
 class QueueFullError(RuntimeError):
@@ -53,6 +53,18 @@ class QueueFullError(RuntimeError):
 
 class DeadlineExceededError(RuntimeError):
     """The request's deadline passed before its batch dispatched."""
+
+
+class DrainingError(RuntimeError):
+    """Admission closed: the replica is draining (graceful shutdown /
+    scale-down victim).  DISTINCT from ``QueueFullError`` on purpose —
+    the HTTP front maps this to 503 + Retry-After (go to another
+    replica; this one is leaving) where queue-full is 429 (back off
+    and retry HERE).  ``retry_after`` is the client hint in seconds."""
+
+    def __init__(self, msg: str, retry_after: float = 0.5):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 
 class Ticket:
@@ -108,6 +120,13 @@ class ContinuousBatcher:
         self._cv = threading.Condition()
         self._queue: deque = deque()
         self._stop = False
+        #: admission closed (drain): submit raises DrainingError while
+        #: queued + dispatching work runs to completion
+        self._draining = False
+        #: TICKETS the worker currently holds (popped off the queue
+        #: but not yet resolved) — same unit as len(_queue), so
+        #: ``in_flight`` counts requests consistently
+        self._busy = 0
         self._thread: Optional[threading.Thread] = None
         self.stats = {"batches": 0, "swaps": 0}
 
@@ -153,6 +172,24 @@ class ContinuousBatcher:
         with self._cv:
             return len(self._queue)
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        """Requests admitted but not yet resolved: queued + the batch
+        the worker currently holds.  The drain loop polls this to 0."""
+        with self._cv:
+            return len(self._queue) + self._busy
+
+    def close_admission(self) -> None:
+        """Enter drain: every later ``submit`` raises DrainingError
+        (503 + Retry-After at the HTTP front, distinct from 429
+        queue-full); already-admitted requests keep computing."""
+        with self._cv:
+            self._draining = True
+
     # -- admission ----------------------------------------------------------
     def submit(
         self,
@@ -160,8 +197,15 @@ class ContinuousBatcher:
         deadline_s: Optional[float] = None,
     ) -> Ticket:
         """Admit one request (1..max_batch rows).  Raises
-        ``QueueFullError`` on backpressure and ``ValueError`` on a
-        schema mismatch — both BEFORE the request costs any compute."""
+        ``QueueFullError`` on backpressure, ``DrainingError`` once
+        admission closed for a drain, and ``ValueError`` on a schema
+        mismatch — all BEFORE the request costs any compute."""
+        if self._draining:
+            self._m_requests.inc(status="draining")
+            raise DrainingError(
+                "replica draining: admission closed; retry another "
+                "replica"
+            )
         arrays, rows = self.engine.coerce_inputs(inputs)
         if rows < 1:
             raise ValueError("empty request (0 rows)")
@@ -175,6 +219,15 @@ class ContinuousBatcher:
         )
         ticket = Ticket(arrays, rows, time.monotonic() + budget)
         with self._cv:
+            if self._draining:
+                # Re-check under the lock: a drain closing admission
+                # concurrently with this submit must not see in-flight
+                # grow after it read the count.
+                self._m_requests.inc(status="draining")
+                raise DrainingError(
+                    "replica draining: admission closed; retry another "
+                    "replica"
+                )
             forced = self.chaos is not None and bool(
                 self.chaos.due("serve.queue.full")
             )
@@ -217,6 +270,7 @@ class ContinuousBatcher:
                 self._queue.popleft()
                 taken.append(t)
                 rows += t.rows
+            self._busy = len(taken)
             self._g_depth.set(len(self._queue))
         return taken
 
@@ -262,6 +316,8 @@ class ContinuousBatcher:
                 for t in batch:
                     self._m_requests.inc(status="error")
                     t._reject(e)
+                with self._cv:
+                    self._busy = 0
                 continue
             self._m_batches.inc()
             self._m_examples.inc(rows)
@@ -275,6 +331,8 @@ class ContinuousBatcher:
                 self._m_requests.inc(status="ok")
                 self._m_latency.observe(now - t.enqueued)
                 t._resolve(sl, dict(meta))
+            with self._cv:
+                self._busy = 0
 
 
 def jax_tree_slice(outputs: Dict[str, np.ndarray], lo: int, hi: int):
@@ -465,6 +523,9 @@ class TokenContinuousBatcher:
         self._prefilling_tokens = 0
         self._active: List[GenerateTicket] = []
         self._stop = False
+        #: admission closed (drain): submit_generate raises
+        #: DrainingError; queued/prefilling/active sequences finish
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._bound_gen = -1
         self._bound_step = -1
@@ -540,6 +601,31 @@ class TokenContinuousBatcher:
         per-token gauge update costs O(1), not O(queue depth)."""
         return self._queued_tokens + self._prefilling_tokens
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def in_flight(self) -> int:
+        """Sequences admitted but not yet resolved: queued + mid-
+        prefill + the active decode batch.  The drain loop polls this
+        to 0 — a drained replica's KV pool is empty by construction
+        (every finish path frees its blocks the same iteration)."""
+        with self._cv:
+            return (
+                len(self._queue)
+                + len(self._prefilling)
+                + len(self._active)
+            )
+
+    def close_admission(self) -> None:
+        """Enter drain: later ``submit_generate`` calls raise
+        DrainingError (HTTP 503 + Retry-After); every admitted
+        sequence — queued, mid-prefill, decoding — runs to its normal
+        finish and frees its KV blocks."""
+        with self._cv:
+            self._draining = True
+
     # -- admission ----------------------------------------------------------
     def submit_generate(
         self,
@@ -550,8 +636,15 @@ class TokenContinuousBatcher:
         on_event=None,
     ) -> GenerateTicket:
         """Admit one autoregressive request (a single prompt row).
-        Raises ``QueueFullError`` on backpressure and ``ValueError``
-        on a schema violation — both before any compute."""
+        Raises ``QueueFullError`` on backpressure, ``DrainingError``
+        once admission closed for a drain, and ``ValueError`` on a
+        schema violation — all before any compute."""
+        if self._draining:
+            self._m_requests.inc(status="draining")
+            raise DrainingError(
+                "replica draining: admission closed; retry another "
+                "replica"
+            )
         prompt = self.engine.coerce_prompt(inputs)
         max_new = int(max_new_tokens or self.default_max_new)
         if max_new < 1:
@@ -567,6 +660,13 @@ class TokenContinuousBatcher:
             on_event=on_event,
         )
         with self._cv:
+            if self._draining:
+                # Re-check under the lock (see ContinuousBatcher.submit)
+                self._m_requests.inc(status="draining")
+                raise DrainingError(
+                    "replica draining: admission closed; retry another "
+                    "replica"
+                )
             forced = self.chaos is not None and bool(
                 self.chaos.due("serve.queue.full")
             )
@@ -707,6 +807,19 @@ class TokenContinuousBatcher:
             t.table[: len(blocks)] = blocks
             try:
                 first = self.engine.prefill(weights, t.prompt, t.table)
+            except DispatchWedgedError:
+                # Wedged dispatch (watchdog): RECOVERABLE — the engine
+                # already rebuilt the pools + bumped cache_epoch.  The
+                # request survives: requeue it at the front (arrival
+                # order kept) and stop joining; the worker loop's
+                # epoch check re-prefills everything next iteration.
+                self._free_blocks(t)
+                with self._cv:
+                    t.state = _QUEUED
+                    self._queue.appendleft(t)
+                    self._queued_tokens += int(t.prompt.shape[0])
+                    self._g_depth.set(len(self._queue))
+                return joined
             except BaseException as e:
                 self._free_blocks(t)
                 self._m_requests.inc(status="error")
@@ -840,6 +953,12 @@ class TokenContinuousBatcher:
                     t.prefilled,
                     t.table,
                 )
+            except DispatchWedgedError:
+                # Wedged chunk dispatch: recoverable.  Leave the
+                # sequence at the FIFO head — the epoch rewind next
+                # iteration frees its blocks, resets its progress, and
+                # requeues it (no reject: the request survives).
+                break
             except BaseException as e:
                 self._prefilling.popleft()
                 self._prefilling_tokens -= plen - t.prefilled
@@ -916,6 +1035,13 @@ class TokenContinuousBatcher:
             tables[i] = t.table
         try:
             ids = self.engine.decode_step(weights, tokens, lengths, tables)
+        except DispatchWedgedError:
+            # Wedged decode dispatch: recoverable — the sequences stay
+            # ACTIVE (nothing is rejected); the worker loop's next
+            # epoch check sees the rebuilt pool and re-prefills every
+            # one of them against the fresh cache.  A genuine compute
+            # error (below) still rejects.
+            return 0
         except BaseException as e:
             for t in ready:
                 if t in self._active:

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu.checkpoint import HostDRAMStore
 from edl_tpu.checkpoint.hostdram import HostCheckpoint, leaf_placer
+from edl_tpu.consensus.watchdog import CollectiveTimeout, CollectiveWatchdog
 from edl_tpu.models.base import ModelDef
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 
@@ -50,6 +51,17 @@ class PromptTooLongError(ValueError):
     before it costs any compute or KV blocks, never discovered
     mid-chunk.  Subclasses ValueError so the HTTP front's existing
     400 mapping applies."""
+
+
+class DispatchWedgedError(RuntimeError):
+    """A prefill/chunk/decode dispatch missed the dispatch watchdog's
+    deadline (wedged device, hung runtime) — or a chaos
+    ``serve.dispatch.wedged`` trip simulated one.  By the time this
+    raises the engine has already rebuilt its (donated) KV pools and
+    bumped ``cache_epoch``: the token batcher treats it as a
+    RECOVERABLE condition — live sequences re-prefill on the fresh
+    cache instead of being rejected (the request survives a wedge; a
+    genuine compute error still rejects)."""
 
 
 @dataclass(frozen=True)
@@ -145,6 +157,13 @@ class InferenceEngine:
         self._jit = jax.jit(model.predict_fn)
         #: bucket -> held AOT executable (the zero-compile request path)
         self._compiled: Dict[int, Any] = {}
+        #: step of the newest candidate already rejected at a refresh —
+        #: a torn checkpoint sits in the store until a newer clean save
+        #: supersedes it, and every poll re-seeing it must not re-count
+        #: (or re-journal, or re-hash) the same rejection: one torn
+        #: candidate = one rejection, which also keeps chaos-soak
+        #: journals deterministic under refresh-poll interleave
+        self._last_rejected_step = -1
         self._weights: Optional[_Weights] = None
         self._swap_lock = threading.Lock()
         #: serializes refresh(): the single-shot and token batchers may
@@ -248,6 +267,9 @@ class InferenceEngine:
                 digest=ckpt.digest(),
                 params=params,
             )
+        # A successful install clears the rejection dedup: the next
+        # torn candidate (whatever its step) counts/journals again.
+        self._last_rejected_step = -1
         self._m_weights_step.set(int(ckpt.step))
 
     def load(self) -> bool:
@@ -314,11 +336,17 @@ class InferenceEngine:
         newest = self.store.latest()
         if newest is None or int(newest.step) <= current:
             return False
+        if int(newest.step) == self._last_rejected_step:
+            # The newest candidate is the one already rejected: nothing
+            # changed since, so skip the re-verify (one hash pass per
+            # candidate, not per poll) and the duplicate count/journal.
+            return False
         ckpt = self.store.latest_verified()
         if ckpt is None or int(ckpt.step) <= current:
             # The newer candidate failed verification (torn/corrupt):
             # latest_verified discarded it and whatever remains is not
             # newer than what we serve.  Keep the old weights.
+            self._last_rejected_step = int(newest.step)
             self._m_swap_rejected.inc()
             self.recorder.record(
                 "serve.swap.rejected",
@@ -660,6 +688,7 @@ class DecodeEngine(InferenceEngine):
         max_context: Optional[int] = None,
         num_blocks: Optional[int] = None,
         max_chunk_tokens: Optional[int] = None,
+        dispatch_timeout: Optional[float] = None,
     ):
         if model.decode is None:
             raise ValueError(
@@ -773,6 +802,50 @@ class DecodeEngine(InferenceEngine):
         #: every live sequence when it sees a new epoch, exactly like
         #: a weights-generation change
         self.cache_epoch = 0
+        # -- dispatch watchdog (ISSUE 15): the PR 6 deadline-fetch
+        # pattern on the SERVING data plane.  A wedged prefill/chunk/
+        # decode dispatch (hung device runtime, stuck transfer) would
+        # otherwise hang the token batcher's worker thread forever —
+        # the same failure shape a wedged gloo collective has in
+        # training, with the same answer: run the blocking fetch under
+        # a deadline on an abandonable helper thread, and surface
+        # expiry as a typed error into the existing pool-rebuild +
+        # cache-epoch re-prefill recovery.  ``dispatch_timeout`` <= 0
+        # disables the deadline (single-process CPU default — a wedge
+        # is not a real failure mode there and the thread hop would tax
+        # every token); the ``serve.dispatch.wedged`` chaos trip stays
+        # live either way, so the recovery path is testable anywhere.
+        if dispatch_timeout is None:
+            import os
+
+            dispatch_timeout = float(
+                os.environ.get("EDL_SERVE_DISPATCH_TIMEOUT", "0") or 0
+            )
+        self.dispatch_timeout = float(dispatch_timeout)
+        #: chaos source for the wedge trip — defaults to the engine's
+        #: schedule; tests may point it elsewhere so a shared schedule's
+        #: swap-torn events stay with the engines that should pop them
+        self.dispatch_chaos = self.chaos
+        self._m_wedged = self.telemetry.counter(
+            "edl_serve_dispatch_wedged_total"
+        )
+
+        def _wedge_due() -> bool:
+            c = self.dispatch_chaos
+            return c is not None and bool(c.due("serve.dispatch.wedged"))
+
+        def _wedge_trip(what: str, waited: float) -> None:
+            self._m_wedged.inc()
+            self.recorder.record(
+                "serve.watchdog",
+                {"what": what, "waited_s": round(waited, 3)},
+            )
+
+        self.watchdog = CollectiveWatchdog(
+            timeout=self.dispatch_timeout,
+            chaos_check=_wedge_due,
+            on_trip=_wedge_trip,
+        )
 
     # -- buckets ------------------------------------------------------------
     @property
@@ -957,7 +1030,12 @@ class DecodeEngine(InferenceEngine):
             self._put(tables),
         )
         fn = self._decode_compiled.get(key)
-        try:
+
+        def dispatch():
+            # Dispatch AND device fetch under one deadline: a wedged
+            # runtime can hang either the call or the blocking
+            # device_get, and both must surface as a trip, not a
+            # stuck worker thread.
             with self.mesh:
                 if fn is not None:
                     ids, kp, vp = fn(*args)
@@ -970,6 +1048,20 @@ class DecodeEngine(InferenceEngine):
                         "decode": self._decode_jit,
                     }[key[0]]
                     ids, kp, vp = jfn(*args)
+            return np.asarray(jax.device_get(ids)), kp, vp
+
+        try:
+            out, kp, vp = self.watchdog.fetch(dispatch, what=key[0])
+        except CollectiveTimeout as e:
+            # Wedged dispatch (deadline expiry or the chaos trip): the
+            # DONATED pools may be half-consumed by the abandoned
+            # fetch, so rebuild + epoch-bump exactly like a failed
+            # dispatch — then raise the RECOVERABLE typed error so the
+            # batcher re-prefills live sequences instead of rejecting
+            # them.
+            self.pool.rebuild()
+            self.cache_epoch += 1
+            raise DispatchWedgedError(str(e)) from e
         except BaseException:
             # The pools were DONATED: after a failed dispatch the old
             # buffers may already be consumed, so keeping them would
@@ -983,7 +1075,7 @@ class DecodeEngine(InferenceEngine):
         # cache after this token.
         self.pool.kpool = kp
         self.pool.vpool = vp
-        return np.asarray(jax.device_get(ids))
+        return out
 
     def prefill(
         self, weights: _Weights, prompt: np.ndarray, table_row: np.ndarray

@@ -48,6 +48,7 @@ from typing import Optional
 from edl_tpu.serving.batcher import (
     ContinuousBatcher,
     DeadlineExceededError,
+    DrainingError,
     QueueFullError,
 )
 from edl_tpu.serving.engine import InferenceEngine, NotReadyError
@@ -66,6 +67,12 @@ class ServingServer:
     ):
         self.batcher = batcher
         self.gen_batcher = gen_batcher
+        #: the ServingReplica driving this server (set by
+        #: ServingReplica.start) — POST /drain routes through it so the
+        #: full contract runs (admission close -> in-flight finish ->
+        #: deregister); without one the handler drains the batchers
+        #: directly (batcher-only test/CLI deployments)
+        self.replica = None
         engine = batcher.engine
         self_server = self
         from edl_tpu import telemetry
@@ -96,6 +103,7 @@ class ServingServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
+                    gen0 = self.server_gen_batcher
                     health = {
                         "ok": engine.ready,
                         "model": engine.model.name,
@@ -103,6 +111,13 @@ class ServingServer:
                         "weights_generation": engine.weights_generation,
                         "warm_buckets": list(engine.warm_buckets),
                         "queue_depth": self.server_batcher.depth,
+                        # drain posture: admission state + what is
+                        # still in flight (the scale-down victim-ack
+                        # signal a poller can watch)
+                        "draining": self.server_batcher.draining
+                        or (gen0 is not None and gen0.draining),
+                        "in_flight": self.server_batcher.in_flight
+                        + (gen0.in_flight if gen0 is not None else 0),
                     }
                     gen = self.server_gen_batcher
                     if gen is not None:
@@ -155,6 +170,9 @@ class ServingServer:
                 if self.path == "/generate":
                     self._do_generate()
                     return
+                if self.path == "/drain":
+                    self._do_drain()
+                    return
                 if self.path != "/predict":
                     self._reply({"error": "not found"}, 404)
                     return
@@ -183,6 +201,22 @@ class ServingServer:
                     self._reply(
                         {"error": str(e), "retry_after_s": e.retry_after},
                         429,
+                        headers=(
+                            ("Retry-After", f"{e.retry_after:.3f}"),
+                        ),
+                    )
+                    return
+                except DrainingError as e:
+                    # 503 + Retry-After, DISTINCT from 429 queue-full:
+                    # this replica is leaving — clients route the retry
+                    # to another replica instead of backing off here.
+                    self._reply(
+                        {
+                            "error": str(e),
+                            "draining": True,
+                            "retry_after_s": e.retry_after,
+                        },
+                        503,
                         headers=(
                             ("Retry-After", f"{e.retry_after:.3f}"),
                         ),
@@ -254,6 +288,19 @@ class ServingServer:
                     self._reply(
                         {"error": str(e), "retry_after_s": e.retry_after},
                         429,
+                        headers=(
+                            ("Retry-After", f"{e.retry_after:.3f}"),
+                        ),
+                    )
+                    return
+                except DrainingError as e:
+                    self._reply(
+                        {
+                            "error": str(e),
+                            "draining": True,
+                            "retry_after_s": e.retry_after,
+                        },
+                        503,
                         headers=(
                             ("Retry-After", f"{e.retry_after:.3f}"),
                         ),
@@ -337,6 +384,68 @@ class ServingServer:
                     }
                 )
 
+            def _do_drain(self):
+                """POST /drain — graceful shutdown contract (ISSUE 15):
+                close admission (later /predict//generate = 503 +
+                Retry-After), let every in-flight request and decode
+                sequence finish under the bounded budget, free KV
+                blocks, deregister from the serving coordinator.  With
+                ``wait`` (the default) the reply IS the drain ack —
+                the scale-down actuator's drain-victim-ack-then-patch
+                handshake blocks on exactly this call."""
+                try:
+                    req = self._read_json()
+                except ValueError:
+                    self._reply({"error": "bad json"}, 400)
+                    return
+                budget_ms = req.get("budget_ms")
+                budget_s = (
+                    float(budget_ms) / 1000.0
+                    if budget_ms is not None
+                    else None
+                )
+                wait = bool(req.get("wait", True))
+                rep = self_server.replica
+                if rep is not None:
+                    if wait:
+                        self._reply(rep.drain(budget_s=budget_s))
+                    else:
+                        threading.Thread(
+                            target=rep.drain,
+                            kwargs={"budget_s": budget_s},
+                            daemon=True,
+                            name="edl-serve-drain",
+                        ).start()
+                        self._reply(
+                            {"draining": True, "drained": False}
+                        )
+                    return
+                # Batcher-only fallback (no replica attached): close
+                # admission and wait the queues out under the budget.
+                batcher.close_admission()
+                gen0 = self.server_gen_batcher
+                if gen0 is not None:
+                    gen0.close_admission()
+                deadline = time.monotonic() + (budget_s or 30.0)
+                if wait:
+                    while time.monotonic() < deadline:
+                        left = batcher.in_flight + (
+                            gen0.in_flight if gen0 is not None else 0
+                        )
+                        if left == 0:
+                            break
+                        time.sleep(0.005)
+                left = batcher.in_flight + (
+                    gen0.in_flight if gen0 is not None else 0
+                )
+                self._reply(
+                    {
+                        "draining": True,
+                        "drained": left == 0,
+                        "in_flight": left,
+                    }
+                )
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -380,7 +489,18 @@ class ServingReplica:
         heartbeat_interval: float = 2.0,
         telemetry_interval: float = 5.0,
         gen_batcher=None,
+        drain_budget_s: float = 30.0,
+        chaos=None,
     ):
+        """``drain_budget_s``: how long a graceful drain lets in-flight
+        work finish before giving up (``EDL_SERVE_DRAIN_MS`` via
+        serve_run; the kube manifests size the pod's
+        terminationGracePeriodSeconds above it).  ``chaos``: a per-POD
+        fault schedule for the replica-level points
+        (``serve.replica.die`` / ``serve.coord.unreachable``) — kept
+        separate from the engine's schedule on purpose: those points
+        name a whole replica, so a schedule shared across replicas in
+        one process would misroute them."""
         self.engine = engine
         self.batcher = batcher or ContinuousBatcher(engine)
         # Decode-capable engines get the token-iteration batcher too
@@ -406,12 +526,33 @@ class ServingReplica:
         self._seq = 0
         self._events_sent_seq = 0
         self._boot = uuid.uuid4().hex[:12]
+        self.drain_budget_s = float(drain_budget_s)
+        self.chaos = chaos
+        #: serve.coord.unreachable blackout: until this monotonic time
+        #: every heartbeat/report is skipped (the coordinator has
+        #: "vanished"); serving continues on last-verified weights and
+        #: the lease-KeyError rejoin path reconverges on return
+        self._blackout_until = 0.0
+        self._deregistered = False
+        self._dead = False
+        #: drain state machine: None (serving) -> "running" ->
+        #: "drained" (terminal) | "incomplete" (budget missed:
+        #: admission stays closed, membership KEPT, retryable)
+        self._drain_lock = threading.Lock()
+        self._drain_state: Optional[str] = None
+        self._drain_evt: Optional[threading.Event] = None
+        self._drain_result: Optional[dict] = None
         from edl_tpu import telemetry
 
         self.telemetry = telemetry.get_registry()
         self.recorder = telemetry.get_recorder()
         self._m_reports = self.telemetry.counter(
             "edl_telemetry_reports_total"
+        )
+        self._g_draining = self.telemetry.gauge("edl_serve_draining")
+        self._m_drains = self.telemetry.counter("edl_serve_drains_total")
+        self._h_drain = self.telemetry.histogram(
+            "edl_serve_drain_seconds"
         )
 
     def start(self) -> "ServingReplica":
@@ -427,10 +568,12 @@ class ServingReplica:
             if self.server is not None and self.server.gen_batcher is None:
                 self.server.gen_batcher = self.gen_batcher
         if self.server is not None:
+            self.server.replica = self  # POST /drain routes here
             self.server.start()
         if self.coordinator is not None:
             self.coordinator.register(self.replica_id, address=self.address)
             self._start_background()
+        self._g_draining.set(0, replica=self.replica_id)
         self.recorder.record(
             "serve.replica",
             {
@@ -448,9 +591,10 @@ class ServingReplica:
             self._stop_evt.set()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout=10)
-        if self.coordinator is not None:
+        if self.coordinator is not None and not self._deregistered:
             try:
                 self.coordinator.deregister(self.replica_id)
+                self._deregistered = True
             except Exception:
                 pass
         self.batcher.stop()
@@ -458,6 +602,149 @@ class ServingReplica:
             self.gen_batcher.stop()
         if self.server is not None:
             self.server.stop()
+
+    # -- graceful drain (ISSUE 15) ------------------------------------------
+    def _in_flight(self) -> int:
+        n = self.batcher.in_flight
+        if self.gen_batcher is not None:
+            n += self.gen_batcher.in_flight
+        return n
+
+    def drain(self, budget_s: Optional[float] = None) -> dict:
+        """The graceful-shutdown contract, in order: (1) close
+        admission — later requests get 503 + Retry-After (distinct
+        from 429: this replica is LEAVING, clients go elsewhere);
+        (2) let every in-flight single-shot request and decode
+        sequence finish under the bounded ``budget_s`` (their normal
+        finish paths free the KV blocks the same iteration); (3) stop
+        heartbeating and deregister from the serving coordinator —
+        only after in-flight settled, and heartbeats FIRST or the
+        lease-KeyError rejoin path would re-register the leaving
+        replica; (4) return the ack.  The caller owns the actual exit
+        (``stop()``/process teardown) — a drained replica still
+        answers /healthz and /metrics until then.
+
+        Idempotent and join-safe: one drain runs at a time; concurrent
+        calls (POST /drain racing SIGTERM racing the autoscaler's
+        victim drain) block on it and share its result.  A drain that
+        MISSES its budget is ``incomplete``, not terminal: admission
+        stays closed, but the replica keeps heartbeating and stays
+        REGISTERED — it must remain visible in the plan as an
+        undrained victim so the scale-down actuator keeps blocking the
+        Deployment patch and a retried drain (next tick, or a joiner's
+        own call) can wait the remaining work out and ack for real.
+        Only a SUCCESSFUL drain deregisters."""
+        budget = self.drain_budget_s if budget_s is None else float(budget_s)
+        give_up = time.monotonic() + budget + 10.0
+        while True:
+            with self._drain_lock:
+                if self._drain_state == "drained":
+                    return dict(self._drain_result)
+                if self._drain_state in (None, "incomplete"):
+                    first = self._drain_state is None
+                    self._drain_state = "running"
+                    self._drain_evt = threading.Event()
+                    evt = self._drain_evt
+                    break  # this caller owns the (re)attempt
+                evt = self._drain_evt  # "running": join it
+            evt.wait(timeout=max(0.05, give_up - time.monotonic()))
+            if time.monotonic() >= give_up:
+                return dict(
+                    self._drain_result
+                    or {"draining": True, "drained": False}
+                )
+            # re-check: the finished attempt either drained (return
+            # its result) or came up incomplete (retry as the owner)
+        t0 = time.monotonic()
+        self._g_draining.set(1, replica=self.replica_id)
+        if first:
+            # counters/journal count DRAINS, not retry attempts
+            self._m_drains.inc()
+            self.recorder.record(
+                "serve.drain",
+                {"replica": self.replica_id, "phase": "start"},
+            )
+        self.batcher.close_admission()
+        if self.gen_batcher is not None:
+            self.gen_batcher.close_admission()
+        chaos = (
+            self.chaos
+            if self.chaos is not None
+            else getattr(self.engine, "chaos", None)
+        )
+        deadline = t0 + budget
+        while time.monotonic() < deadline:
+            if chaos is not None:
+                for ev in chaos.due("serve.drain.slow"):
+                    # chaos[serve.drain.slow]: a slow drain (stuck
+                    # client, long generation) eats into the budget —
+                    # the bounded-budget path under test control.
+                    time.sleep(float(ev.arg or 0.05))
+            if self._in_flight() == 0:
+                break
+            time.sleep(0.005)
+        leftover = self._in_flight()
+        drained = leftover == 0
+        if drained:
+            # Heartbeats stop BEFORE deregistering (see docstring).
+            if self._stop_evt is not None:
+                self._stop_evt.set()
+            if self._thread is not None and self._thread.is_alive():
+                self._thread.join(timeout=5)
+            if self.coordinator is not None and not self._deregistered:
+                try:
+                    self.coordinator.deregister(self.replica_id)
+                    self._deregistered = True
+                except Exception:
+                    pass
+        dt = time.monotonic() - t0
+        self._h_drain.observe(dt)
+        self._g_draining.set(2 if drained else 1, replica=self.replica_id)
+        if drained:
+            self.recorder.record(
+                "serve.drain",
+                {
+                    "replica": self.replica_id,
+                    "phase": "done",
+                    "drained": True,
+                },
+                timing={"seconds": round(dt, 6), "in_flight": leftover},
+            )
+        result = {
+            "draining": True,
+            "drained": drained,
+            "in_flight": leftover,
+            "seconds": round(dt, 6),
+        }
+        with self._drain_lock:
+            self._drain_result = result
+            self._drain_state = "drained" if drained else "incomplete"
+            evt.set()
+        return dict(result)
+
+    def die(self) -> None:
+        """The UNgraceful exit (chaos ``serve.replica.die`` — the
+        SIGKILL shape a drain exists to avoid): batchers stop abruptly
+        (queued and mid-flight requests fail — their clients must
+        retry against surviving replicas), heartbeats stop WITHOUT
+        deregistering, so the coordinator only learns through lease
+        expiry.  What a dead pod actually looks like."""
+        self._dead = True
+        if self._stop_evt is not None:
+            self._stop_evt.set()
+        self.batcher.stop()
+        if self.gen_batcher is not None:
+            self.gen_batcher.stop()
+        if self.server is not None:
+            self.server.stop()
+
+    def blackout(self, seconds: float) -> None:
+        """chaos[serve.coord.unreachable]: the serving coordinator
+        vanishes for ``seconds`` — beats and reports are skipped, the
+        replica keeps serving its last-verified weights, and on return
+        the normal heartbeat (or its KeyError -> re-register rejoin)
+        reconverges membership."""
+        self._blackout_until = time.monotonic() + float(seconds)
 
     # -- heartbeat + telemetry cadence (the training stack's shape) ---------
     def _start_background(self) -> None:
@@ -468,6 +755,18 @@ class ServingReplica:
             while not self._stop_evt.wait(
                 max(self.heartbeat_interval, 0.05)
             ):
+                if self.chaos is not None:
+                    # Replica-level chaos (per-POD schedule): a kill
+                    # takes the whole replica down ungracefully; a
+                    # coordinator blackout mutes the control plane
+                    # while serving continues.
+                    if self.chaos.due("serve.replica.die"):
+                        self.die()
+                        return
+                    for ev in self.chaos.due("serve.coord.unreachable"):
+                        self.blackout(float(ev.arg or 1.0))
+                if self._blackout_until > time.monotonic():
+                    continue  # coordinator unreachable: keep serving
                 self._beat_once()
                 now = time.monotonic()
                 if (
@@ -580,6 +879,8 @@ def serve_run(
         coordinator = HTTPCoordinator(
             coordinator_addr or cfg["coordinator_addr"]
         )
+    import os
+
     replica = ServingReplica(
         engine,
         batcher,
@@ -588,6 +889,8 @@ def serve_run(
         replica_id=replica_id or cfg["pod_name"],
         address=pod_address or cfg["pod_address"],
         telemetry_interval=cfg["telemetry_interval"],
+        drain_budget_s=float(os.environ.get("EDL_SERVE_DRAIN_MS", "30000"))
+        / 1000.0,
     )
     return replica.start()
 
@@ -623,7 +926,25 @@ def main(argv=None):  # pragma: no cover - pod entrypoint
         f"({replica.engine.model.name}) on port "
         f"{replica.server.port if replica.server else '-'}"
     )
-    threading.Event().wait()  # serve until killed
+    # SIGTERM = the kube pod-deletion signal: drain (close admission,
+    # finish in-flight, free KV, deregister) then exit — the serving
+    # half of the "a scale-down can never SIGKILL a replica
+    # mid-generation" contract.  The Deployment's
+    # terminationGracePeriodSeconds is sized above the drain budget so
+    # the kubelet's SIGKILL never beats the drain.
+    import signal
+    import sys
+
+    done = threading.Event()
+
+    def _terminate(signum, frame):
+        replica.drain()
+        replica.stop()
+        done.set()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    done.wait()  # serve until drained out by SIGTERM
+    sys.exit(0)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -149,6 +149,23 @@ class TelemetryAggregator:
         series = (snap.get("counters") or {}).get("edl_steps_total") or {}
         return sum(series.values())
 
+    def drop_source(self, source: str) -> None:
+        """Forget ``source``'s snapshot, rate window, and clock info —
+        the heartbeat-lease eviction hook (ISSUE 15).  A dead
+        (never-drained) serving replica's last report is frozen at its
+        moment of death: its queue-depth gauge pins the merged max
+        forever and its histogram sits in every quantile window — a
+        ghost p95 that an autoscaling lane would keep scaling on.
+        Eviction is the membership plane saying "this source is gone";
+        the telemetry plane must agree.  A replica that was evicted
+        while actually alive re-registers on its next heartbeat and
+        re-reports its CUMULATIVE snapshot — the same reconvergence
+        contract as a coordinator restart, so dropping is always
+        safe."""
+        self._by_source.pop(source, None)
+        self._rate_points.pop(source, None)
+        self._clock_info.pop(source, None)
+
     def merged(self) -> dict:
         return merge_snapshots(
             [snap for _, _, snap in self._by_source.values()]

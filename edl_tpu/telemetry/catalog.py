@@ -408,6 +408,35 @@ CATALOG = {
         "help": "Training step of the checkpoint currently serving.",
         "labels": (),
     },
+    # -- serving-plane fault tolerance (graceful drain + watchdog) -----------
+    "edl_serve_draining": {
+        "type": "gauge",
+        "help": "Per-replica drain state: 0 serving, 1 draining "
+        "(admission closed, in-flight finishing), 2 drained "
+        "(deregistered, ready to exit).",
+        "labels": ("replica",),
+    },
+    "edl_serve_drains_total": {
+        "type": "counter",
+        "help": "Graceful drains started on this replica (POST /drain, "
+        "SIGTERM, or a scale-down victim drain).",
+        "labels": (),
+    },
+    "edl_serve_drain_seconds": {
+        "type": "histogram",
+        "help": "Seconds from admission close to drained (every "
+        "in-flight single-shot request and decode sequence finished, "
+        "KV blocks freed, replica deregistered).",
+        "labels": (),
+    },
+    "edl_serve_dispatch_wedged_total": {
+        "type": "counter",
+        "help": "Serving dispatches (prefill / chunk / decode) that "
+        "missed the dispatch watchdog deadline and were recovered via "
+        "pool rebuild + cache-epoch re-prefill instead of hanging the "
+        "worker thread.",
+        "labels": (),
+    },
     # -- autoregressive decode serving (DecodeEngine + token batcher) --------
     "edl_serve_tokens_total": {
         "type": "counter",
@@ -606,6 +635,8 @@ KNOWN_EVENT_KINDS = {
     "serve.swap.rejected": "a hot-swap candidate failed verification",
     "serve.replica": "a serving replica registered / took traffic",
     "serve.restart": "a hot swap re-prefilled in-flight sequences",
+    "serve.drain": "a replica drain started / completed",
+    "serve.watchdog": "a serving dispatch missed the watchdog deadline",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

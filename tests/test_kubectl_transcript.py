@@ -279,6 +279,145 @@ spec:
     assert len(_read(transcript)) == before
 
 
+def test_golden_transcript_drain_before_serving_scale_down(transcript_api):
+    """ISSUE 15: the drain-victim-ack-then-patch scale-down sequence
+    through ``update_serving_replicas``, pinned end to end.  The
+    victim's /drain ack is recorded into the SAME transcript as the
+    kubectl calls (the fake replica's handler appends a DRAIN line),
+    so the golden proves ordering: the Deployment patch happens only
+    AFTER the drain acked — a scale-down can never yank a replica with
+    live generations."""
+    import os
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from edl_tpu import telemetry
+    from edl_tpu.autoscaler.serving import ServingLane, kube_replica_glue
+    from edl_tpu.cluster.cluster import Cluster
+    from edl_tpu.resource.training_job import TrainingJob
+
+    api, transcript = transcript_api
+    api.apply_manifests([SERVE_DEPLOYMENT])
+    job = TrainingJob.from_yaml(
+        """
+apiVersion: edl.tpu.dev/v1
+kind: TrainingJob
+metadata: {name: gj}
+spec:
+  fault_tolerant: true
+  global_batch_size: 64
+  checkpoint_dir: /ckpts
+  trainer:
+    entrypoint: mnist
+    min_instance: 1
+    max_instance: 4
+    slice_topology: cpu
+  serving:
+    min_replicas: 1
+    max_replicas: 5
+"""
+    ).validate()
+    cluster = Cluster(api)
+    tpath = os.environ["EDL_KUBECTL_TRANSCRIPT"]
+
+    class DrainHandler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            with open(tpath, "a") as f:
+                f.write(
+                    json.dumps(
+                        {"argv": ["DRAIN", "gj-serve-1"], "stdin": ""}
+                    )
+                    + "\n"
+                )
+            body = json.dumps(
+                {"draining": True, "drained": True, "in_flight": 0}
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), DrainHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    victim_addr = f"127.0.0.1:{srv.server_address[1]}"
+
+    class Coord:
+        target = 2
+
+        def telemetry(self):
+            return {
+                "merged": {
+                    "counters": {},
+                    "gauges": {"edl_serve_queue_depth": {"": 0}},
+                    "histograms": {},
+                }
+            }
+
+        def metrics(self):
+            return {"target_world": self.target}
+
+        def plan(self):
+            class P:
+                members = ("gj-serve-0", "gj-serve-1")
+                addresses = ("", victim_addr)
+
+            return P()
+
+        def set_prewarm(self, n, trace_id=""):
+            pass
+
+        def set_target_world(self, n, trace_id=""):
+            self.target = n
+
+    try:
+        with telemetry.scoped():
+            lane = ServingLane(
+                Coord(),
+                min_replicas=1,
+                max_replicas=5,
+                hold_ticks=1,
+                on_scale=kube_replica_glue(cluster, job),
+                victim_drain_timeout=5.0,
+            )
+            entry = lane.run_once()
+        assert entry["actuated"] and entry["drain"]["acked"]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+    records = _read(transcript)
+    golden_argv = [
+        # fleet submit
+        ["-n", "default", "apply", "-f", "-"],
+        # the victim drain ACK — strictly before any kubectl mutation
+        ["DRAIN", "gj-serve-1"],
+        # then the pinned read-modify-patch-reread Deployment scale
+        ["-n", "default", "get", "deployment", "gj-serve", "-o", "json"],
+        [
+            "-n",
+            "default",
+            "patch",
+            "deployment",
+            "gj-serve",
+            "--type=merge",
+            "-p",
+            json.dumps(
+                {
+                    "metadata": {"resourceVersion": "1"},
+                    "spec": {"replicas": 1},
+                }
+            ),
+        ],
+        ["-n", "default", "get", "deployment", "gj-serve", "-o", "json"],
+    ]
+    assert [r["argv"] for r in records] == golden_argv
+
+
 def test_golden_transcript_conflict_surfaces(transcript_api):
     """A stale resourceVersion must round-trip to ConflictError through
     the recorded patch invocation (the retry loop's trigger)."""

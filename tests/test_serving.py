@@ -983,6 +983,9 @@ def test_cli_metrics_pretty_prints_serving_section(capsys):
     reg.counter("edl_serve_requests_total").inc(10, status="ok")
     reg.gauge("edl_serve_queue_depth").set(3)
     reg.gauge("edl_serve_weights_step").set(42)
+    # drain posture (ISSUE 15): per-replica state + drain counters
+    reg.gauge("edl_serve_draining").set(1, replica="serve-0")
+    reg.counter("edl_serve_drains_total").inc()
     coord.report_telemetry("serve-0", snapshot=reg.snapshot(), seq=1)
     server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
         evict=False
@@ -995,5 +998,7 @@ def test_cli_metrics_pretty_prints_serving_section(capsys):
         assert "queue_depth_max" in out and "3" in out
         assert "weights_step" in out and "42" in out
         assert "status=ok" in out
+        assert "drain{replica=serve-0}" in out and "draining" in out
+        assert "drains_total" in out
     finally:
         server.stop()

@@ -1,0 +1,400 @@
+"""ISSUE 15 acceptance: the seeded multi-replica serving chaos soak.
+
+One driver thread walks a ``FaultSchedule`` through a 4-replica
+serving fleet under live traffic:
+
+- a **replica kill** mid-flight (``serve.replica.die`` — the SIGKILL
+  shape): its in-flight requests FAIL and are resolved by retry
+  against survivors;
+- a **torn swap** (``serve.swap.torn``): the shared store's newest
+  candidate rots; every engine rejects it ONCE (the dedup contract)
+  and keeps serving, then swaps cleanly to the next good save;
+- a **wedged decode dispatch** (``serve.dispatch.wedged``): the
+  dispatch watchdog trips into pool-rebuild + cache-epoch re-prefill;
+  the wedged sequences COMPLETE with generation-pure tokens;
+- two **graceful drains** (one decode replica with live generations,
+  one single-shot replica under ``serve.drain.slow``): zero in-flight
+  requests dropped, KV blocks freed, deregistered;
+- a **coordinator restart** + a ``serve.coord.unreachable`` blackout:
+  replicas keep serving last-verified weights and membership
+  reconverges via the heartbeat rejoin path.
+
+Determinism contract: run twice with the same seed, the flight
+recorder's order-independent ``digest()`` AND the driver's structured
+soak log are bit-identical.  Everything scheduling-dependent (drain
+durations, in-flight counts at the drain moment) rides the recorder's
+non-identity ``timing`` field or stays out of the log; the driver
+advances the chaos clock and then WAITS for thread-consumed points to
+pop before moving on, so every chaos event journals at its scheduled
+step.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.membership import ChaosCoordinator
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.runtime.coordinator import LocalCoordinator
+from edl_tpu.runtime.train import TrainState
+from edl_tpu.serving import (
+    DecodeEngine,
+    InferenceEngine,
+    ServingReplica,
+)
+from tests.test_decode_serving import _reference_decode
+
+_OPT = optax.adam(1e-3)
+
+
+def _line_state(g: float) -> TrainState:
+    params = {
+        "w": jnp.full((13,), g, jnp.float32),
+        "b": jnp.asarray(g, jnp.float32),
+    }
+    return TrainState(
+        step=jnp.asarray(int(g), jnp.int32),
+        params=params,
+        opt_state=_OPT.init(params),
+    )
+
+
+def _lm_state(model, step: int, seed: int) -> TrainState:
+    p = model.init_params(jax.random.key(seed))
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params=p,
+        opt_state=_OPT.init(p),
+    )
+
+
+def _soak_events():
+    return [
+        FaultEvent(3, "serve.swap.torn"),
+        FaultEvent(5, "serve.replica.die", arg=1),
+        FaultEvent(8, "serve.dispatch.wedged"),
+        FaultEvent(11, "serve.drain.slow", arg=0.02),
+        FaultEvent(14, "coord.restart"),
+        FaultEvent(14, "serve.coord.unreachable", arg=1.0),
+    ]
+
+
+def _wait(cond, timeout=20.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"soak wait timed out: {what}")
+
+
+def _run_serving_soak(seed: int):
+    """One full soak.  Returns everything that must be bit-identical
+    across same-seed runs (recorder digest + the structured log) plus
+    the run's invariant evidence."""
+    with telemetry.scoped() as (reg, rec):
+        schedule = FaultSchedule(seed, _soak_events())
+        log = []
+
+        # -- the fleet -------------------------------------------------------
+        store = HostDRAMStore(chaos=schedule)  # shared by the 3 liners
+        store.save_async(_line_state(1.0), generation=0)
+        store.wait()
+        inner = LocalCoordinator(
+            target_world=8, max_world=8, heartbeat_timeout=1e9
+        )
+        coord = ChaosCoordinator(inner, schedule)
+        fit = []
+        for i in range(3):
+            engine = InferenceEngine(
+                get_model("fit_a_line"),
+                store,
+                devices=jax.devices()[:1],
+                max_batch=4,
+                chaos=schedule,
+            )
+            fit.append(
+                ServingReplica(
+                    engine,
+                    coordinator=coord,
+                    replica_id=f"serve-{i}",
+                    heartbeat_interval=0.05,
+                    telemetry_interval=1e9,
+                ).start()
+            )
+        lm = get_model("transformer_lm", tiny=True)
+        dstore = HostDRAMStore()  # decode weights: own store, no chaos
+        dstore.save_async(_lm_state(lm, 1, 1), generation=0)
+        dstore.wait()
+        dengine = DecodeEngine(
+            lm,
+            dstore,
+            devices=jax.devices()[:1],
+            max_batch=1,
+            max_seqs=4,
+            block_tokens=16,
+        )
+        # the wedge trip routes through the DISPATCH chaos seam only —
+        # the shared schedule's swap-torn events stay with the liners
+        dengine.dispatch_chaos = schedule
+        drep = ServingReplica(
+            dengine,
+            coordinator=coord,
+            replica_id="serve-d",
+            heartbeat_interval=0.05,
+            telemetry_interval=1e9,
+        ).start()
+        rng = np.random.RandomState(seed)
+        x0 = np.ones((1, 13), np.float32)
+
+        def call(order, x):
+            """The client retry contract: submit against replicas in
+            ``order`` until one serves (drain/kill failures route to
+            the next)."""
+            last = None
+            for b in list(order) * 2:
+                try:
+                    return b.batcher.submit({"x": x}).result(timeout=15)
+                except BaseException as e:
+                    last = e
+            raise last
+
+        def check(out, x, g):
+            np.testing.assert_allclose(
+                out["pred"],
+                g * (x.sum(axis=1) + 1.0),
+                rtol=1e-4,
+                atol=1e-3,
+            )
+
+        def wave(tag, order, n=3):
+            """n validated requests; the log records (tag, i, step)."""
+            for i in range(n):
+                x = rng.randn(1, 13).astype(np.float32)
+                out, meta = call(order, x)
+                check(out, x, float(meta["weights_step"]))
+                log.append((tag, i, meta["weights_step"]))
+
+        def barrier(replicas, step):
+            """Pump traffic until every engine serves ``step`` (workers
+            only refresh when traffic flows).  Pump requests stay out
+            of the log: their count is scheduling-dependent."""
+            for r in replicas:
+                _wait_swap(r, step)
+
+        def _wait_swap(r, step):
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if r.engine.weights_step == step:
+                    return
+                r.batcher.submit({"x": x0}).result(timeout=15)
+                time.sleep(0.002)
+            raise AssertionError(f"{r.replica_id} never reached {step}")
+
+        try:
+            # -- rounds 0-2: healthy traffic, one clean swap ---------------
+            schedule.advance(0)
+            wave("warm", fit)
+            store.save_async(_line_state(3.0), generation=1)
+            store.wait()
+            barrier(fit, 3)
+            wave("post-swap", fit)
+            log.append(("swap", 3))
+
+            # -- round 3: torn swap ----------------------------------------
+            # A newer candidate (g=5) lands, then the chaos clock makes
+            # the next refresh corrupt it: every engine must reject it
+            # exactly once (dedup) and keep serving step 3.
+            store.save_async(_line_state(5.0), generation=2)
+            store.wait()
+            schedule.advance(3)
+            # Pump traffic round-robin: a parked worker never
+            # refreshes, so the fleet needs live requests to observe
+            # the torn candidate.  The FIRST refresh to see it pops
+            # the chaos, fails CRC verification, and the store
+            # DISCARDS it — exactly one rejection fleet-wide (whoever
+            # wins the race journals the identical event: the shared
+            # store serves the same steps to every engine).
+            m_rejected = reg.counter("edl_serve_swap_rejected_total")
+            deadline = time.monotonic() + 20
+            while m_rejected.value() < 1:
+                assert time.monotonic() < deadline, (
+                    "torn candidate never rejected"
+                )
+                for r in fit:
+                    r.batcher.submit({"x": x0}).result(timeout=15)
+                time.sleep(0.002)
+            assert not any(
+                ev.point == "serve.swap.torn" for ev in schedule.pending()
+            )
+            assert int(store.latest().step) == 3  # torn 5 discarded
+            wave("during-torn", fit)  # still serving step 3
+            assert m_rejected.value() == 1
+            log.append(("torn-rejected", 5))
+            store.save_async(_line_state(7.0), generation=3)
+            store.wait()
+            barrier(fit, 7)
+            log.append(("swap", 7))
+
+            # -- round 5: replica kill mid-flight --------------------------
+            schedule.advance(5)
+            xs = [rng.randn(1, 13).astype(np.float32) for _ in range(4)]
+            tickets = [
+                fit[1].batcher.submit({"x": x}) for x in xs
+            ]
+            for ev in schedule.due("serve.replica.die"):
+                fit[int(ev.arg)].die()
+            survivors = [fit[0], fit[2]]
+            for i, (t, x) in enumerate(zip(tickets, xs)):
+                try:
+                    out, meta = t.result(timeout=15)
+                except BaseException:
+                    # the retry contract: a killed replica's request is
+                    # resolved against a survivor
+                    out, meta = call(survivors, x)
+                check(out, x, float(meta["weights_step"]))
+                log.append(("kill-resolved", i, meta["weights_step"]))
+            # a dead pod never deregisters — membership still lists it
+            assert "serve-1" in coord.members()
+
+            # -- round 8: wedged decode dispatch ---------------------------
+            prompts = [
+                lm.synth_batch(np.random.RandomState(41), 1)["tokens"][
+                    0, :9
+                ],
+                lm.synth_batch(np.random.RandomState(42), 1)["tokens"][
+                    0, :13
+                ],
+            ]
+            gens = [
+                drep.gen_batcher.submit_generate(
+                    {"tokens": p}, max_new_tokens=40, deadline_s=60.0
+                )
+                for p in prompts
+            ]
+            _wait(
+                lambda: drep.gen_batcher.active_count == 2
+                and all(t.tokens for t in gens),
+                what="2 active decode sequences",
+            )
+            schedule.advance(8)  # the next decode dispatch wedges
+            _wait(
+                lambda: not any(
+                    ev.point == "serve.dispatch.wedged"
+                    for ev in schedule.pending()
+                ),
+                what="wedge consumed",
+            )
+            w = dengine.current_weights()
+            for i, (t, p) in enumerate(zip(gens, prompts)):
+                tokens, meta = t.result(timeout=60)
+                assert meta["restarts"] == 1, "wedge must re-prefill"
+                ref = _reference_decode(lm, w.params, list(p), 40, dengine)
+                assert tokens == ref, "post-wedge tokens impure"
+                log.append(
+                    ("wedge-recovered", i, len(tokens), meta["restarts"])
+                )
+            assert (
+                reg.counter("edl_serve_dispatch_wedged_total").value()
+                == 1
+            )
+
+            # -- rounds 11-12: graceful drains -----------------------------
+            # (a) the decode replica with LIVE generations: every
+            # in-flight sequence completes, KV frees, deregistered.
+            gens = [
+                drep.gen_batcher.submit_generate(
+                    {"tokens": prompts[i]},
+                    max_new_tokens=24,
+                    deadline_s=60.0,
+                )
+                for i in range(2)
+            ]
+            _wait(
+                lambda: drep.gen_batcher.active_count == 2,
+                what="2 active pre-drain",
+            )
+            r = drep.drain(budget_s=60.0)
+            assert r["drained"] and r["in_flight"] == 0
+            for i, t in enumerate(gens):
+                tokens, meta = t.result(timeout=1.0)
+                assert len(tokens) == 24
+                log.append(("drain-decode-completed", i, len(tokens)))
+            assert dengine.pool.used_blocks == 0
+            assert "serve-d" not in coord.members()
+            # (b) a single-shot replica under serve.drain.slow: the
+            # budget still bounds the drain; queued requests complete.
+            schedule.advance(11)
+            t2 = [fit[2].batcher.submit({"x": x0}) for _ in range(3)]
+            r2 = fit[2].drain(budget_s=30.0)
+            assert r2["drained"]
+            for t in t2:
+                out, _ = t.result(timeout=1.0)
+            assert "serve-2" not in coord.members()
+            log.append(("drains-acked", 2))
+
+            # -- round 14: coordinator restart + blackout ------------------
+            schedule.advance(14)
+            for ev in schedule.due("serve.coord.unreachable"):
+                fit[0].blackout(float(ev.arg))
+            for ev in schedule.due("coord.restart"):
+                coord.restart(
+                    lambda: LocalCoordinator(
+                        target_world=8,
+                        max_world=8,
+                        heartbeat_timeout=1e9,
+                    )
+                )
+            log.append(("coord-restart", 14))
+            # the coordinator vanished AND lost all state: the replica
+            # keeps serving last-verified weights through the blackout
+            wave("during-blackout", [fit[0]])
+            # ...and membership reconverges once the blackout lifts:
+            # the lone survivor re-registers via the heartbeat rejoin
+            _wait(
+                lambda: set(coord.members()) == {"serve-0"},
+                timeout=30,
+                what="membership reconvergence",
+            )
+            log.append(("reconverged", sorted(coord.members())))
+            wave("final", [fit[0]])
+
+            assert schedule.pending() == []
+            ok = reg.counter("edl_serve_requests_total").value(
+                status="ok"
+            )
+            return {
+                "digest": rec.digest(),
+                "log": list(log),
+                "pending": schedule.pending(),
+                "ok_requests": ok,
+            }
+        finally:
+            for r in fit + [drep]:
+                try:
+                    r.stop()
+                except Exception:
+                    pass
+
+
+@pytest.mark.chaos
+def test_serving_chaos_soak_bit_reproducible():
+    """Acceptance (ISSUE 15): kills + torn swap + wedged dispatch +
+    drains + coordinator restart under live traffic — drained replicas
+    drop ZERO in-flight requests, killed replicas' requests resolve by
+    retry against survivors, wedged dispatches recover with pure
+    tokens, and two same-seed runs journal BIT-IDENTICALLY (recorder
+    digest + the driver log)."""
+    r1 = _run_serving_soak(seed=2024)
+    assert r1["pending"] == []
+    assert r1["ok_requests"] > 0
+    r2 = _run_serving_soak(seed=2024)
+    assert r1["digest"] == r2["digest"], "journals diverged across reruns"
+    assert r1["log"] == r2["log"]

@@ -1,0 +1,917 @@
+"""Serving-plane fault tolerance (ISSUE 15), unit layer: graceful
+replica drain, the decode dispatch watchdog, coordinator-loss
+behavior, and the lease-expiry ghost-telemetry fix.
+
+The seeded multi-replica chaos soak lives in
+``tests/test_serving_chaos.py``; the drain-before-patch kubectl golden
+in ``tests/test_kubectl_transcript.py``.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.runtime.train import TrainState
+from edl_tpu.serving import (
+    ContinuousBatcher,
+    DecodeEngine,
+    DrainingError,
+    InferenceEngine,
+    ServingReplica,
+    ServingServer,
+    TokenContinuousBatcher,
+)
+
+_OPT = optax.adam(1e-3)
+
+
+def _line_state(g: float) -> TrainState:
+    params = {
+        "w": jnp.full((13,), g, jnp.float32),
+        "b": jnp.asarray(g, jnp.float32),
+    }
+    return TrainState(
+        step=jnp.asarray(int(g), jnp.int32),
+        params=params,
+        opt_state=_OPT.init(params),
+    )
+
+def _line_engine(store, **kw) -> InferenceEngine:
+    return InferenceEngine(
+        get_model("fit_a_line"),
+        store,
+        devices=jax.devices()[:1],
+        max_batch=4,
+        **kw,
+    )
+
+
+def _line_store(g: float = 1.0) -> HostDRAMStore:
+    store = HostDRAMStore()
+    store.save_async(_line_state(g), generation=0)
+    store.wait()
+    return store
+
+
+def _lm_state(model, step: int, seed: int) -> TrainState:
+    p = model.init_params(jax.random.key(seed))
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params=p,
+        opt_state=_OPT.init(p),
+    )
+
+
+def _decode_engine(**kw):
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        **kw,
+    )
+    assert engine.load()
+    engine.warm()
+    return model, store, engine
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+# -- admission semantics -----------------------------------------------------
+
+
+def test_close_admission_raises_typed_draining_error():
+    with telemetry.scoped() as (reg, _):
+        store = _line_store()
+        engine = _line_engine(store)
+        engine.load()
+        engine.warm()
+        batcher = ContinuousBatcher(engine).start()
+        try:
+            x = {"x": np.ones((1, 13), np.float32)}
+            t = batcher.submit(x)  # admitted before the drain
+            batcher.close_admission()
+            assert batcher.draining
+            with pytest.raises(DrainingError) as ei:
+                batcher.submit(x)
+            assert ei.value.retry_after > 0
+            # DrainingError is NOT a QueueFullError: the HTTP front
+            # maps them to 503 vs 429 (different client contract).
+            from edl_tpu.serving import QueueFullError
+
+            assert not isinstance(ei.value, QueueFullError)
+            # the already-admitted request still completes
+            out, _ = t.result(timeout=10)
+            np.testing.assert_allclose(out["pred"], [14.0], atol=1e-5)
+            assert (
+                reg.counter("edl_serve_requests_total").value(
+                    status="draining"
+                )
+                == 1
+            )
+        finally:
+            batcher.stop()
+
+
+def test_http_drain_contract_503_with_retry_after_vs_429():
+    """While draining, /predict and /generate reply 503 + Retry-After —
+    the "go to another replica" signal — NOT the 429 queue-full "back
+    off and retry here" signal."""
+    model, store, engine = _decode_engine()
+    batcher = ContinuousBatcher(engine).start()
+    gen_b = TokenContinuousBatcher(engine, refresh=False).start()
+    server = ServingServer(
+        batcher, host="127.0.0.1", gen_batcher=gen_b
+    ).start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        rng = np.random.RandomState(0)
+        corpus = model.synth_batch(rng, 2)["tokens"]
+        r = _post(f"{base}/drain", {"budget_ms": 5000})
+        assert r["draining"] and r["drained"] and r["in_flight"] == 0
+        for path, payload in (
+            ("/predict", {"inputs": {"tokens": corpus[:1].tolist()}}),
+            (
+                "/generate",
+                {
+                    "inputs": {"tokens": corpus[0][:5].tolist()},
+                    "max_new_tokens": 2,
+                },
+            ),
+        ):
+            try:
+                _post(f"{base}{path}", payload)
+                raise AssertionError(f"expected HTTP 503 on {path}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503, path
+                assert float(e.headers["Retry-After"]) > 0, path
+                body = json.loads(e.read())
+                assert body["draining"] is True, path
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as h:
+            health = json.loads(h.read())
+        assert health["draining"] is True and health["in_flight"] == 0
+    finally:
+        server.stop()
+        batcher.stop()
+        gen_b.stop()
+
+
+# -- replica drain lifecycle -------------------------------------------------
+
+
+def test_replica_drain_finishes_in_flight_frees_kv_deregisters():
+    """The full contract, in order: admission closed (typed error),
+    every in-flight decode sequence runs to its normal finish, its KV
+    blocks are freed, and ONLY then the replica deregisters."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped() as (reg, rec):
+        model, store, engine = _decode_engine()
+        coord = LocalCoordinator(target_world=2, max_world=4)
+        replica = ServingReplica(
+            engine,
+            coordinator=coord,
+            replica_id="serve-0",
+            heartbeat_interval=60.0,
+            telemetry_interval=60.0,
+        )
+        replica.start()
+        try:
+            rng = np.random.RandomState(0)
+            corpus = model.synth_batch(rng, 4)["tokens"]
+            tickets = [
+                replica.gen_batcher.submit_generate(
+                    {"tokens": corpus[i][: 5 + i]},
+                    max_new_tokens=24,
+                    deadline_s=30.0,
+                )
+                for i in range(3)
+            ]
+            # wait until the batch is genuinely in flight
+            deadline = time.monotonic() + 10
+            while (
+                replica.gen_batcher.active_count
+                + replica.gen_batcher.prefilling_count
+                + replica.gen_batcher.depth
+                < 3
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+            assert coord.members() == ["serve-0"]
+            r = replica.drain(budget_s=30.0)
+            assert r["drained"] and r["in_flight"] == 0
+            # every in-flight sequence COMPLETED (0 drops), full length
+            for t in tickets:
+                tokens, meta = t.result(timeout=1.0)
+                assert len(tokens) == 24
+            # KV blocks all freed the same iterations the sequences
+            # finished — a drained replica holds no cache
+            assert engine.pool.used_blocks == 0
+            # deregistered only after the in-flight work settled
+            assert coord.members() == []
+            # second drain call joins the first (idempotent)
+            assert replica.drain()["drained"]
+            assert (
+                reg.counter("edl_serve_drains_total").value() == 1
+            )
+            assert (
+                reg.gauge("edl_serve_draining").value(replica="serve-0")
+                == 2
+            )
+            phases = [
+                e.data.get("phase")
+                for e in rec.events()
+                if e.kind == "serve.drain"
+            ]
+            assert phases == ["start", "done"]
+        finally:
+            replica.stop()
+
+
+def test_budget_missed_drain_stays_registered_and_retries():
+    """Review regression: a drain that MISSES its budget is
+    ``incomplete``, not terminal — the replica keeps heartbeating and
+    stays REGISTERED (it must remain a visible undrained victim so the
+    scale-down patch stays blocked), and a RETRIED drain waits the
+    remaining work out and acks for real (the result is never cached
+    stale)."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped() as (reg, rec):
+        model, store, engine = _decode_engine()
+        coord = LocalCoordinator(target_world=2, max_world=4)
+        replica = ServingReplica(
+            engine,
+            coordinator=coord,
+            replica_id="serve-slowgen",
+            heartbeat_interval=0.05,
+            telemetry_interval=1e9,
+        )
+        replica.start()
+        try:
+            rng = np.random.RandomState(0)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :8]
+            t = batch_t = replica.gen_batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=48, deadline_s=60.0
+            )
+            deadline = time.monotonic() + 10
+            while not t.tokens and time.monotonic() < deadline:
+                time.sleep(0.002)
+            # a 48-token generation cannot finish in ~1ms: budget missed
+            r1 = replica.drain(budget_s=0.001)
+            assert not r1["drained"] and r1["in_flight"] >= 1
+            # STILL a member (undrained victims must stay visible) and
+            # still heartbeating
+            assert "serve-slowgen" in coord.members()
+            assert replica._thread.is_alive()
+            # admission stayed closed across the incomplete attempt
+            with pytest.raises(DrainingError):
+                replica.gen_batcher.submit_generate(
+                    {"tokens": prompt}, max_new_tokens=2
+                )
+            # the retry (next tick's post_drain) waits it out and acks
+            r2 = replica.drain(budget_s=60.0)
+            assert r2["drained"] and r2["in_flight"] == 0
+            tokens, _ = batch_t.result(timeout=1.0)
+            assert len(tokens) == 48  # the generation was never cut
+            assert "serve-slowgen" not in coord.members()
+            # one DRAIN (two attempts) in the counters/journal
+            assert reg.counter("edl_serve_drains_total").value() == 1
+            phases = [
+                e.data.get("phase")
+                for e in rec.events()
+                if e.kind == "serve.drain"
+            ]
+            assert phases == ["start", "done"]
+        finally:
+            replica.stop()
+
+
+def test_drain_victims_refused_is_dead_but_errors_fail_closed():
+    """Review regression: only connection-REFUSED counts as a dead
+    victim (acked — nothing live to yank); a broken drain handshake
+    (plan fetch raising) fails CLOSED and blocks the actuation."""
+    import socket
+
+    from edl_tpu.autoscaler.serving import ServingLane
+
+    with telemetry.scoped():
+        # a genuinely closed port: connection refused -> acked
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_addr = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()  # nothing listens here now
+        coord = _DrainCoord(2, ["r0", "r1"], ["", dead_addr])
+        lane = ServingLane(
+            coord,
+            min_replicas=1,
+            max_replicas=4,
+            hold_ticks=1,
+            victim_drain_timeout=2.0,
+        )
+        entry = lane.run_once()
+        assert entry["actuated"]  # dead victim: scale-down proceeds
+        assert entry["drain"]["victims"][0]["acked"]
+        assert entry["drain"]["victims"][0]["unreachable"]
+
+        # a handshake that RAISES blocks the tick (fail closed)
+        coord2 = _DrainCoord(2, ["r0", "r1"], ["", ""])
+
+        def boom():
+            raise RuntimeError("plan fetch broke")
+
+        coord2.plan = boom
+        patches = []
+        lane2 = ServingLane(
+            coord2,
+            min_replicas=1,
+            max_replicas=4,
+            hold_ticks=1,
+            on_scale=lambda old, new: patches.append((old, new)),
+        )
+        e2 = lane2.run_once()
+        assert not e2["actuated"] and patches == []
+        assert "drain not acked" in e2["reason"]
+
+
+def test_drain_budget_bounded_with_slow_chaos():
+    """chaos[serve.drain.slow] stalls the drain loop; the budget still
+    bounds the wait and the ack reports honestly."""
+    with telemetry.scoped():
+        chaos = FaultSchedule(
+            seed=3,
+            events=[FaultEvent(step=0, point="serve.drain.slow", arg=0.1)],
+        )
+        chaos.advance(0)
+        store = _line_store()
+        engine = _line_engine(store, chaos=chaos)
+        engine.load()
+        engine.warm()
+        replica = ServingReplica(
+            engine, replica_id="serve-slow", heartbeat_interval=60.0
+        )
+        replica.start()
+        try:
+            t0 = time.monotonic()
+            r = replica.drain(budget_s=5.0)
+            dt = time.monotonic() - t0
+            assert r["drained"]  # nothing was in flight
+            assert 0.1 <= dt < 5.0  # slow chaos consumed, budget held
+            assert not chaos.pending()
+        finally:
+            replica.stop()
+
+
+def test_replica_die_is_abrupt_clients_retry_against_survivor():
+    """serve.replica.die (the SIGKILL shape): in-flight requests FAIL
+    (no graceful resolution), the replica never deregisters, and the
+    client contract is retry-against-survivors."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped():
+        store = _line_store()
+        coord = LocalCoordinator(
+            target_world=2, max_world=4, heartbeat_timeout=1e9
+        )
+        victim_engine = _line_engine(store)
+        victim = ServingReplica(
+            victim_engine,
+            coordinator=coord,
+            replica_id="victim",
+            heartbeat_interval=60.0,
+        )
+        survivor_engine = _line_engine(store)
+        survivor = ServingReplica(
+            survivor_engine,
+            coordinator=coord,
+            replica_id="survivor",
+            heartbeat_interval=60.0,
+        )
+        victim.start()
+        survivor.start()
+        try:
+            x = {"x": np.ones((1, 13), np.float32)}
+            # park requests on the victim, then kill it mid-flight
+            tickets = [victim.batcher.submit(x) for _ in range(4)]
+            victim.die()
+            outcomes = []
+            for t in tickets:
+                try:
+                    out, _ = t.result(timeout=10)
+                except BaseException:
+                    # the retry contract: resubmit against a survivor
+                    out, _ = survivor.batcher.submit(x).result(timeout=10)
+                outcomes.append(float(out["pred"][0]))
+            assert outcomes == [14.0] * 4
+            # a dead pod says no goodbyes: still registered until the
+            # heartbeat lease expires
+            assert set(coord.members()) == {"victim", "survivor"}
+        finally:
+            survivor.stop()
+
+
+def test_chaos_driven_die_and_blackout_via_heartbeat_loop():
+    """The per-pod schedule wiring: serve.replica.die kills the replica
+    from its own heartbeat loop; serve.coord.unreachable mutes the
+    control plane while serving continues, then reconverges."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped():
+        store = _line_store()
+        chaos = FaultSchedule(
+            seed=5,
+            events=[
+                FaultEvent(step=0, point="serve.coord.unreachable", arg=1.2)
+            ],
+        )
+        engine = _line_engine(store)
+        coord = LocalCoordinator(
+            target_world=1, max_world=2, heartbeat_timeout=0.3
+        )
+        replica = ServingReplica(
+            engine,
+            coordinator=coord,
+            replica_id="serve-b",
+            heartbeat_interval=0.05,
+            telemetry_interval=1e9,
+            chaos=chaos,
+        )
+        replica.start()
+        try:
+            chaos.advance(0)
+            deadline = time.monotonic() + 5
+            while not chaos.fired() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert chaos.fired(), "blackout never delivered"
+            # the coordinator hears nothing -> lease expires -> evicted
+            deadline = time.monotonic() + 5
+            while coord.members() and time.monotonic() < deadline:
+                coord.evict_dead()
+                time.sleep(0.05)
+            assert coord.members() == []
+            # ...but the replica keeps serving last-verified weights
+            out, meta = replica.batcher.submit(
+                {"x": np.ones((1, 13), np.float32)}
+            ).result(timeout=10)
+            np.testing.assert_allclose(out["pred"], [14.0], atol=1e-5)
+            # blackout over: the KeyError->re-register rejoin path
+            # reconverges membership
+            deadline = time.monotonic() + 5
+            while not coord.members() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert coord.members() == ["serve-b"]
+        finally:
+            replica.stop()
+
+
+# -- decode dispatch watchdog ------------------------------------------------
+
+
+def test_wedged_decode_dispatch_recovers_via_reprefill_zero_compiles():
+    """The tentpole's watchdog half: a wedged decode dispatch (chaos
+    trip) surfaces as the typed DispatchWedgedError into pool-rebuild +
+    cache-epoch re-prefill — the request SURVIVES (no reject), its
+    final tokens are pure under the one reported generation, and the
+    whole recovery performs zero steady-state XLA compiles."""
+    from tests.test_decode_serving import _reference_decode
+
+    with telemetry.scoped() as (reg, rec):
+        chaos = FaultSchedule(
+            seed=11,
+            events=[FaultEvent(step=0, point="serve.dispatch.wedged")],
+        )
+        model, store, engine = _decode_engine()
+        engine.dispatch_chaos = chaos  # trip source for the watchdog
+        batcher = TokenContinuousBatcher(engine).start()
+        import jax._src.compiler as _compiler
+
+        real = _compiler.backend_compile
+        count = [0]
+
+        def counting(*a, **k):
+            count[0] += 1
+            return real(*a, **k)
+
+        try:
+            rng = np.random.RandomState(0)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :9]
+            t = batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=12, deadline_s=30.0
+            )
+            # let it join the decode batch, then wedge mid-generation
+            deadline = time.monotonic() + 10
+            while not t.tokens and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert t.tokens, "sequence never started"
+            epoch0 = engine.cache_epoch
+            _compiler.backend_compile = counting
+            chaos.advance(0)  # the next dispatch trips the watchdog
+            tokens, meta = t.result(timeout=30)
+        finally:
+            _compiler.backend_compile = real
+            batcher.stop()
+        assert engine.cache_epoch == epoch0 + 1  # pools rebuilt once
+        assert count[0] == 0, (
+            f"{count[0]} XLA compiles during wedge recovery"
+        )
+        assert meta["restarts"] >= 1  # re-prefilled, not rejected
+        assert reg.counter("edl_serve_dispatch_wedged_total").value() == 1
+        trips = [e for e in rec.events() if e.kind == "serve.watchdog"]
+        assert len(trips) == 1 and trips[0].data["what"] == "decode"
+        # purity: the final tokens equal the reference greedy decode
+        # under the single generation the sequence reports
+        w = engine.current_weights()
+        ref = _reference_decode(model, w.params, list(prompt), 12, engine)
+        assert tokens == ref
+        assert not chaos.pending()
+
+
+def test_wedged_chunk_prefill_rewinds_half_prefilled_sequence():
+    """A wedge mid-CHUNK leaves the sequence at the FIFO head; the
+    epoch rewind resets its progress to zero and it still completes
+    with exact first-token parity."""
+    from tests.test_decode_serving import _reference_decode
+
+    with telemetry.scoped():
+        chaos = FaultSchedule(
+            seed=13,
+            events=[FaultEvent(step=0, point="serve.dispatch.wedged")],
+        )
+        model = get_model("longcontext_lm", tiny=True)
+        store = HostDRAMStore()
+        store.save_async(_lm_state(model, 1, 1), generation=0)
+        store.wait()
+        engine = DecodeEngine(
+            model,
+            store,
+            devices=jax.devices()[:1],
+            max_batch=1,
+            max_seqs=2,
+            block_tokens=16,
+            max_chunk_tokens=32,
+        )
+        assert engine.load()
+        engine.warm()
+        engine.dispatch_chaos = chaos
+        batcher = TokenContinuousBatcher(
+            engine, prefill_token_budget=32
+        ).start()
+        try:
+            rng = np.random.RandomState(0)
+            plen = engine.max_context * 3 // 4  # needs several chunks
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :plen]
+            chaos.advance(0)  # first chunk dispatch wedges
+            t = batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=4, deadline_s=60.0
+            )
+            tokens, meta = t.result(timeout=60)
+        finally:
+            batcher.stop()
+        assert engine.pool.used_blocks == 0
+        w = engine.current_weights()
+        ref = _reference_decode(model, w.params, list(prompt), 4, engine)
+        assert tokens == ref
+        assert not chaos.pending()
+
+
+def test_dispatch_timeout_env_and_param_wire_the_watchdog():
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    e = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        dispatch_timeout=7.5,
+    )
+    assert e.dispatch_timeout == 7.5 and e.watchdog.timeout == 7.5
+    # default: disabled (0) — single-process CPU pays no thread hop
+    e2 = DecodeEngine(
+        model, store, devices=jax.devices()[:1], max_batch=1
+    )
+    assert e2.dispatch_timeout == 0.0
+
+
+# -- torn-candidate rejection dedup (soak determinism) -----------------------
+
+
+def test_swap_rejection_counts_once_per_torn_candidate():
+    """A torn candidate sits in the store until a newer clean save; the
+    engine must count/journal its rejection ONCE, not once per refresh
+    poll (and must not re-hash it every poll either)."""
+    with telemetry.scoped() as (reg, rec):
+        chaos = FaultSchedule(
+            seed=7, events=[FaultEvent(step=0, point="serve.swap.torn")]
+        )
+        chaos.advance(0)
+        store = HostDRAMStore(chaos=chaos)
+        store.save_async(_line_state(1.0), generation=0)
+        store.wait()
+        engine = _line_engine(store, chaos=chaos)
+        assert engine.load()
+        engine.warm()
+        store.save_async(_line_state(5.0), generation=1)
+        store.wait()
+        for _ in range(4):  # four polls, one torn candidate
+            assert not engine.refresh()
+        assert reg.counter("edl_serve_swap_rejected_total").value() == 1
+        kinds = [e.kind for e in rec.events()]
+        assert kinds.count("serve.swap.rejected") == 1
+        # a newer clean save still swaps in, and a LATER torn candidate
+        # counts again (dedup is per candidate, not forever)
+        store.save_async(_line_state(7.0), generation=2)
+        store.wait()
+        assert engine.refresh() and engine.weights_step == 7
+
+
+# -- lease expiry: ghost telemetry -------------------------------------------
+
+
+def test_evicted_replica_telemetry_drops_out_of_lane_observations():
+    """Regression (ISSUE 15 satellite): a dead (never-drained) replica
+    with a frozen high-latency histogram and a pinned queue-depth gauge
+    must stop feeding ServingLane observations after lease eviction —
+    a ghost p95 may not pin scaling decisions."""
+    from edl_tpu.autoscaler.serving import ServingLane
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    with telemetry.scoped():
+        clock = [0.0]
+        coord = LocalCoordinator(
+            target_world=2,
+            max_world=4,
+            heartbeat_timeout=5.0,
+            clock=lambda: clock[0],
+        )
+        coord.register("ghost")
+        coord.register("healthy")
+        # the ghost's dying report: terrible p95, deep queue
+        bad = telemetry.MetricsRegistry()
+        h = bad.histogram("edl_serve_latency_seconds")
+        for _ in range(50):
+            h.observe(5.0)
+        bad.gauge("edl_serve_queue_depth").set(40)
+        coord.report_telemetry("ghost", snapshot=bad.snapshot(), seq=1)
+        good = telemetry.MetricsRegistry()
+        h2 = good.histogram("edl_serve_latency_seconds")
+        for _ in range(50):
+            h2.observe(0.004)
+        good.gauge("edl_serve_queue_depth").set(0)
+        coord.report_telemetry("healthy", snapshot=good.snapshot(), seq=1)
+
+        lane = ServingLane(
+            coord,
+            min_replicas=1,
+            max_replicas=4,
+            p95_high_s=0.5,
+            hold_ticks=1,
+        )
+        obs = lane.observe()
+        assert obs["p95_latency_s"] > 0.5  # ghost still reporting: high
+        assert obs["queue_depth"] == 40
+
+        # the ghost dies (no drain); only "healthy" keeps beating
+        clock[0] = 10.0
+        coord.heartbeat("healthy")
+        assert coord.evict_dead() == ["ghost"]
+        merged = coord.telemetry()["merged"]
+        depth = merged["gauges"].get("edl_serve_queue_depth") or {}
+        assert max(depth.values()) == 0  # the pinned gauge is gone
+        # fresh healthy traffic: the ghost's frozen histogram must not
+        # haunt the p95 window
+        for _ in range(50):
+            h2.observe(0.004)
+        coord.report_telemetry("healthy", snapshot=good.snapshot(), seq=2)
+        obs2 = lane.observe()
+        assert obs2["queue_depth"] == 0
+        assert obs2["p95_latency_s"] is None or obs2["p95_latency_s"] < 0.5
+        # and the band proposal no longer chases the ghost
+        proposed, _ = lane.desired_replicas(obs2, 2)
+        assert proposed <= 2
+
+
+# -- lane drain-ack-then-patch ordering --------------------------------------
+
+
+class _Plan:
+    def __init__(self, members, addresses):
+        self.members = tuple(members)
+        self.addresses = tuple(addresses)
+
+
+class _DrainCoord:
+    """Lane double whose plan carries victim addresses."""
+
+    def __init__(self, target, members, addresses):
+        self.target = target
+        self._members = members
+        self._addresses = addresses
+        self.calls = []
+
+    def telemetry(self):
+        return {
+            "merged": {
+                "counters": {},
+                "gauges": {"edl_serve_queue_depth": {"": 0}},
+                "histograms": {},
+            }
+        }
+
+    def metrics(self):
+        return {"target_world": self.target, "world_size": self.target}
+
+    def plan(self):
+        return _Plan(self._members, self._addresses)
+
+    def set_prewarm(self, n, trace_id=""):
+        self.calls.append(("prewarm", n))
+
+    def set_target_world(self, n, trace_id=""):
+        self.calls.append(("target", n))
+        self.target = n
+
+
+class _FakeDrainReplica:
+    """One /drain HTTP endpoint recording its hit and replying an ack."""
+
+    def __init__(self, drained=True):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.hits = []
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                outer.hits.append(
+                    (self.path, json.loads(self.rfile.read(n) or b"{}"))
+                )
+                body = json.dumps(
+                    {"draining": True, "drained": drained, "in_flight": 0}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(
+            target=self._srv.serve_forever, daemon=True
+        ).start()
+        self.address = f"127.0.0.1:{self._srv.server_address[1]}"
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_lane_drains_rank_tail_victims_before_retarget_and_patch():
+    from edl_tpu.autoscaler.serving import ServingLane
+
+    with telemetry.scoped():
+        victim = _FakeDrainReplica(drained=True)
+        try:
+            coord = _DrainCoord(
+                3,
+                ["r0", "r1", "r2"],
+                ["", "", victim.address],  # victim = rank-order tail
+            )
+            patches = []
+            lane = ServingLane(
+                coord,
+                min_replicas=1,
+                max_replicas=4,
+                hold_ticks=1,
+                on_scale=lambda old, new: patches.append((old, new)),
+                victim_drain_timeout=5.0,
+            )
+            entry = lane.run_once()
+            assert entry["actuated"]
+            assert entry["dry_run"]["proposed"] == 2
+            # the victim was drained (with the lane's budget) BEFORE
+            # the retarget and the Deployment patch
+            assert [p for p, _ in victim.hits] == ["/drain"]
+            assert victim.hits[0][1]["budget_ms"] == 5000
+            assert coord.calls == [("prewarm", 2), ("target", 2)]
+            assert patches == [(3, 2)]
+            assert entry["drain"]["acked"]
+            assert [
+                v["replica"] for v in entry["drain"]["victims"]
+            ] == ["r2"]
+        finally:
+            victim.stop()
+
+
+def test_lane_blocks_patch_when_victim_drain_not_acked():
+    """A reachable victim that cannot finish inside the budget blocks
+    the WHOLE actuation this tick — the Deployment patch can never
+    yank an undrained replica; the lane retries next tick."""
+    from edl_tpu.autoscaler.serving import ServingLane
+
+    with telemetry.scoped():
+        victim = _FakeDrainReplica(drained=False)
+        try:
+            coord = _DrainCoord(2, ["r0", "r1"], ["", victim.address])
+            patches = []
+            lane = ServingLane(
+                coord,
+                min_replicas=1,
+                max_replicas=4,
+                hold_ticks=1,
+                on_scale=lambda old, new: patches.append((old, new)),
+                victim_drain_timeout=2.0,
+            )
+            entry = lane.run_once()
+            assert not entry["actuated"]
+            assert "drain not acked" in entry["reason"]
+            assert coord.calls == [] and patches == []
+            assert coord.target == 2  # nothing moved
+        finally:
+            victim.stop()
+
+
+def test_serving_bidder_drains_before_market_scale_down():
+    from edl_tpu.autoscaler.serving import ServingLane
+    from edl_tpu.fleet.bidders import ServingBidder
+
+    with telemetry.scoped():
+        victim = _FakeDrainReplica(drained=True)
+        try:
+            coord = _DrainCoord(2, ["r0", "r1"], ["", victim.address])
+            lane = ServingLane(
+                coord,
+                min_replicas=1,
+                max_replicas=4,
+                victim_drain_timeout=5.0,
+            )
+            bidder = ServingBidder("fleet-a", lane)
+            assert bidder.actuate(1, trace_id="t-1")
+            assert [p for p, _ in victim.hits] == ["/drain"]
+            # drain happened BEFORE the retarget (calls appended after)
+            assert coord.calls == [("prewarm", 1), ("target", 1)]
+            # scale-UP never drains
+            victim.hits.clear()
+            assert bidder.actuate(3, trace_id="t-2")
+            assert victim.hits == []
+        finally:
+            victim.stop()
+
+
+# -- manifests ---------------------------------------------------------------
+
+
+def test_serving_manifests_carry_drain_grace_and_env():
+    from edl_tpu.controller.jobparser import (
+        SERVE_DRAIN_MS,
+        SERVE_TERMINATION_GRACE_S,
+        parse_to_serving_manifests,
+    )
+    from edl_tpu.resource.training_job import TrainingJob
+    from tests.test_serving import SERVING_JOB_YAML
+
+    job = TrainingJob.from_yaml(SERVING_JOB_YAML).validate()
+    dep = parse_to_serving_manifests(job)[2]
+    pod = dep["spec"]["template"]["spec"]
+    assert (
+        pod["terminationGracePeriodSeconds"] == SERVE_TERMINATION_GRACE_S
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in pod["containers"][0]["env"]
+    }
+    assert env["EDL_SERVE_DRAIN_MS"] == str(SERVE_DRAIN_MS)
+    # the grace period must exceed the drain budget (SIGKILL never
+    # beats a drain)
+    assert SERVE_TERMINATION_GRACE_S * 1000 > SERVE_DRAIN_MS

@@ -255,6 +255,7 @@ def bench_serving() -> dict:
         "decode": bench_decode(),
         "interference": bench_interference(),
         "drain": bench_drain(),
+        "migrate": bench_migrate(),
     }
 
 
@@ -818,5 +819,194 @@ def bench_interference() -> dict:
             "restarted_mid_generation": restarted_mid_swap[0],
         },
         "dropped_sequences": dropped,
+        "steady_state_xla_compiles": steady_compiles,
+    }
+
+
+def bench_migrate() -> dict:
+    """Live KV sequence migration section (ISSUE 16): repeated drain
+    rounds of a replica with a DELIBERATELY long generation in flight,
+    each handing the sequence to a surviving replica over the chunked
+    TCP push.  Per round: the drain must ack while the generation is
+    still decoding on the survivor (drain latency is O(KV transfer),
+    not O(generation)), and the migrated sequence's final tokens must
+    equal the unmigrated same-seed reference BIT-EXACTLY.  Gated:
+    bit_identical == true, dropped == 0, steady-state compiles == 0
+    (round 0 warms the import scatter executables), drain p95 under
+    the threshold."""
+    import time
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import (
+        DecodeEngine,
+        MigrationReceiver,
+        ServingReplica,
+        TokenContinuousBatcher,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = get_model("transformer_lm", tiny=not on_tpu)
+    opt = optax.adam(1e-3)
+    params = model.init_params(jax.random.key(1))
+    store = HostDRAMStore()
+    store.save_async(
+        TrainState(
+            step=jnp.asarray(1, jnp.int32),
+            params=params,
+            opt_state=opt.init(params),
+        )
+    )
+    store.wait()
+
+    def _engine():
+        e = DecodeEngine(
+            model,
+            store,
+            devices=jax.devices()[:1],
+            max_batch=1,
+            max_seqs=4,
+            block_tokens=16,
+        )
+        e.load()
+        e.warm()
+        return e
+
+    victim_engine = _engine()
+    survivor_engine = _engine()
+    survivor_b = TokenContinuousBatcher(
+        survivor_engine, refresh=False, default_deadline_s=120.0
+    ).start()
+    receiver = MigrationReceiver(
+        survivor_engine, survivor_b, replica_id="bench-survivor"
+    ).start()
+    dest = f"tcp://127.0.0.1:{receiver.port}"
+
+    prompt = list(range(1, 9))
+    max_new = 48
+
+    import jax._src.compiler as _compiler
+
+    reg = telemetry.get_registry()
+    m_compiles = reg.counter("edl_xla_compiles_total")
+    _real_bc = _compiler.backend_compile
+
+    def _counting_bc(*args, **kwargs):
+        m_compiles.inc()
+        return _real_bc(*args, **kwargs)
+
+    rounds = 4  # round 0 warms the export/import executables
+    latencies_ms = []
+    warmup_ms = None
+    tokens_at_ack = []
+    migrated_rounds = 0
+    drained_all = True
+    dropped = 0
+    results = []
+    compiles_steady_before = None
+    _compiler.backend_compile = _counting_bc
+    try:
+        for n in range(rounds):
+            if n == 1:
+                compiles_steady_before = m_compiles.value()
+            replica = ServingReplica(
+                victim_engine,
+                replica_id=f"bench-migrate-{n}",
+                heartbeat_interval=60.0,
+                telemetry_interval=1e9,
+            )
+            replica.start()
+            t = replica.gen_batcher.submit_generate(
+                {"tokens": prompt},
+                max_new_tokens=max_new,
+                deadline_s=120.0,
+            )
+            # a long generation genuinely mid-flight (and past one KV
+            # block, so every round pushes the same block count)
+            deadline = time.monotonic() + 30
+            while len(t.tokens) < 10 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            r = replica.drain(budget_s=60.0, migrate_to=dest)
+            at_ack = len(t.tokens)
+            drained_all = drained_all and bool(r["drained"])
+            migrated_rounds += int(
+                r.get("progress", {}).get("migrated", 0) == 1
+            )
+            if n == 0:
+                warmup_ms = round(r["seconds"] * 1000.0, 3)
+            else:
+                latencies_ms.append(round(r["seconds"] * 1000.0, 3))
+            tokens_at_ack.append(at_ack)
+            tokens, meta = t.result(timeout=120)
+            if len(tokens) != max_new:
+                dropped += 1
+            results.append(list(tokens))
+            replica.stop()
+    finally:
+        _compiler.backend_compile = _real_bc
+        survivor_b.stop()
+        receiver.stop()
+    steady_compiles = int(m_compiles.value() - compiles_steady_before)
+
+    # Unmigrated same-seed reference (compiled OUTSIDE the seam): the
+    # greedy decode the migrated tokens must equal bit-for-bit.
+    spec = model.decode
+    eng = victim_engine
+    kp = jnp.zeros(
+        (
+            spec.layers,
+            eng.blocks_per_seq + 1,
+            eng.block_tokens,
+            spec.heads,
+            spec.head_dim,
+        ),
+        spec.cache_dtype,
+    )
+    vp = jnp.zeros_like(kp)
+    tab = np.arange(1, eng.blocks_per_seq + 1, dtype=np.int32)[None]
+    plen = len(prompt)
+    tok = np.zeros((1, eng.prompt_bucket_for(plen)), np.int32)
+    tok[0, :plen] = prompt
+    ids, kp, vp = jax.jit(spec.prefill_fn)(
+        params, tok, np.asarray([plen], np.int32), kp, vp, tab
+    )
+    ref = [int(ids[0])]
+    ln = np.asarray([plen], np.int32)
+    dec = jax.jit(spec.decode_fn)
+    while len(ref) < max_new:
+        ids, kp, vp = dec(
+            params, np.asarray([ref[-1]], np.int32), ln, kp, vp, tab
+        )
+        ref.append(int(ids[0]))
+        ln = ln + 1
+    bit_identical = all(toks == ref for toks in results)
+
+    assert drained_all, "a bench drain missed its budget"
+    assert dropped == 0, f"{dropped} sequences dropped across migrations"
+    assert bit_identical, "migrated tokens diverged from the reference"
+    assert migrated_rounds == rounds, "a round fell off the migrate path"
+    ordered = sorted(latencies_ms)
+    return {
+        "rounds": rounds,
+        "max_new_tokens": max_new,
+        "drain_latency_ms": latencies_ms,
+        "warmup_round_ms": warmup_ms,
+        "drain_latency_p50_ms": ordered[len(ordered) // 2],
+        "drain_latency_p95_ms": ordered[-1],
+        "tokens_at_ack": tokens_at_ack,
+        "ack_before_generation_end": all(
+            a < max_new for a in tokens_at_ack
+        ),
+        "migrated_rounds": migrated_rounds,
+        "bit_identical": bit_identical,
+        "dropped": dropped,
+        "drained_all": drained_all,
         "steady_state_xla_compiles": steady_compiles,
     }

@@ -32,22 +32,29 @@ from edl_tpu.telemetry.aggregate import histogram_quantile
 
 
 def post_drain(
-    address: str, budget_s: float, timeout: Optional[float] = None
+    address: str,
+    budget_s: float,
+    timeout: Optional[float] = None,
+    migrate_to: Optional[str] = None,
 ) -> dict:
     """POST /drain to one serving replica and block for its ack (the
     reply carries ``drained``).  The scale-down actuators call this
     per victim BEFORE touching the Deployment — drain-victim-ack-then-
-    patch, mirroring training's consensus victim-drain wait."""
+    patch, mirroring training's consensus victim-drain wait.
+    ``migrate_to`` names a surviving replica: the victim hands its
+    live KV sequences over instead of waiting them out, so the ack
+    arrives in O(KV transfer) rather than O(longest generation)."""
     import json
     import urllib.request
 
     if "://" not in address:
         address = f"http://{address}"
+    body = {"budget_ms": int(budget_s * 1000.0), "wait": True}
+    if migrate_to:
+        body["migrate_to"] = migrate_to
     req = urllib.request.Request(
         address.rstrip("/") + "/drain",
-        data=json.dumps(
-            {"budget_ms": int(budget_s * 1000.0), "wait": True}
-        ).encode(),
+        data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
@@ -301,12 +308,31 @@ class ServingLane:
         members = list(plan.members)
         addresses = list(plan.addresses)
         addresses += [""] * (len(members) - len(addresses))
+        # Survivor for live KV migration: the first addressed member
+        # that STAYS in the plan.  Victims hand their in-flight
+        # generations to it instead of waiting them out — the ack
+        # latency becomes O(KV transfer); a fleet with no addressed
+        # survivor (in-process tests) falls back to the bounded wait.
+        migrate_to = next(
+            (a for _, a in list(zip(members, addresses))[:proposed] if a),
+            None,
+        )
+        if migrate_to:
+            info["migrate_to"] = migrate_to
         for rid, addr in list(zip(members, addresses))[proposed:]:
             entry = {"replica": rid, "address": addr, "acked": True}
             if addr:
                 try:
-                    r = post_drain(addr, self.victim_drain_timeout)
+                    r = post_drain(
+                        addr,
+                        self.victim_drain_timeout,
+                        migrate_to=migrate_to,
+                    )
                     entry["acked"] = bool(r.get("drained"))
+                    if "migrate" in r:
+                        entry["migrated"] = r.get("progress", {}).get(
+                            "migrated", 0
+                        )
                 except Exception as e:
                     # ONLY connection-refused is evidence of death
                     # (nothing listening -> nothing live to yank; the

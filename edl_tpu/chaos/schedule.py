@@ -105,6 +105,23 @@ KNOWN_POINTS = (
                                  # vanishes for arg seconds — it must
                                  # keep serving last-verified weights
                                  # and reconverge on return
+    # (8c) live KV sequence migration (ISSUE 16)
+    "serve.migrate.kill",        # source dies mid-push: the socket is
+                                 # torn down before DONE and the dest
+                                 # must free its granted blocks while
+                                 # the source walks the fallback ladder
+    "serve.migrate.torn",        # one received KV chunk is corrupted
+                                 # in flight (per-chunk crc catches it,
+                                 # dest refuses, source re-prefills the
+                                 # sequence cold on the survivor)
+    "serve.migrate.exhausted",   # dest KV pool reports exhaustion at
+                                 # the offer (refused grant: the source
+                                 # falls back to a cold re-prefill)
+    "serve.migrate.swap",        # a hot swap lands on the dest between
+                                 # block grant and batcher adoption —
+                                 # the generation-key check must route
+                                 # the sequence to re-prefill, never
+                                 # mix weights generations
 )
 
 

@@ -310,16 +310,21 @@ def _gather(fabric, vec: np.ndarray, expect_msg: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def _tune(sock: socket.socket) -> None:
+def tune_socket(sock: socket.socket) -> None:
     """Bulk-transfer socket tuning: no Nagle (chunk headers must not
     wait behind payload), generous kernel buffers (64MB application
-    chunks over default ~200KB buffers thrash context switches)."""
+    chunks over default ~200KB buffers thrash context switches).
+    Shared with the live KV migration stream (serving/migrate.py),
+    which moves filled cache blocks over the same chunked wire."""
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 << 20)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
     except OSError:  # pragma: no cover - platform-dependent caps
         pass
+
+
+_tune = tune_socket
 
 
 def _recv_exact(sock: socket.socket, view: memoryview) -> None:

@@ -363,6 +363,29 @@ def cmd_metrics(args) -> int:
                 f"  {'drain_seconds_mean':<24} "
                 f"{dsum / dcount:.3f}"
             )
+        # Live KV migration counters (ISSUE 16): sequences drains
+        # handed to survivors instead of waiting out, the KV bytes
+        # that moved, and the re-prefill fallbacks the ladder took.
+        mig = counters_all.get("edl_serve_migrations_total") or {}
+        if mig:
+            print(f"  {'migrations_total':<24} {sum(mig.values()):g}")
+            fb = sum(
+                v for k, v in mig.items() if "outcome=fallback" in k
+            )
+            print(f"  {'migrate_fallbacks':<24} {fb:g}")
+            mb = counters_all.get(
+                "edl_serve_migrations_bytes_total"
+            ) or {}
+            if mb:
+                print(
+                    f"  {'migrated_kv_bytes':<24} {sum(mb.values()):g}"
+                )
+            msec = hists_all.get("edl_serve_migrate_seconds")
+            m95 = histogram_quantile(msec, 0.95) if msec else None
+            print(
+                f"  {'migrate_p95':<24} "
+                f"{f'{m95 * 1000:.1f} ms' if m95 is not None else 'n/a'}"
+            )
         tok = counters_all.get("edl_serve_tokens_total") or {}
         if tok:
             # Decode stats (the token-iteration path): tokens/s is the

@@ -290,11 +290,14 @@ class ServingBidder:
         if units < before:
             # Drain-victim-ack-then-patch (ISSUE 15): the market's
             # serving scale-downs follow the SAME contract as the
-            # lane's — victims finish their in-flight generations
-            # before the retarget drops them and the Deployment patch
-            # deletes their pods.  No ack -> no actuation this tick;
-            # the arbiter's fixed point re-proposes next tick and the
-            # already-started drain is usually finished by then.
+            # lane's — and ride the lane's live KV migration (ISSUE
+            # 16): drain_victims picks a surviving replica and each
+            # victim hands its in-flight generations over instead of
+            # waiting them out, so a market preemption acks in O(KV
+            # transfer), not O(longest generation).  No ack -> no
+            # actuation this tick; the arbiter's fixed point
+            # re-proposes next tick and the already-started drain is
+            # usually finished by then.
             try:
                 drain = self.lane.drain_victims(before, units)
             except Exception:

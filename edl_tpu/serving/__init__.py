@@ -23,6 +23,13 @@ Long prompts prefill in block-aligned CHUNKS under a per-iteration
 token budget riding beside the decode step (Sarathi-Serve's
 stall-free batching), so an admission never stalls the running
 batch's token cadence.
+
+Drains and preemptions MIGRATE live sequences instead of waiting
+(``edl_tpu.serving.migrate``): filled KV blocks + cursor move to a
+survivor over a fabric-style chunked-TCP push and decode resumes
+mid-generation, bit-identical to an unmigrated run — drain latency is
+O(KV bytes), independent of generation length, with re-prefill on the
+survivor as the fallback ladder's last rung.
 """
 
 from edl_tpu.serving.batcher import (
@@ -42,6 +49,13 @@ from edl_tpu.serving.engine import (
     NotReadyError,
     PromptTooLongError,
 )
+from edl_tpu.serving.migrate import (
+    MigrationError,
+    MigrationReceiver,
+    MigrationRefusedError,
+    TornMigrationError,
+    migrate_out,
+)
 from edl_tpu.serving.server import ServingReplica, ServingServer, serve_run
 
 __all__ = [
@@ -53,6 +67,9 @@ __all__ = [
     "GenerateTicket",
     "InferenceEngine",
     "KVBlockPool",
+    "MigrationError",
+    "MigrationReceiver",
+    "MigrationRefusedError",
     "NotReadyError",
     "PromptTooLongError",
     "QueueFullError",
@@ -60,5 +77,7 @@ __all__ = [
     "ServingServer",
     "Ticket",
     "TokenContinuousBatcher",
+    "TornMigrationError",
+    "migrate_out",
     "serve_run",
 ]

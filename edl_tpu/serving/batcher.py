@@ -34,6 +34,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -365,7 +366,7 @@ class GenerateTicket:
         "prompt", "max_new", "deadline", "eos_id", "enqueued", "on_event",
         "state", "blocks", "table", "length", "last_token", "tokens",
         "restarts", "last_time", "prefilled", "chunks", "first_time",
-        "_done", "_result", "_error",
+        "migrated", "_done", "_result", "_error",
     )
 
     def __init__(
@@ -402,6 +403,10 @@ class GenerateTicket:
         #: enqueue -> first token across ALL chunks (ISSUE 14
         #: satellite), and a restart never moves it
         self.first_time: Optional[float] = None
+        #: this sequence was handed to a survivor replica (live KV
+        #: migration or cold requeue) — it no longer counts toward the
+        #: local drain; its caller's future resolves via the relay
+        self.migrated = False
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -522,13 +527,27 @@ class TokenContinuousBatcher:
         self._queued_tokens = 0
         self._prefilling_tokens = 0
         self._active: List[GenerateTicket] = []
+        #: sequences migrated IN (KV already imported into granted
+        #: blocks) awaiting adoption at the next token boundary:
+        #: (ticket, weights_step, weights_digest, cache_epoch) entries
+        self._adopted: deque = deque()
         self._stop = False
         #: admission closed (drain): submit_generate raises
         #: DrainingError; queued/prefilling/active sequences finish
         self._draining = False
+        #: token-boundary freeze handshake (live migration export):
+        #: the exporter raises _freeze_req, the worker parks and acks,
+        #: _resume releases it
+        self._freeze_req = threading.Event()
+        self._frozen_ack = threading.Event()
+        self._resume = threading.Event()
+        #: serializes frozen() callers (a drain export racing a
+        #: migration import would otherwise share one ack handshake)
+        self._freeze_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._bound_gen = -1
         self._bound_step = -1
+        self._bound_digest = -1
         self._bound_epoch = 0  # engine.cache_epoch last observed
         self.stats = {"iterations": 0, "prefills": 0, "swaps": 0,
                       "restarts": 0, "chunks": 0}
@@ -606,16 +625,23 @@ class TokenContinuousBatcher:
         return self._draining
 
     @property
+    def adopted_count(self) -> int:
+        with self._cv:
+            return len(self._adopted)
+
+    @property
     def in_flight(self) -> int:
         """Sequences admitted but not yet resolved: queued + mid-
-        prefill + the active decode batch.  The drain loop polls this
-        to 0 — a drained replica's KV pool is empty by construction
-        (every finish path frees its blocks the same iteration)."""
+        prefill + the active decode batch + migrated-in sequences
+        awaiting adoption.  The drain loop polls this to 0 — a drained
+        replica's KV pool is empty by construction (every finish path
+        frees its blocks the same iteration)."""
         with self._cv:
             return (
                 len(self._queue)
                 + len(self._prefilling)
                 + len(self._active)
+                + len(self._adopted)
             )
 
     def close_admission(self) -> None:
@@ -625,6 +651,154 @@ class TokenContinuousBatcher:
         finish and frees its KV blocks."""
         with self._cv:
             self._draining = True
+
+    # -- live KV sequence migration -----------------------------------------
+    @contextmanager
+    def frozen(self):
+        """Park the worker at a token boundary and hold it there while
+        the migration exporter reads pool device buffers and batch
+        state — no donated dispatch can invalidate either until the
+        block exits.  The worker resumes even if the body raises; if
+        the worker isn't running the state is already still."""
+        with self._freeze_lock:
+            alive = self._thread is not None and self._thread.is_alive()
+            if not alive:
+                yield
+                return
+            self._resume.clear()
+            self._frozen_ack.clear()
+            self._freeze_req.set()
+            with self._cv:
+                self._cv.notify_all()
+            self._frozen_ack.wait(timeout=30.0)
+            try:
+                yield
+            finally:
+                self._freeze_req.clear()
+                self._resume.set()
+
+    def detach(self, t: GenerateTicket) -> None:
+        """Remove a decoding sequence from the active batch and free
+        its blocks (its K/V is already snapshotted host-side).  Caller
+        must hold the worker frozen."""
+        if t in self._active:
+            self._active.remove(t)
+        self._free_blocks(t)
+        t.migrated = True
+
+    def take_cold(self) -> List[GenerateTicket]:
+        """Detach every queued and half-prefilled sequence for COLD
+        handoff to a survivor: they streamed nothing, so a requeue on
+        the dest re-prefills the prompt with no restart event and no
+        claim on the local drain budget.  Caller must hold the worker
+        frozen."""
+        out: List[GenerateTicket] = []
+        with self._cv:
+            while self._queue:
+                t = self._queue.popleft()
+                self._queued_tokens -= int(t.prompt.shape[0])
+                out.append(t)
+            self._g_depth.set(0)
+        while self._prefilling:
+            t = self._prefilling.popleft()
+            self._prefilling_tokens -= int(t.prompt.shape[0]) - t.prefilled
+            self._free_blocks(t)
+            t.prefilled = 0
+            t.state = _QUEUED
+            out.append(t)
+        for t in out:
+            t.migrated = True
+        return out
+
+    def readmit(self, t: GenerateTicket) -> None:
+        """Fallback ladder's LAST rung: every survivor path failed, so
+        the sequence comes back to the local queue and the drain waits
+        it out (the PR 15 posture).  Streamed tokens are void — it
+        re-prefills under the local weights."""
+        t.migrated = False
+        if t.tokens:
+            t.restarts += 1
+            self.stats["restarts"] += 1
+            self._m_restarts.inc()
+            t._event({"restart": True, "reason": "migration failed"})
+        t.state = _QUEUED
+        t.tokens = []
+        t.length = 0
+        t.last_token = 0
+        t.prefilled = 0
+        with self._cv:
+            self._queue.appendleft(t)
+            self._queued_tokens += int(t.prompt.shape[0])
+            self._g_depth.set(len(self._queue))
+            self._cv.notify()
+
+    def adopt(
+        self,
+        t: GenerateTicket,
+        weights_step: int,
+        weights_digest: int,
+        cache_epoch: int,
+    ) -> None:
+        """Hand a migrated-in sequence (K/V already imported into its
+        granted pool blocks) to the worker for adoption at the next
+        token boundary.  ``weights_step``/``weights_digest`` name the
+        checkpoint the K/V was produced under; the worker re-checks
+        them at adoption and routes any skew to a cold re-prefill.
+        Runs on the migration receiver's thread."""
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("batcher stopped")
+            self._adopted.append(
+                (t, int(weights_step), int(weights_digest), int(cache_epoch))
+            )
+            self._cv.notify()
+
+    def _adopt_pending(self, w) -> int:
+        """Place migrated-in sequences into the active decode batch.
+        The generation-key check happens HERE, at the token boundary:
+        if a hot swap or pool rebuild landed between the import grant
+        and adoption, the imported cache is unusable — the sequence
+        re-prefills cold (a restart event, never a mixed-generation
+        token)."""
+        adopted = 0
+        while True:
+            with self._cv:
+                if not self._adopted:
+                    return adopted
+                t, step, digest, epoch = self._adopted.popleft()
+            stale = (
+                step != w.step
+                or digest != w.digest
+                or epoch != getattr(self.engine, "cache_epoch", 0)
+            )
+            if stale or len(self._active) >= self.engine.max_seqs:
+                self._free_blocks(t)
+                t.state = _QUEUED
+                t.tokens = []
+                t.length = 0
+                t.last_token = 0
+                t.prefilled = 0
+                t.restarts += 1
+                t._event(
+                    {
+                        "restart": True,
+                        "weights_generation": w.generation,
+                        "weights_step": w.step,
+                    }
+                )
+                self.stats["restarts"] += 1
+                self._m_restarts.inc()
+                with self._cv:
+                    self._queue.appendleft(t)
+                    self._queued_tokens += int(t.prompt.shape[0])
+                    self._g_depth.set(len(self._queue))
+                continue
+            t.state = _DECODING
+            t.last_time = time.monotonic()
+            self._active.append(t)
+            adopted += 1
+            if self._seq_finished(t):
+                self._finish(t)
 
     # -- admission ----------------------------------------------------------
     def submit_generate(
@@ -1074,7 +1248,9 @@ class TokenContinuousBatcher:
                     not self._queue
                     and not self._active
                     and not self._prefilling
+                    and not self._adopted
                     and not self._stop
+                    and not self._freeze_req.is_set()
                 ):
                     self._cv.wait(timeout=0.5)
                 if self._stop:
@@ -1082,7 +1258,18 @@ class TokenContinuousBatcher:
                     self._queue.clear()
                     self._queued_tokens = 0
                     self._g_depth.set(0)
+                    adopted = [e[0] for e in self._adopted]
+                    self._adopted.clear()
                     break
+            if self._freeze_req.is_set():
+                # Token-boundary FREEZE (live migration): the exporter
+                # owns the pool buffers and batch state until resume —
+                # parking here is what makes the device->host KV
+                # gather safe against the next donated dispatch.
+                self._frozen_ack.set()
+                self._resume.wait(timeout=60.0)
+                self._frozen_ack.clear()
+                continue
             # 1. swap check — at the token boundary only.  Guarded:
             # a swap-path failure costs the swap, never the worker.
             try:
@@ -1114,7 +1301,11 @@ class TokenContinuousBatcher:
                     self._restart_active(w.generation, w.step)
                 self._bound_gen = w.generation
                 self._bound_step = w.step
+                self._bound_digest = w.digest
                 self._bound_epoch = epoch
+            # 1b. adopt migrated-in sequences (generation-key checked
+            # against the weights just bound — skew re-prefills cold).
+            adopted_work = self._adopt_pending(w) if self._adopted else 0
             # 2. token-boundary join + budgeted prefill work;
             # 3. one decode iteration for the active batch.  The time
             # admission work holds up an already-running batch is the
@@ -1139,6 +1330,7 @@ class TokenContinuousBatcher:
                 # resolve WRONG before the next iteration's epoch
                 # check could rewind it.  Skip straight to the rewind.
                 continue
+            progress += adopted_work
             progress += self._decode_iteration(w)
             self._g_active.set(len(self._active))
             self._g_kv.set(self.engine.pool.occupancy())
@@ -1150,8 +1342,9 @@ class TokenContinuousBatcher:
                 # and nobody could join: nothing can change until a
                 # deadline expires or blocks free, so don't busy-spin.
                 time.sleep(0.01)
-        # stopped: nothing queued, prefilling or active survives.
-        for t in queued + list(self._prefilling) + list(self._active):
+        # stopped: nothing queued, adopted, prefilling or active
+        # survives.
+        for t in queued + adopted + list(self._prefilling) + list(self._active):
             self._free_blocks(t)
             self._m_requests.inc(status="error")
             t._reject(RuntimeError("batcher stopped"))

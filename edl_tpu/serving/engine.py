@@ -1167,3 +1167,41 @@ class DecodeEngine(InferenceEngine):
             np.asarray(lengths, np.int32),
             np.asarray(tables, np.int32),
         )
+
+    # -- live KV sequence migration (device<->host block movement) ------
+
+    def export_kv(
+        self, block_ids: Sequence[int]
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather a sequence's filled K/V blocks to host memory for
+        migration: [layers, n, block_tokens, heads, head_dim] per
+        plane.  Must run at a token boundary with the batcher frozen —
+        the next donated dispatch invalidates the pool buffers these
+        reads come from."""
+        ids = np.asarray(list(block_ids), np.int32)
+        k = np.asarray(jax.device_get(self.pool.kpool[:, ids]))
+        v = np.asarray(jax.device_get(self.pool.vpool[:, ids]))
+        return k, v
+
+    def import_kv(
+        self,
+        block_ids: Sequence[int],
+        k: np.ndarray,
+        v: np.ndarray,
+    ) -> None:
+        """Scatter migrated host K/V planes into freshly granted pool
+        slots (the dest half of a live migration).  Rebinds the pool
+        arrays like ``_run`` does after a donated dispatch, keeping the
+        replicated sharding the held executables were lowered for."""
+        import jax.numpy as jnp
+
+        pool = self.pool
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        pool.kpool = jax.device_put(
+            pool.kpool.at[:, ids].set(jnp.asarray(k, pool.kpool.dtype)),
+            self._replicated,
+        )
+        pool.vpool = jax.device_put(
+            pool.vpool.at[:, ids].set(jnp.asarray(v, pool.vpool.dtype)),
+            self._replicated,
+        )

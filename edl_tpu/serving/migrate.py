@@ -1,0 +1,825 @@
+"""Live KV sequence migration: drains and preemptions that never wait
+on a generation.
+
+PR 15 made drains graceful but WAITING — drain latency was the longest
+in-flight generation, and a budget miss left the replica stuck
+registered.  This module closes ROADMAP item 2(b): at a token boundary
+the token batcher freezes, a sequence's filled KV blocks are gathered
+device->host through the pool's block table, and a fabric-style
+chunked-TCP push (the PR 12 shard-transfer wire: per-chunk crc32,
+advertised per-leaf digests, typed errors) lands them on a survivor
+whose engine imports the blocks into freshly granted pool slots and
+resumes decode MID-GENERATION.  Greedy decode is a pure function of
+(weights, written K/V, cursor), so the survivor's remaining tokens are
+bit-identical to an unmigrated same-seed run — asserted by tests and
+the gated bench section.  Drain latency becomes O(KV bytes / NIC),
+independent of generation length (the Pathways posture PAPERS.md
+credits: one control plane MOVING work, not killing it, at the
+paged-KV block granularity the Orca/vLLM entries established).
+
+Mixed weights generations are forbidden end to end: the offer carries
+the source checkpoint's ``(step, digest)`` content key (engine-local
+generation counters don't travel), the dest refuses skew at the offer,
+and the batcher re-checks at adoption — a hot swap landing between
+grant and adoption routes the sequence to a cold re-prefill, never to
+a token computed under different weights than its prefix.
+
+Every failure mode degrades down a ladder, never to a hang:
+
+1. **KV push** — blocks + cursor move, decode resumes mid-generation.
+2. **Cold re-prefill on the survivor** — torn push, refused offer,
+   KV-exhausted dest, generation skew: the sequence restarts as a
+   fresh prompt on the dest (streamed tokens voided via a restart
+   event, exactly the hot-swap contract).
+3. **Readmit locally** — the survivor is unusable entirely: the
+   sequence re-enters the local queue and PR 15's bounded drain wait
+   covers it.
+
+After a successful handoff the source keeps the client connection: a
+relay thread forwards the survivor's token/done events back through
+the original ticket, so callers streaming from the draining replica
+never observe the move.
+
+Chaos points (seeded, journal bit-identically): ``serve.migrate.kill``
+(source dies mid-push), ``serve.migrate.torn`` (corrupt chunk),
+``serve.migrate.exhausted`` (dest pool refuses the grant),
+``serve.migrate.swap`` (hot swap between grant and adoption).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from edl_tpu.checkpoint.transfer import tune_socket
+from edl_tpu.serving.batcher import (
+    _DECODING,
+    DrainingError,
+    GenerateTicket,
+    QueueFullError,
+)
+
+#: distinct from the checkpoint fabric's magic — a migration socket
+#: accidentally pointed at a shard receiver must fail loudly, not parse
+_MAGIC = 0xED16_0A11
+#: JSON control frame: magic, payload length
+_FRAME = struct.Struct("<II")
+#: KV chunk header: magic, leaf index, offset, length, crc32(payload)
+_CHUNK_HDR = struct.Struct("<IIQQI")
+_DONE_LEAF = 0xFFFF_FFFF
+_CHUNK_BYTES = 1 << 20
+
+
+class MigrationError(RuntimeError):
+    """A live KV migration failed (peer unreachable, torn stream,
+    protocol violation).  Recoverable by construction: the caller
+    walks the fallback ladder — cold re-prefill on the survivor, then
+    readmit-and-wait locally."""
+
+
+class TornMigrationError(MigrationError):
+    """A received KV chunk failed its crc (or a leaf its chained
+    digest): the dest refused the import and freed its grant."""
+
+
+class MigrationRefusedError(MigrationError):
+    """The dest refused the offer before any KV bytes moved (draining,
+    not ready, generation skew, KV pool exhausted, no decode slot)."""
+
+
+def _recv_exact(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    while got < len(view):
+        n = sock.recv_into(view[got:], len(view) - got)
+        if n == 0:
+            raise MigrationError("migration peer closed mid-stream")
+        got += n
+
+
+def _send_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    data = json.dumps(obj, sort_keys=True).encode()
+    sock.sendall(_FRAME.pack(_MAGIC, len(data)) + data)
+
+
+def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    hdr = bytearray(_FRAME.size)
+    _recv_exact(sock, memoryview(hdr))
+    magic, length = _FRAME.unpack(hdr)
+    if magic != _MAGIC:
+        raise MigrationError(f"bad migration frame magic {magic:#x}")
+    if length > (64 << 20):
+        raise MigrationError(f"oversized migration frame ({length} bytes)")
+    body = bytearray(length)
+    _recv_exact(sock, memoryview(body))
+    return json.loads(bytes(body).decode())
+
+
+def _seq_meta(t: GenerateTicket) -> Dict[str, Any]:
+    """The cursor + sampled tokens + budget — everything the survivor
+    needs to resume (or restart) the generation.  The remaining
+    deadline travels as a relative budget: monotonic clocks don't
+    compare across hosts."""
+    now = time.monotonic()
+    return {
+        "prompt": [int(x) for x in t.prompt],
+        "tokens": [int(x) for x in t.tokens],
+        "length": int(t.length),
+        "last_token": int(t.last_token),
+        "max_new": int(t.max_new),
+        "eos_id": t.eos_id,
+        "deadline_left_s": round(max(0.001, t.deadline - now), 6),
+        "restarts": int(t.restarts),
+        "chunks": int(t.chunks),
+        "ttft_s": (
+            round(t.first_time - t.enqueued, 6)
+            if t.first_time is not None
+            else None
+        ),
+    }
+
+
+def snapshot_sequence(engine, t: GenerateTicket, weights) -> Dict[str, Any]:
+    """Export one decoding sequence's migration image: filled KV
+    blocks gathered device->host (leaf j = K block j, leaf n+j = V
+    block j, each contiguous) plus the offer frame with per-leaf sizes
+    and crc32 digests.  MUST run with the batcher frozen — the next
+    donated dispatch invalidates the buffers the gather reads."""
+    bt = engine.block_tokens
+    nblk = max(1, -(-int(t.length) // bt))
+    ids = list(t.blocks[:nblk])
+    k, v = engine.export_kv(ids)
+    leaves: List[bytes] = []
+    for plane in (k, v):
+        for j in range(nblk):
+            leaves.append(np.ascontiguousarray(plane[:, j]).tobytes())
+    hello = {
+        "mode": "kv",
+        "blocks": nblk,
+        "weights_step": int(weights.step),
+        "weights_digest": int(weights.digest),
+        "leaf_sizes": [len(b) for b in leaves],
+        "leaf_crcs": [zlib.crc32(b) for b in leaves],
+        "seq": _seq_meta(t),
+    }
+    return {"hello": hello, "leaves": leaves}
+
+
+def _relay(sock: socket.socket, t: GenerateTicket) -> None:
+    """Source-side relay: forward the survivor's stream back through
+    the original ticket so the caller never observes the move.  Runs
+    until the survivor resolves the sequence (done/error) or the
+    socket dies (then the caller's future fails — the request was
+    already off this replica's books)."""
+    try:
+        while True:
+            fr = _recv_frame(sock)
+            if "token" in fr:
+                t.tokens.append(int(fr["token"]))
+                t._event(fr)
+            elif fr.get("restart"):
+                t.tokens = []
+                t.restarts += 1
+                t._event(fr)
+            elif fr.get("done"):
+                t.tokens = [int(x) for x in fr.get("tokens", [])]
+                meta = {
+                    k: v for k, v in fr.items() if k not in ("done", "tokens")
+                }
+                meta["migrated"] = True
+                t._result = (list(t.tokens), meta)
+                t._event({"done": True, "tokens": list(t.tokens), **meta})
+                t._done.set()
+                return
+            elif "error" in fr:
+                t._reject(MigrationError(str(fr["error"])))
+                return
+    except Exception as e:
+        if not t._done.is_set():
+            t._reject(MigrationError(f"migration relay lost: {e}"))
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+
+def _start_relay(sock: socket.socket, t: GenerateTicket) -> None:
+    sock.settimeout(None)
+    threading.Thread(
+        target=_relay, args=(sock, t), daemon=True,
+        name="edl-migrate-relay",
+    ).start()
+
+
+def _open(host: str, port: int, timeout: float) -> socket.socket:
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+    except OSError as e:
+        raise MigrationRefusedError(
+            f"migration dest {host}:{port} unreachable: {e}"
+        )
+    tune_socket(sock)
+    sock.settimeout(timeout)
+    return sock
+
+
+def _finish_handoff(
+    sock: socket.socket, t: GenerateTicket
+) -> Dict[str, Any]:
+    """Read the dest's result frame; on acceptance hand the socket to
+    the relay thread (the caller keeps streaming from us)."""
+    res = _recv_frame(sock)
+    if not res.get("ok"):
+        reason = str(res.get("reason", "unknown"))
+        if reason == "torn":
+            raise TornMigrationError(
+                f"dest refused import: torn chunks ({res.get('torn', '?')})"
+            )
+        raise MigrationError(f"dest refused import: {reason}")
+    _start_relay(sock, t)
+    return res
+
+
+def push_kv(
+    host: str,
+    port: int,
+    snap: Dict[str, Any],
+    t: GenerateTicket,
+    chaos=None,
+    timeout: float = 10.0,
+) -> int:
+    """Rung 1: stream a snapshotted sequence's KV blocks to the
+    survivor and leave the socket relaying.  Returns bytes pushed.
+    Raises ``MigrationRefusedError`` (nothing moved),
+    ``TornMigrationError`` / ``MigrationError`` (push failed; the
+    sequence is still intact host-side for the next rung)."""
+    sock = _open(host, port, timeout)
+    handed = False
+    try:
+        try:
+            _send_frame(sock, snap["hello"])
+            acc = _recv_frame(sock)
+            if not acc.get("accept"):
+                raise MigrationRefusedError(
+                    f"dest refused offer: {acc.get('reason', 'unknown')}"
+                )
+            pushed = 0
+            for i, leaf in enumerate(snap["leaves"]):
+                mv = memoryview(leaf)
+                off = 0
+                while off < len(mv):
+                    part = mv[off : off + _CHUNK_BYTES]
+                    if chaos is not None and chaos.due("serve.migrate.kill"):
+                        # chaos[serve.migrate.kill]: the source dies
+                        # mid-push — the dest sees the peer vanish
+                        # before DONE and frees its grant; we walk the
+                        # fallback ladder.
+                        raise MigrationError(
+                            "migration push killed mid-stream (chaos)"
+                        )
+                    sock.sendall(
+                        _CHUNK_HDR.pack(
+                            _MAGIC, i, off, len(part), zlib.crc32(part)
+                        )
+                    )
+                    sock.sendall(part)
+                    off += len(part)
+                    pushed += len(part)
+            sock.sendall(_CHUNK_HDR.pack(_MAGIC, _DONE_LEAF, 0, 0, 0))
+            _finish_handoff(sock, t)
+            handed = True
+            return pushed
+        except OSError as e:
+            raise MigrationError(f"migration push failed: {e}")
+    finally:
+        if not handed:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def push_cold(
+    host: str,
+    port: int,
+    t: GenerateTicket,
+    timeout: float = 10.0,
+) -> None:
+    """Rung 2: requeue the sequence on the survivor as a COLD prompt
+    (no KV bytes — the dest re-prefills under its own weights).  The
+    socket stays open as the relay.  Raises ``MigrationRefusedError``
+    / ``MigrationError``; the ticket is untouched on failure."""
+    sock = _open(host, port, timeout)
+    handed = False
+    try:
+        try:
+            _send_frame(sock, {"mode": "cold", "seq": _seq_meta(t)})
+            acc = _recv_frame(sock)
+            if not acc.get("accept"):
+                raise MigrationRefusedError(
+                    f"dest refused cold requeue: {acc.get('reason', 'unknown')}"
+                )
+            _finish_handoff(sock, t)
+            handed = True
+        except OSError as e:
+            raise MigrationError(f"cold requeue failed: {e}")
+    finally:
+        if not handed:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def resolve_endpoint(address: str, timeout: float = 5.0) -> Tuple[str, int]:
+    """Resolve a survivor's migration endpoint.  ``tcp://host:port``
+    addresses a receiver directly; anything else is the replica's HTTP
+    address — GET /migrate advertises the port (and whether it's
+    accepting).  Raises ``MigrationRefusedError`` when the survivor is
+    dark or not accepting — the caller falls back to waiting."""
+    if address.startswith("tcp://"):
+        host, _, port = address[6:].rpartition(":")
+        try:
+            return host or "127.0.0.1", int(port)
+        except ValueError:
+            raise MigrationRefusedError(f"bad migration address {address!r}")
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    url = address if "://" in address else f"http://{address}"
+    try:
+        with urllib.request.urlopen(
+            f"{url.rstrip('/')}/migrate", timeout=timeout
+        ) as r:
+            info = json.loads(r.read().decode())
+        if not info.get("accepting", False):
+            raise MigrationRefusedError(f"dest {address} not accepting")
+        host = urllib.parse.urlparse(url).hostname or "127.0.0.1"
+        return host, int(info["migrate_port"])
+    except MigrationRefusedError:
+        raise
+    except Exception as e:
+        raise MigrationRefusedError(
+            f"migration endpoint lookup at {address} failed: {e}"
+        )
+
+
+def migrate_out(
+    engine,
+    batcher,
+    dest_address: str,
+    replica_id: str = "",
+    chaos=None,
+    timeout: float = 10.0,
+) -> Dict[str, Any]:
+    """Drain-side orchestration: freeze the batcher at a token
+    boundary, snapshot every decoding sequence host-side and detach
+    it, take every queued/half-prefilled sequence cold, resume the
+    worker, then walk each sequence down the ladder toward the
+    survivor.  Returns the summary the drain result reports.  Raises
+    ``MigrationRefusedError`` only when the survivor itself is
+    unreachable BEFORE anything was detached — the caller then waits
+    (PR 15) with every sequence still local."""
+    from edl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    rec = telemetry.get_recorder()
+    m_out = reg.counter("edl_serve_migrations_total")
+    m_bytes = reg.counter("edl_serve_migrations_bytes_total")
+    h_sec = reg.histogram("edl_serve_migrate_seconds")
+
+    host, port = resolve_endpoint(dest_address, timeout=timeout)
+    summary = {
+        "dest": dest_address, "attempted": 0, "migrated": 0,
+        "cold": 0, "fallback": 0, "failed": 0, "bytes": 0,
+    }
+    weights = engine.current_weights()
+    if weights is None:
+        raise MigrationRefusedError("source has no verified weights")
+    hot: List[Tuple[GenerateTicket, Optional[Dict[str, Any]]]] = []
+    with batcher.frozen():
+        for t in list(batcher._active):
+            if t.state != _DECODING:
+                continue
+            # A swap that raced the drain (worker hasn't rebound yet)
+            # makes the cached K/V stale — snapshot nothing and let
+            # the ladder re-prefill the sequence cold.
+            snap = (
+                snapshot_sequence(engine, t, weights)
+                if batcher._bound_gen == weights.generation
+                else None
+            )
+            hot.append((t, snap))
+            batcher.detach(t)
+        cold = batcher.take_cold()
+    t_all = time.monotonic()
+    for t, snap in hot:
+        summary["attempted"] += 1
+        t0 = time.monotonic()
+        outcome = "failed"
+        try:
+            if snap is None:
+                raise MigrationError("weights swapped under the drain")
+            pushed = push_kv(host, port, snap, t, chaos=chaos,
+                             timeout=timeout)
+            summary["migrated"] += 1
+            summary["bytes"] += pushed
+            m_bytes.inc(pushed)
+            h_sec.observe(time.monotonic() - t0)
+            outcome = "ok"
+        except MigrationError:
+            # Rung 2: the KV image is unusable somewhere on the wire
+            # or the dest — re-prefill COLD on the survivor.  Streamed
+            # tokens are void (the hot-swap restart contract).
+            if t.tokens:
+                t.tokens = []
+                t.restarts += 1
+                t._event({"restart": True, "reason": "migration fallback"})
+            try:
+                push_cold(host, port, t, timeout=timeout)
+                summary["fallback"] += 1
+                outcome = "fallback"
+            except MigrationError:
+                # Rung 3: survivor unusable — back on the local books;
+                # the PR 15 bounded wait covers it.
+                batcher.readmit(t)
+        m_out.inc(outcome=outcome)
+    for t in cold:
+        summary["attempted"] += 1
+        outcome = "failed"
+        try:
+            # Cold candidates streamed nothing: requeue-to-survivor
+            # with NO restart event (there is nothing to void).
+            push_cold(host, port, t, timeout=timeout)
+            summary["cold"] += 1
+            outcome = "cold"
+        except MigrationError:
+            batcher.readmit(t)
+        m_out.inc(outcome=outcome)
+    summary["failed"] = (
+        summary["attempted"]
+        - summary["migrated"] - summary["fallback"] - summary["cold"]
+    )
+    rec.record(
+        "serve.migrate",
+        {
+            "phase": "out",
+            "replica": replica_id,
+            "attempted": summary["attempted"],
+            "migrated": summary["migrated"],
+            "cold": summary["cold"],
+            "fallback": summary["fallback"],
+            "failed": summary["failed"],
+        },
+        # bytes ride the non-identity timing field: the KV volume
+        # depends on how many tokens streamed before the freeze — a
+        # scheduling accident the same-seed soak digest must not see.
+        timing={
+            "seconds": round(time.monotonic() - t_all, 6),
+            "bytes": summary["bytes"],
+        },
+    )
+    return summary
+
+
+class MigrationReceiver:
+    """Survivor-side TCP listener: one connection per migrated
+    sequence.  KV offers are admission-checked (draining / weights
+    key / decode slot / block grant) BEFORE any bytes move; accepted
+    imports are crc-verified chunk by chunk, scattered into the
+    granted blocks, and handed to the batcher for token-boundary
+    adoption.  Cold offers go straight through ``submit_generate``.
+    Either way the connection stays open as the event relay back to
+    the source."""
+
+    def __init__(
+        self,
+        engine,
+        batcher,
+        replica_id: str = "",
+        chaos=None,
+        host: str = "127.0.0.1",
+        timeout: float = 30.0,
+    ):
+        self.engine = engine
+        self.batcher = batcher
+        self.replica_id = replica_id
+        self.chaos = chaos if chaos is not None else engine.chaos
+        self.timeout = float(timeout)
+        self.accepting = True
+        self._stop = False
+        self._threads: List[threading.Thread] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, 0))
+        self._srv.listen(16)
+        self._srv.settimeout(0.5)
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread: Optional[threading.Thread] = None
+
+        from edl_tpu import telemetry
+
+        self.recorder = telemetry.get_recorder()
+
+    def start(self) -> "MigrationReceiver":
+        if self._accept_thread is not None and self._accept_thread.is_alive():
+            return self
+        self._stop = False
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="edl-migrate-recv"
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            th = threading.Thread(
+                target=self._handle, args=(conn,), daemon=True,
+                name="edl-migrate-import",
+            )
+            th.start()
+            self._threads.append(th)
+
+    # -- per-connection import ----------------------------------------------
+    def _refusal(self, hello: Dict[str, Any]) -> Optional[str]:
+        eng, bat = self.engine, self.batcher
+        if self._stop or not self.accepting or bat.draining:
+            return "draining"
+        w = eng.current_weights()
+        if w is None:
+            return "not_ready"
+        if hello.get("mode") == "kv":
+            if (
+                int(hello.get("weights_step", -1)) != w.step
+                or int(hello.get("weights_digest", -1)) != w.digest
+            ):
+                return "generation_skew"
+            if (
+                bat.active_count + bat.prefilling_count + bat.adopted_count
+                >= eng.max_seqs
+            ):
+                return "no_slot"
+            if self.chaos is not None and self.chaos.due(
+                "serve.migrate.exhausted"
+            ):
+                # chaos[serve.migrate.exhausted]: the dest pool
+                # reports exhaustion at the offer — the source must
+                # fall back to a cold re-prefill, not hang.
+                return "kv_exhausted"
+        return None
+
+    def _handle(self, conn: socket.socket) -> None:
+        granted: Optional[List[int]] = None
+        handed = False
+        try:
+            tune_socket(conn)
+            conn.settimeout(self.timeout)
+            hello = _recv_frame(conn)
+            mode = str(hello.get("mode", ""))
+            if mode not in ("kv", "cold"):
+                _send_frame(conn, {"accept": False, "reason": "bad_mode"})
+                return
+            refuse = self._refusal(hello)
+            if refuse is None and mode == "kv":
+                nblk = int(hello["blocks"])
+                if nblk < 1 or nblk > self.engine.blocks_per_seq:
+                    refuse = "bad_blocks"
+                else:
+                    granted = self.engine.pool.alloc(nblk)
+                    if granted is None:
+                        refuse = "kv_exhausted"
+            if refuse is not None:
+                self._record(mode, "refused", reason=refuse)
+                _send_frame(conn, {"accept": False, "reason": refuse})
+                return
+            _send_frame(conn, {"accept": True})
+            # The forwarder must not write an event frame before the
+            # RESULT frame is on the wire (the worker can adopt and
+            # emit within microseconds) — the gate orders the socket.
+            gate = threading.Event()
+            try:
+                if mode == "cold":
+                    handed = self._import_cold(conn, hello, gate)
+                else:
+                    handed = self._import_kv(conn, hello, granted, gate)
+                    if handed:
+                        granted = None  # ownership passed to the ticket
+            finally:
+                gate.set()
+        except (MigrationError, OSError, ValueError, KeyError):
+            # Torn peer / protocol violation: nothing was adopted, the
+            # grant (if any) goes back to the pool below.
+            self._record("kv", "aborted")
+        finally:
+            if granted is not None:
+                self.engine.pool.free(granted)
+            if not handed:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _forwarder(self, conn: socket.socket, gate: threading.Event):
+        lock = threading.Lock()
+
+        def fwd(ev: Dict[str, Any]) -> None:
+            # Adoption can start streaming within microseconds of
+            # batcher.adopt()/submit_generate() — before _handle has
+            # sent its RESULT frame.  Event frames must queue behind
+            # it or the source misreads an event as the result.
+            gate.wait(timeout=60.0)
+            with lock:
+                _send_frame(conn, ev)
+            if ev.get("done") or "error" in ev:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+        return fwd
+
+    def _ticket_from(
+        self,
+        seq: Dict[str, Any],
+        conn: socket.socket,
+        gate: threading.Event,
+    ) -> GenerateTicket:
+        return GenerateTicket(
+            np.asarray(seq["prompt"], np.int32),
+            int(seq["max_new"]),
+            time.monotonic() + float(seq["deadline_left_s"]),
+            seq.get("eos_id"),
+            on_event=self._forwarder(conn, gate),
+        )
+
+    def _import_cold(
+        self,
+        conn: socket.socket,
+        hello: Dict[str, Any],
+        gate: threading.Event,
+    ) -> bool:
+        seq = hello["seq"]
+        try:
+            self.batcher.submit_generate(
+                {"tokens": seq["prompt"]},
+                max_new_tokens=int(seq["max_new"]),
+                deadline_s=float(seq["deadline_left_s"]),
+                eos_id=seq.get("eos_id"),
+                on_event=self._forwarder(conn, gate),
+            )
+        except (DrainingError, QueueFullError) as e:
+            _send_frame(conn, {"ok": False, "reason": type(e).__name__})
+            self._record("cold", "refused", reason=type(e).__name__)
+            return False
+        _send_frame(conn, {"ok": True})
+        self._record("cold", "adopted")
+        return True
+
+    def _import_kv(
+        self,
+        conn: socket.socket,
+        hello: Dict[str, Any],
+        granted: List[int],
+        gate: threading.Event,
+    ) -> bool:
+        eng = self.engine
+        nblk = int(hello["blocks"])
+        sizes = [int(s) for s in hello["leaf_sizes"]]
+        crcs = [int(c) for c in hello["leaf_crcs"]]
+        shape = eng.pool._shape  # (layers, num_blocks, bt, heads, hd)
+        block_shape = (shape[0], shape[2], shape[3], shape[4])
+        expect = int(np.prod(block_shape)) * np.dtype(eng.pool._dtype).itemsize
+        if len(sizes) != 2 * nblk or any(s != expect for s in sizes):
+            _send_frame(conn, {"ok": False, "reason": "shape_mismatch"})
+            self._record("kv", "refused", reason="shape_mismatch")
+            return False
+        bufs = [bytearray(s) for s in sizes]
+        got = [0] * len(bufs)
+        leaf_crc = [0] * len(bufs)
+        torn: set = set()
+        hdr = bytearray(_CHUNK_HDR.size)
+        while True:
+            _recv_exact(conn, memoryview(hdr))
+            magic, leaf, off, length, crc = _CHUNK_HDR.unpack(hdr)
+            if magic != _MAGIC:
+                raise MigrationError(f"bad chunk magic {magic:#x}")
+            if leaf == _DONE_LEAF:
+                break
+            if leaf >= len(bufs) or off + length > len(bufs[leaf]):
+                raise MigrationError(
+                    f"chunk out of bounds (leaf {leaf}, off {off})"
+                )
+            if off != got[leaf]:
+                raise MigrationError(
+                    f"out-of-order chunk for leaf {leaf} "
+                    f"(expected {got[leaf]}, got {off})"
+                )
+            region = memoryview(bufs[leaf])[off : off + length]
+            _recv_exact(conn, region)
+            if self.chaos is not None and self.chaos.due("serve.migrate.torn"):
+                # chaos[serve.migrate.torn]: one chunk corrupted in
+                # flight — the per-chunk crc must catch it and the
+                # import refuse, never scatter poisoned K/V.
+                region[0] ^= 0xFF
+            if zlib.crc32(region) != crc:
+                torn.add(leaf)
+            leaf_crc[leaf] = zlib.crc32(region, leaf_crc[leaf])
+            got[leaf] += length
+        for i in range(len(bufs)):
+            if got[i] != sizes[i]:
+                torn.add(i)
+            elif leaf_crc[i] != crcs[i]:
+                torn.add(i)
+        if torn:
+            _send_frame(conn, {"ok": False, "reason": "torn",
+                               "torn": len(torn)})
+            self._record("kv", "refused", reason="torn")
+            return False
+        dtype = eng.pool._dtype
+        k = np.stack(
+            [
+                np.frombuffer(bytes(bufs[j]), dtype).reshape(block_shape)
+                for j in range(nblk)
+            ],
+            axis=1,
+        )
+        v = np.stack(
+            [
+                np.frombuffer(bytes(bufs[nblk + j]), dtype).reshape(block_shape)
+                for j in range(nblk)
+            ],
+            axis=1,
+        )
+        # The worker's donated decode dispatches rebind the pool
+        # arrays every iteration; the import's read-modify-write must
+        # not interleave with one or an update is silently lost.
+        # Freeze parks the worker at a token boundary for the scatter.
+        with self.batcher.frozen():
+            eng.import_kv(granted, k, v)
+            epoch = getattr(eng, "cache_epoch", 0)
+        seq = hello["seq"]
+        t = self._ticket_from(seq, conn, gate)
+        t.state = _DECODING
+        t.blocks = list(granted)
+        t.table = np.zeros(eng.blocks_per_seq, np.int32)
+        t.table[: len(granted)] = granted
+        t.length = int(seq["length"])
+        t.last_token = int(seq["last_token"])
+        t.tokens = [int(x) for x in seq["tokens"]]
+        t.restarts = int(seq.get("restarts", 0))
+        t.chunks = int(seq.get("chunks", 0))
+        if seq.get("ttft_s") is not None:
+            # TTFT was already observed at the source; pin first_time
+            # so adoption never re-samples it AND the finish meta
+            # reports the source's enqueue->first-token span.
+            t.first_time = t.enqueued + float(seq["ttft_s"])
+        step = int(hello["weights_step"])
+        digest = int(hello["weights_digest"])
+        if self.chaos is not None and self.chaos.due("serve.migrate.swap"):
+            # chaos[serve.migrate.swap]: a hot swap lands between the
+            # block grant and batcher adoption — poison the adoption
+            # key so the worker's generation check routes the sequence
+            # down the re-prefill rung instead of mixing generations.
+            digest ^= 1
+        try:
+            self.batcher.adopt(t, step, digest, epoch)
+        except RuntimeError as e:
+            _send_frame(conn, {"ok": False, "reason": str(e)})
+            self._record("kv", "refused", reason="stopped")
+            return False
+        _send_frame(conn, {"ok": True, "blocks": nblk})
+        # block count is scheduling-dependent (tokens streamed before
+        # the source froze) — journal it as timing, not identity
+        self._record("kv", "adopted", _timing={"blocks": nblk})
+        return True
+
+    def _record(self, mode: str, outcome: str, _timing=None, **data) -> None:
+        payload = {
+            "phase": "in", "replica": self.replica_id,
+            "mode": mode, "outcome": outcome,
+        }
+        payload.update(data)
+        self.recorder.record("serve.migrate", payload, timing=_timing)

@@ -73,6 +73,9 @@ class ServingServer:
         #: deregister); without one the handler drains the batchers
         #: directly (batcher-only test/CLI deployments)
         self.replica = None
+        #: the MigrationReceiver advertised on GET /migrate (set by
+        #: ServingReplica.start for decode-capable replicas)
+        self.migration = None
         engine = batcher.engine
         self_server = self
         from edl_tpu import telemetry
@@ -142,6 +145,30 @@ class ServingServer:
                             ),
                         }
                     self._reply(health, 200 if engine.ready else 503)
+                elif self.path == "/migrate":
+                    # Migration endpoint advertisement: a draining
+                    # source GETs this before opening the chunked-TCP
+                    # push (the port lives outside HTTP — KV bytes
+                    # never squeeze through JSON).
+                    mig = self_server.migration
+                    gen0 = self.server_gen_batcher
+                    if mig is None:
+                        self._reply(
+                            {"error": "no migration receiver"}, 404
+                        )
+                        return
+                    self._reply(
+                        {
+                            "migrate_port": mig.port,
+                            "accepting": bool(
+                                mig.accepting
+                                and engine.ready
+                                and not (
+                                    gen0 is not None and gen0.draining
+                                )
+                            ),
+                        }
+                    )
                 elif self.path == "/metrics":
                     body = registry.render().encode()
                     self.send_response(200)
@@ -405,14 +432,22 @@ class ServingServer:
                     else None
                 )
                 wait = bool(req.get("wait", True))
+                migrate_to = req.get("migrate_to") or None
                 rep = self_server.replica
                 if rep is not None:
                     if wait:
-                        self._reply(rep.drain(budget_s=budget_s))
+                        self._reply(
+                            rep.drain(
+                                budget_s=budget_s, migrate_to=migrate_to
+                            )
+                        )
                     else:
                         threading.Thread(
                             target=rep.drain,
-                            kwargs={"budget_s": budget_s},
+                            kwargs={
+                                "budget_s": budget_s,
+                                "migrate_to": migrate_to,
+                            },
                             daemon=True,
                             name="edl-serve-drain",
                         ).start()
@@ -542,6 +577,15 @@ class ServingReplica:
         self._drain_state: Optional[str] = None
         self._drain_evt: Optional[threading.Event] = None
         self._drain_result: Optional[dict] = None
+        #: per-sequence drain progress (ISSUE 16 satellite): the first
+        #: attempt snapshots the generation tickets in flight; retried
+        #: drains re-wait ONLY the still-unresolved, still-local ones
+        self._drain_pending: Optional[list] = None
+        self._drain_total = 0
+        self._drain_migrated = 0
+        #: the live-migration receiver (decode-capable replicas only;
+        #: started in start(), advertised on GET /migrate)
+        self.migration = None
         from edl_tpu import telemetry
 
         self.telemetry = telemetry.get_registry()
@@ -567,8 +611,20 @@ class ServingReplica:
             self.gen_batcher.start()
             if self.server is not None and self.server.gen_batcher is None:
                 self.server.gen_batcher = self.gen_batcher
+        if self.gen_batcher is not None:
+            # Live KV migration receiver: survivors import drained
+            # replicas' sequences here (chunked TCP, not HTTP).
+            from edl_tpu.serving.migrate import MigrationReceiver
+
+            self.migration = MigrationReceiver(
+                self.engine,
+                self.gen_batcher,
+                replica_id=self.replica_id,
+                chaos=getattr(self.engine, "chaos", None),
+            ).start()
         if self.server is not None:
             self.server.replica = self  # POST /drain routes here
+            self.server.migration = self.migration
             self.server.start()
         if self.coordinator is not None:
             self.coordinator.register(self.replica_id, address=self.address)
@@ -600,6 +656,8 @@ class ServingReplica:
         self.batcher.stop()
         if self.gen_batcher is not None:
             self.gen_batcher.stop()
+        if self.migration is not None:
+            self.migration.stop()
         if self.server is not None:
             self.server.stop()
 
@@ -610,19 +668,45 @@ class ServingReplica:
             n += self.gen_batcher.in_flight
         return n
 
-    def drain(self, budget_s: Optional[float] = None) -> dict:
+    def _pending_generation(self) -> list:
+        """Snapshot the generation tickets currently on this replica's
+        books (queued, mid-prefill, decoding, awaiting adoption) — the
+        per-sequence unit the drain's progress accounting carries
+        across retries."""
+        b = self.gen_batcher
+        if b is None:
+            return []
+        with b._cv:
+            return (
+                list(b._queue)
+                + list(b._prefilling)
+                + list(b._active)
+                + [e[0] for e in b._adopted]
+            )
+
+    def drain(
+        self,
+        budget_s: Optional[float] = None,
+        migrate_to: Optional[str] = None,
+    ) -> dict:
         """The graceful-shutdown contract, in order: (1) close
         admission — later requests get 503 + Retry-After (distinct
         from 429: this replica is LEAVING, clients go elsewhere);
-        (2) let every in-flight single-shot request and decode
-        sequence finish under the bounded ``budget_s`` (their normal
-        finish paths free the KV blocks the same iteration); (3) stop
-        heartbeating and deregister from the serving coordinator —
-        only after in-flight settled, and heartbeats FIRST or the
-        lease-KeyError rejoin path would re-register the leaving
-        replica; (4) return the ack.  The caller owns the actual exit
-        (``stop()``/process teardown) — a drained replica still
-        answers /healthz and /metrics until then.
+        (2) with ``migrate_to`` (a survivor's HTTP address or a
+        ``tcp://host:port`` receiver endpoint) hand every in-flight
+        decode sequence to the survivor FIRST — filled KV blocks move
+        and decode resumes mid-generation, half-prefilled and queued
+        prompts requeue cold — so drain latency is O(KV bytes), not
+        the longest generation; anything that couldn't move (and every
+        single-shot request) finishes under the bounded ``budget_s``
+        (their normal finish paths free the KV blocks the same
+        iteration); (3) stop heartbeating and deregister from the
+        serving coordinator — only after in-flight settled, and
+        heartbeats FIRST or the lease-KeyError rejoin path would
+        re-register the leaving replica; (4) return the ack.  The
+        caller owns the actual exit (``stop()``/process teardown) — a
+        drained replica still answers /healthz and /metrics until
+        then.
 
         Idempotent and join-safe: one drain runs at a time; concurrent
         calls (POST /drain racing SIGTERM racing the autoscaler's
@@ -672,6 +756,49 @@ class ServingReplica:
             if self.chaos is not None
             else getattr(self.engine, "chaos", None)
         )
+        # Per-sequence progress (ISSUE 16 satellite): snapshot once,
+        # then every retry re-waits ONLY the still-unresolved, still-
+        # local sequences — finished or migrated work never re-enters
+        # the wait, so retried drains converge monotonically.
+        if self._drain_pending is None:
+            self._drain_pending = self._pending_generation()
+            self._drain_total = len(self._drain_pending)
+        else:
+            self._drain_pending = [
+                t
+                for t in self._drain_pending
+                if not t._done.is_set() and not t.migrated
+            ]
+        migrate_summary = None
+        if migrate_to and self.gen_batcher is not None:
+            from edl_tpu.serving.migrate import MigrationError, migrate_out
+
+            try:
+                migrate_summary = migrate_out(
+                    self.engine,
+                    self.gen_batcher,
+                    migrate_to,
+                    replica_id=self.replica_id,
+                    chaos=chaos,
+                )
+                self._drain_migrated += (
+                    migrate_summary["migrated"]
+                    + migrate_summary["fallback"]
+                    + migrate_summary["cold"]
+                )
+            except MigrationError as e:
+                # Survivor dark or refusing before anything moved:
+                # everything is still local — fall back to the PR 15
+                # bounded wait below.
+                migrate_summary = {"error": type(e).__name__}
+                self.recorder.record(
+                    "serve.migrate",
+                    {
+                        "phase": "abort",
+                        "replica": self.replica_id,
+                        "reason": type(e).__name__,
+                    },
+                )
         deadline = t0 + budget
         while time.monotonic() < deadline:
             if chaos is not None:
@@ -685,6 +812,11 @@ class ServingReplica:
             time.sleep(0.005)
         leftover = self._in_flight()
         drained = leftover == 0
+        self._drain_pending = [
+            t
+            for t in self._drain_pending
+            if not t._done.is_set() and not t.migrated
+        ]
         if drained:
             # Heartbeats stop BEFORE deregistering (see docstring).
             if self._stop_evt is not None:
@@ -707,6 +839,7 @@ class ServingReplica:
                     "replica": self.replica_id,
                     "phase": "done",
                     "drained": True,
+                    "migrated": self._drain_migrated,
                 },
                 timing={"seconds": round(dt, 6), "in_flight": leftover},
             )
@@ -715,7 +848,14 @@ class ServingReplica:
             "drained": drained,
             "in_flight": leftover,
             "seconds": round(dt, 6),
+            "progress": {
+                "total": self._drain_total,
+                "migrated": self._drain_migrated,
+                "remaining": len(self._drain_pending),
+            },
         }
+        if migrate_summary is not None:
+            result["migrate"] = migrate_summary
         with self._drain_lock:
             self._drain_result = result
             self._drain_state = "drained" if drained else "incomplete"
@@ -735,6 +875,8 @@ class ServingReplica:
         self.batcher.stop()
         if self.gen_batcher is not None:
             self.gen_batcher.stop()
+        if self.migration is not None:
+            self.migration.stop()
         if self.server is not None:
             self.server.stop()
 

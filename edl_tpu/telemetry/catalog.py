@@ -437,6 +437,32 @@ CATALOG = {
         "worker thread.",
         "labels": (),
     },
+    # -- live KV sequence migration (drain/preempt without waiting) ----------
+    "edl_serve_migrations_total": {
+        "type": "counter",
+        "help": "Sequences handed to a survivor at drain/preemption, "
+        "by outcome: ok (KV blocks moved, decode resumed mid-"
+        "generation), fallback (push failed somewhere on the ladder, "
+        "re-prefilled cold on the survivor), cold (half-prefilled or "
+        "queued sequence requeued as a cold prompt), failed (survivor "
+        "unusable, sequence readmitted locally and drained by "
+        "waiting).",
+        "labels": ("outcome",),
+    },
+    "edl_serve_migrations_bytes_total": {
+        "type": "counter",
+        "help": "KV-cache bytes pushed to survivors over the chunked "
+        "migration stream (filled blocks only, K and V planes).",
+        "labels": (),
+    },
+    "edl_serve_migrate_seconds": {
+        "type": "histogram",
+        "help": "Seconds from sequence freeze to the survivor's import "
+        "ack (device->host gather + chunked TCP push + dest pool "
+        "scatter) — the per-sequence unit of O(KV bytes) drain "
+        "latency.",
+        "labels": (),
+    },
     # -- autoregressive decode serving (DecodeEngine + token batcher) --------
     "edl_serve_tokens_total": {
         "type": "counter",
@@ -637,6 +663,7 @@ KNOWN_EVENT_KINDS = {
     "serve.restart": "a hot swap re-prefilled in-flight sequences",
     "serve.drain": "a replica drain started / completed",
     "serve.watchdog": "a serving dispatch missed the watchdog deadline",
+    "serve.migrate": "a live KV sequence moved (or fell back) at drain",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

@@ -1,0 +1,791 @@
+"""Live KV sequence migration (ISSUE 16): drains and preemptions that
+never wait on a generation.
+
+Key guarantees under test:
+
+- **mid-generation resume, bit-identical**: a sequence frozen at a
+  token boundary, pushed (chunked TCP, per-chunk + per-block crc) and
+  adopted by a survivor emits EXACTLY the tokens an unmigrated
+  same-seed run would — and the survivor performs ZERO prefills for
+  it (the KV moved; nothing was recomputed);
+- **drain latency is O(KV transfer)**: ``drain(migrate_to=...)`` acks
+  while a deliberately long generation is still mid-flight on the
+  survivor — the victim never waits a generation out;
+- **the fallback ladder, rung by rung**: torn push / refused dest /
+  KV-exhausted dest / generation skew each degrade to a cold
+  re-prefill on the survivor (restart event, tokens regenerate in
+  full); an unreachable survivor readmits locally and the PR 15
+  bounded wait covers it.  Never a hang, never a mixed-generation
+  token;
+- **satellites**: budget-missed drain retries carry per-sequence
+  progress; half-prefilled sequences requeue cold immediately (no
+  restart event, no budget claim); the direct ``/drain`` ack completes
+  migration with the coordinator dark; the lane passes the surviving
+  replica as ``migrate_to``; the seeded migration soak journals
+  bit-identically across same-seed runs.
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.serving import (
+    DecodeEngine,
+    MigrationReceiver,
+    ServingReplica,
+    ServingServer,
+    TokenContinuousBatcher,
+    migrate_out,
+)
+from tests.test_decode_serving import _lm_state, _reference_decode
+
+
+def _build_engine(step=1, seed=1, **kw):
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, step, seed), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        **kw,
+    )
+    assert engine.load()
+    engine.warm()
+    return model, store, engine
+
+
+@pytest.fixture(scope="module")
+def mig_pair():
+    """One warmed source + destination DecodeEngine on IDENTICAL
+    weights (step 1 / seed 1) — every test mounts fresh batchers and
+    receivers on them and must leave both pools empty."""
+    model, _, src = _build_engine()
+    _, _, dst = _build_engine()
+    return model, src, dst
+
+
+def _wait(cond, timeout=20.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.002)
+    raise AssertionError(f"wait timed out: {what}")
+
+
+def _chaos_of(point):
+    c = FaultSchedule(0, [FaultEvent(0, point)])
+    c.advance(0)
+    return c
+
+
+# -- the KV wire, roundtrip ---------------------------------------------------
+
+
+def test_kv_export_import_roundtrip_bit_exact(mig_pair):
+    """engine.export_kv -> engine.import_kv moves block contents
+    bit-exactly (the device->host->device path under every push)."""
+    _, src, _ = mig_pair
+    pool = src.pool
+    ids = pool.alloc(2)
+    assert ids is not None
+    try:
+        shape = pool._shape  # (layers, blocks, bt, heads, hd)
+        rng = np.random.RandomState(7)
+        k = rng.randn(shape[0], 2, shape[2], shape[3], shape[4]).astype(
+            np.dtype(pool._dtype)
+        )
+        v = rng.randn(*k.shape).astype(k.dtype)
+        src.import_kv(ids, k, v)
+        k2, v2 = src.export_kv(ids)
+        np.testing.assert_array_equal(k, k2)
+        np.testing.assert_array_equal(v, v2)
+    finally:
+        pool.free(list(ids))
+    assert pool.used_blocks == 0
+
+
+# -- the acceptance criterion: mid-generation resume, bit-identical -----------
+
+
+def test_migration_resumes_mid_generation_bit_identical(mig_pair):
+    """A decoding sequence migrates at a token boundary and the
+    survivor CONTINUES it: final tokens equal the unmigrated reference
+    run, the survivor prefilled NOTHING for it, and the client's event
+    stream is continuous (every index once, no restart)."""
+    model, src, dst = mig_pair
+    with telemetry.scoped() as (reg, _):
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b, replica_id="dst").start()
+        try:
+            prompt, n = list(range(1, 9)), 24
+            events = []
+            t = src_b.submit_generate(
+                {"tokens": prompt},
+                max_new_tokens=n,
+                deadline_s=60.0,
+                on_event=events.append,
+            )
+            _wait(lambda: len(t.tokens) >= 5, what="5 tokens pre-migration")
+            src_b.close_admission()
+            s = migrate_out(
+                src, src_b, f"tcp://127.0.0.1:{recv.port}", replica_id="src"
+            )
+            assert s["migrated"] == 1 and s["failed"] == 0
+            assert s["bytes"] > 0
+            assert t.migrated
+            assert src_b.in_flight == 0  # the drain wait would be instant
+            tokens, meta = t.result(timeout=30)
+            ref = _reference_decode(
+                model, src.current_weights().params, prompt, n, src
+            )
+            assert tokens == ref, "migrated tokens diverged from reference"
+            assert meta.get("migrated") is True
+            assert meta["restarts"] == 0
+            # ZERO survivor prefills: the sequence resumed mid-
+            # generation off the imported KV, nothing was recomputed
+            assert dst_b.stats["prefills"] == 0
+            idx = [e["i"] for e in events if "token" in e]
+            assert idx == list(range(n)), "stream not continuous"
+            assert not any(e.get("restart") for e in events)
+            assert (
+                reg.counter("edl_serve_migrations_total").value(outcome="ok")
+                == 1
+            )
+            assert (
+                reg.counter("edl_serve_migrations_bytes_total").value()
+                == s["bytes"]
+            )
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+# -- the fallback ladder, rung by rung ----------------------------------------
+
+
+def _chaos_case(mig_pair, src_chaos=None, recv_chaos=None):
+    """One migration under a chaos point.  Returns (summary, tokens,
+    reference, events, dst_prefills)."""
+    model, src, dst = mig_pair
+    src_b = TokenContinuousBatcher(src, refresh=False).start()
+    dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+    recv = MigrationReceiver(dst, dst_b, chaos=recv_chaos).start()
+    try:
+        prompt, n = list(range(1, 9)), 12
+        events = []
+        t = src_b.submit_generate(
+            {"tokens": prompt},
+            max_new_tokens=n,
+            deadline_s=60.0,
+            on_event=events.append,
+        )
+        _wait(lambda: len(t.tokens) >= 3, what="3 tokens pre-migration")
+        src_b.close_admission()
+        s = migrate_out(
+            src, src_b, f"tcp://127.0.0.1:{recv.port}", chaos=src_chaos
+        )
+        tokens, _ = t.result(timeout=30)
+        ref = _reference_decode(
+            model, dst.current_weights().params, prompt, n, dst
+        )
+        prefills = dst_b.stats["prefills"]
+    finally:
+        src_b.stop()
+        dst_b.stop()
+        recv.stop()
+    assert src.pool.used_blocks == 0, "source leaked KV blocks"
+    assert dst.pool.used_blocks == 0, "dest leaked KV blocks"
+    return s, tokens, ref, events, prefills
+
+
+def test_torn_push_falls_back_to_cold_survivor_prefill(mig_pair):
+    """chaos[serve.migrate.torn]: one corrupted chunk -> the per-chunk
+    crc refuses the import, poisoned K/V never scatters, and the
+    ladder's next rung re-prefills the sequence COLD on the survivor
+    (streamed tokens voided by a restart event)."""
+    s, tokens, ref, events, prefills = _chaos_case(
+        mig_pair, recv_chaos=_chaos_of("serve.migrate.torn")
+    )
+    assert s["fallback"] == 1 and s["migrated"] == 0 and s["failed"] == 0
+    assert sum(1 for e in events if e.get("restart")) == 1
+    assert prefills == 1  # the survivor re-prefilled it
+    assert tokens == ref and len(tokens) == 12
+
+
+def test_dest_kv_exhaustion_refused_at_offer_then_cold(mig_pair):
+    """chaos[serve.migrate.exhausted]: the dest refuses the KV offer
+    BEFORE any bytes move; the source degrades to a cold push."""
+    s, tokens, ref, events, prefills = _chaos_case(
+        mig_pair, recv_chaos=_chaos_of("serve.migrate.exhausted")
+    )
+    assert s["fallback"] == 1 and s["bytes"] == 0
+    assert prefills == 1
+    assert tokens == ref and len(tokens) == 12
+
+
+def test_kill_during_push_falls_back_cold(mig_pair):
+    """chaos[serve.migrate.kill]: the push dies mid-stream (source
+    side); the dest's crc accounting sees a torn image and the
+    sequence re-prefills cold on the survivor."""
+    s, tokens, ref, events, prefills = _chaos_case(
+        mig_pair, src_chaos=_chaos_of("serve.migrate.kill")
+    )
+    assert s["fallback"] == 1 and s["migrated"] == 0
+    assert prefills == 1
+    assert tokens == ref and len(tokens) == 12
+
+
+def test_swap_during_migration_reprefills_on_dest(mig_pair):
+    """chaos[serve.migrate.swap]: a hot swap lands between the import
+    grant and token-boundary adoption.  The push itself SUCCEEDS; the
+    worker's generation-key check catches the skew at adoption and
+    routes the sequence down the re-prefill rung — a restart event and
+    a full regeneration, never a mixed-generation token."""
+    s, tokens, ref, events, prefills = _chaos_case(
+        mig_pair, recv_chaos=_chaos_of("serve.migrate.swap")
+    )
+    assert s["migrated"] == 1  # the wire transfer was clean
+    assert sum(1 for e in events if e.get("restart")) == 1
+    assert prefills == 1  # ...but adoption re-prefilled under the skew
+    assert tokens == ref and len(tokens) == 12
+
+
+def test_generation_skew_refused_at_import_never_mixed(mig_pair):
+    """A survivor on DIFFERENT weights refuses the KV offer (the
+    weights-generation check at import): the sequence re-prefills cold
+    under the SURVIVOR's weights and its tokens equal the survivor's
+    own reference — KV from one generation never decodes under
+    another."""
+    model, src, _ = mig_pair
+    _, _, skew = _build_engine(step=2, seed=2)
+    with telemetry.scoped():
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        dst_b = TokenContinuousBatcher(skew, refresh=False).start()
+        recv = MigrationReceiver(skew, dst_b).start()
+        try:
+            prompt, n = list(range(1, 9)), 12
+            t = src_b.submit_generate(
+                {"tokens": prompt}, max_new_tokens=n, deadline_s=60.0
+            )
+            _wait(lambda: len(t.tokens) >= 3, what="tokens pre-migration")
+            src_b.close_admission()
+            s = migrate_out(src, src_b, f"tcp://127.0.0.1:{recv.port}")
+            assert s["fallback"] == 1 and s["migrated"] == 0
+            assert s["bytes"] == 0  # refused at the offer, pre-bytes
+            tokens, _ = t.result(timeout=30)
+            ref = _reference_decode(
+                model, skew.current_weights().params, prompt, n, skew
+            )
+            assert tokens == ref, "tokens not pure under survivor weights"
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert skew.pool.used_blocks == 0
+
+
+def test_unreachable_survivor_readmits_locally(mig_pair):
+    """The ladder's LAST rung: no survivor at all — the sequence comes
+    back to the local queue (restart event, tokens voided) and the
+    PR 15 bounded wait covers it locally."""
+    model, src, _ = mig_pair
+    with telemetry.scoped():
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        try:
+            prompt, n = list(range(1, 9)), 12
+            events = []
+            t = src_b.submit_generate(
+                {"tokens": prompt},
+                max_new_tokens=n,
+                deadline_s=60.0,
+                on_event=events.append,
+            )
+            _wait(lambda: len(t.tokens) >= 3, what="tokens pre-migration")
+            src_b.close_admission()
+            s = migrate_out(src, src_b, "tcp://127.0.0.1:9")
+            assert s["failed"] == 1 and s["migrated"] == 0
+            assert not t.migrated  # back on the local books
+            tokens, meta = t.result(timeout=30)
+            assert len(tokens) == n
+            ref = _reference_decode(
+                model, src.current_weights().params, prompt, n, src
+            )
+            assert tokens == ref
+            assert meta["restarts"] == 1
+            assert any(e.get("restart") for e in events)
+        finally:
+            src_b.stop()
+        assert src.pool.used_blocks == 0
+
+
+# -- drain rides migration: O(KV transfer), not O(longest generation) ---------
+
+
+def test_drain_migrate_to_acks_before_long_generation_finishes(mig_pair):
+    """The tentpole's latency claim: a drain with a DELIBERATELY long
+    generation in flight acks once the KV moved — while the survivor
+    is still decoding the handed-over sequence — instead of waiting
+    the generation out.  The survivor is addressed by its HTTP
+    address (GET /migrate advertises the receiver port)."""
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.serving import ContinuousBatcher
+
+    model, src, dst = mig_pair
+    with telemetry.scoped() as (_, rec):
+        coord = LocalCoordinator(target_world=2, max_world=4)
+        victim = ServingReplica(
+            src,
+            coordinator=coord,
+            replica_id="victim",
+            heartbeat_interval=60.0,
+            telemetry_interval=1e9,
+        ).start()
+        dst_gb = TokenContinuousBatcher(dst, refresh=False)
+        dst_srv = ServingServer(
+            ContinuousBatcher(dst),
+            host="127.0.0.1",
+            gen_batcher=dst_gb,
+        )
+        survivor = ServingReplica(
+            dst,
+            batcher=dst_srv.batcher,
+            server=dst_srv,
+            gen_batcher=dst_gb,
+            coordinator=coord,
+            replica_id="survivor",
+            heartbeat_interval=60.0,
+            telemetry_interval=1e9,
+        ).start()
+        try:
+            prompt, n = list(range(1, 9)), 48
+            t = victim.gen_batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=n, deadline_s=120.0
+            )
+            _wait(lambda: len(t.tokens) >= 2, what="generation in flight")
+            r = victim.drain(
+                budget_s=60.0,
+                migrate_to=f"http://127.0.0.1:{dst_srv.port}",
+            )
+            at_ack = len(t.tokens)
+            assert r["drained"] and r["in_flight"] == 0
+            assert r["migrate"]["migrated"] == 1
+            assert r["progress"] == {
+                "total": 1,
+                "migrated": 1,
+                "remaining": 0,
+            }
+            # the ack arrived while the generation was still running
+            assert at_ack < n, "drain waited the generation out"
+            # ...and the victim deregistered without dropping it
+            assert "victim" not in coord.members()
+            tokens, meta = t.result(timeout=60)
+            assert len(tokens) == n
+            ref = _reference_decode(
+                model, src.current_weights().params, prompt, n, src
+            )
+            assert tokens == ref
+            assert meta.get("migrated") is True
+            done = [
+                e
+                for e in rec.events()
+                if e.kind == "serve.drain" and e.data.get("phase") == "done"
+            ]
+            assert done and done[-1].data["migrated"] == 1
+        finally:
+            victim.stop()
+            survivor.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+def test_budget_missed_drain_retry_carries_progress(mig_pair):
+    """ISSUE 16 satellite: a drain that misses its budget reports
+    per-sequence progress; the RETRY re-waits only still-local,
+    still-unresolved sequences — and a retry that migrates counts the
+    moved sequences, converging monotonically."""
+    model, src, dst = mig_pair
+    with telemetry.scoped():
+        victim = ServingReplica(
+            src,
+            replica_id="victim",
+            heartbeat_interval=60.0,
+            telemetry_interval=1e9,
+        ).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b).start()
+        try:
+            tickets = [
+                victim.gen_batcher.submit_generate(
+                    {"tokens": list(range(1 + i, 9 + i))},
+                    max_new_tokens=48,
+                    deadline_s=120.0,
+                )
+                for i in range(2)
+            ]
+            _wait(
+                lambda: all(t.tokens for t in tickets),
+                what="both generations in flight",
+            )
+            # 48-token generations cannot finish in ~1ms: budget missed
+            r1 = victim.drain(budget_s=0.001)
+            assert not r1["drained"]
+            assert r1["progress"]["total"] == 2
+            assert r1["progress"]["remaining"] >= 1
+            # the retry (the next autoscaler tick) rides migration and
+            # acks without re-waiting anything already resolved
+            r2 = victim.drain(
+                budget_s=60.0, migrate_to=f"tcp://127.0.0.1:{recv.port}"
+            )
+            assert r2["drained"]
+            assert r2["progress"]["total"] == 2  # snapshot preserved
+            assert r2["progress"]["remaining"] == 0
+            assert r2["progress"]["migrated"] >= 1
+            assert (
+                r2["progress"]["remaining"] <= r1["progress"]["remaining"]
+            )
+            for t in tickets:
+                tokens, _ = t.result(timeout=60)
+                assert len(tokens) == 48  # dropped == 0
+        finally:
+            victim.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+def test_half_prefilled_drain_requeues_cold_no_restart(mig_pair):
+    """ISSUE 16 satellite: a half-prefilled sequence (mid-chunking at
+    the freeze) streamed NOTHING — it requeues on the survivor as a
+    cold prompt immediately: its local KV frees the same moment (no
+    claim on the drain budget), no restart event reaches the client,
+    and the survivor prefills it from scratch."""
+    model, src, dst = mig_pair
+    from edl_tpu.serving.batcher import _PREFILLING
+
+    with telemetry.scoped():
+        # Worker deliberately NOT started: fabricate the exact state
+        # the chunked scheduler holds mid-prompt (one block written,
+        # 16 of 48 prompt positions prefilled) so the test is
+        # deterministic — a live worker races through small prompts.
+        src_b = TokenContinuousBatcher(src, refresh=False)
+        rng = np.random.RandomState(11)
+        prompt = model.synth_batch(rng, 1)["tokens"][0, :48].tolist()
+        events = []
+        t = src_b.submit_generate(
+            {"tokens": prompt},
+            max_new_tokens=8,
+            deadline_s=60.0,
+            on_event=events.append,
+        )
+        with src_b._cv:
+            assert src_b._queue.popleft() is t
+            src_b._queued_tokens -= len(prompt)
+        got = src.pool.alloc(1)
+        assert got is not None
+        t.state = _PREFILLING
+        t.blocks = list(got)
+        t.table = np.zeros(src.blocks_per_seq, np.int32)
+        t.table[0] = got[0]
+        t.prefilled = 16
+        src_b._prefilling.append(t)
+        src_b._prefilling_tokens += len(prompt) - 16
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b).start()
+        try:
+            s = migrate_out(src, src_b, f"tcp://127.0.0.1:{recv.port}")
+            assert s["cold"] == 1 and s["attempted"] == 1
+            # KV freed IMMEDIATELY — nothing for a drain wait to hold
+            assert src.pool.used_blocks == 0
+            assert src_b.in_flight == 0
+            tokens, meta = t.result(timeout=30)
+            assert len(tokens) == 8
+            ref = _reference_decode(
+                model, dst.current_weights().params, prompt, 8, dst
+            )
+            assert tokens == ref
+            # it streamed nothing, so nothing was voided: NO restart
+            assert meta["restarts"] == 0
+            assert not any(e.get("restart") for e in events)
+            assert dst_b.stats["prefills"] == 1
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert dst.pool.used_blocks == 0
+
+
+# -- coordinator blackout: the control plane is not on the data path ----------
+
+
+def test_drain_migration_completes_with_coordinator_dark(mig_pair):
+    """ISSUE 16 satellite: a direct POST /drain (the kubelet preStop
+    shape) completes the migration and acks while the serving
+    coordinator is DARK — the KV push is replica-to-replica, the
+    control plane is not on the data path.  The un-deregisterable
+    victim stays a member (lease expiry reconverges later), and the
+    lane's patch gate fails CLOSED while the coordinator is dark."""
+    from edl_tpu.autoscaler.serving import ServingLane
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.serving import ContinuousBatcher
+
+    model, src, dst = mig_pair
+
+    class _DarkableCoord:
+        def __init__(self, inner):
+            self.inner = inner
+            self.dark = False
+
+        def __getattr__(self, name):
+            if self.dark:
+                raise ConnectionError("coordinator unreachable")
+            return getattr(self.inner, name)
+
+    with telemetry.scoped():
+        coord = _DarkableCoord(
+            LocalCoordinator(target_world=2, max_world=4)
+        )
+        src_srv = ServingServer(ContinuousBatcher(src), host="127.0.0.1")
+        victim = ServingReplica(
+            src,
+            batcher=src_srv.batcher,
+            server=src_srv,
+            coordinator=coord,
+            replica_id="victim",
+            heartbeat_interval=60.0,
+            telemetry_interval=1e9,
+        ).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b).start()
+        try:
+            prompt, n = list(range(1, 9)), 24
+            t = victim.gen_batcher.submit_generate(
+                {"tokens": prompt}, max_new_tokens=n, deadline_s=120.0
+            )
+            _wait(lambda: len(t.tokens) >= 2, what="generation in flight")
+            coord.dark = True  # serve.coord.unreachable, held dark
+            body = json.dumps(
+                {
+                    "budget_ms": 30000,
+                    "wait": True,
+                    "migrate_to": f"tcp://127.0.0.1:{recv.port}",
+                }
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{src_srv.port}/drain",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=40) as resp:
+                r = json.loads(resp.read())
+            assert r["drained"] and r["in_flight"] == 0
+            assert r["progress"]["migrated"] == 1
+            tokens, meta = t.result(timeout=60)
+            assert len(tokens) == n and meta.get("migrated") is True
+            # deregistration could NOT reach the dark coordinator: the
+            # victim stays a member until lease expiry
+            assert "victim" in coord.inner.members()
+            # ...and a lane watching through the dark coordinator
+            # fails CLOSED: an unobservable fleet is never patched
+            patches = []
+            lane = ServingLane(
+                coord,
+                min_replicas=1,
+                max_replicas=4,
+                hold_ticks=1,
+                on_scale=lambda old, new: patches.append((old, new)),
+            )
+            assert lane.run_once() is None and patches == []
+        finally:
+            victim.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+# -- the lane hands drains a survivor -----------------------------------------
+
+
+def test_lane_drain_victims_passes_survivor_as_migrate_to():
+    """drain_victims picks the plan's first surviving addressed member
+    and every victim's POST /drain body carries it as ``migrate_to`` —
+    fleet scale-downs (and market preemptions through ServingBidder)
+    ride the migration path with zero extra wiring."""
+    from edl_tpu.autoscaler.serving import ServingLane
+    from tests.test_serving_drain import _DrainCoord, _FakeDrainReplica
+
+    with telemetry.scoped():
+        survivor = _FakeDrainReplica(drained=True)
+        victim = _FakeDrainReplica(drained=True)
+        try:
+            coord = _DrainCoord(
+                2,
+                ["r0", "r1"],
+                [survivor.address, victim.address],
+            )
+            lane = ServingLane(
+                coord,
+                min_replicas=1,
+                max_replicas=4,
+                hold_ticks=1,
+                victim_drain_timeout=5.0,
+            )
+            entry = lane.run_once()
+            assert entry["actuated"]
+            assert entry["drain"]["migrate_to"] == survivor.address
+            assert [p for p, _ in victim.hits] == ["/drain"]
+            assert victim.hits[0][1]["migrate_to"] == survivor.address
+            assert survivor.hits == []  # survivors are never drained
+        finally:
+            survivor.stop()
+            victim.stop()
+
+
+# -- edl metrics: the operator view -------------------------------------------
+
+
+def test_metrics_cli_prints_migration_counters(capsys):
+    """ISSUE 16 satellite: `edl metrics` serving section surfaces the
+    migration counters — migrations, KV bytes moved, fallback
+    re-prefills, p95 migrate seconds."""
+    from edl_tpu.cli import main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.telemetry import MetricsRegistry
+
+    coord = LocalCoordinator(target_world=1, max_world=2)
+    coord.register("serve-0")
+    reg = MetricsRegistry()
+    reg.counter("edl_serve_requests_total").inc(3, status="ok")
+    reg.counter("edl_serve_migrations_total").inc(4, outcome="ok")
+    reg.counter("edl_serve_migrations_total").inc(1, outcome="fallback")
+    reg.counter("edl_serve_migrations_bytes_total").inc(8192)
+    reg.histogram("edl_serve_migrate_seconds").observe(0.05)
+    coord.report_telemetry("serve-0", snapshot=reg.snapshot(), seq=1)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    try:
+        assert main(["metrics", f"127.0.0.1:{server.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "migrations_total" in out and "5" in out
+        assert "migrate_fallbacks" in out
+        assert "migrated_kv_bytes" in out and "8192" in out
+        assert "migrate_p95" in out
+    finally:
+        server.stop()
+
+
+# -- the seeded migration soak ------------------------------------------------
+
+
+def _soak_round(schedule, model, src, dst, dst_b, rnd, prompt):
+    """One migration under whatever chaos is due: fresh source
+    batcher, one generation, freeze-and-migrate, resolve.  Returns the
+    deterministic per-round evidence."""
+    src_b = TokenContinuousBatcher(src, refresh=False).start()
+    recv = MigrationReceiver(dst, dst_b, chaos=schedule).start()
+    try:
+        t = src_b.submit_generate(
+            {"tokens": prompt}, max_new_tokens=10, deadline_s=60.0
+        )
+        _wait(lambda: len(t.tokens) >= 2, what=f"round {rnd} tokens")
+        src_b.close_admission()
+        s = migrate_out(
+            src, src_b, f"tcp://127.0.0.1:{recv.port}", chaos=schedule
+        )
+        tokens, _ = t.result(timeout=30)
+        dropped = 0 if len(tokens) == 10 else 1
+        return (
+            rnd,
+            s["migrated"],
+            s["fallback"],
+            s["cold"],
+            s["failed"],
+            tuple(tokens),
+            dropped,
+        )
+    finally:
+        src_b.stop()
+        recv.stop()
+
+
+def _run_migration_soak(seed: int):
+    """Kill-during-push, torn block, dest exhaustion, swap-during-
+    migration, then one clean migration — all against one surviving
+    destination.  Returns what must be bit-identical across same-seed
+    runs."""
+    events = [
+        FaultEvent(1, "serve.migrate.kill"),
+        FaultEvent(2, "serve.migrate.torn"),
+        FaultEvent(3, "serve.migrate.exhausted"),
+        FaultEvent(4, "serve.migrate.swap"),
+    ]
+    with telemetry.scoped() as (_, rec):
+        schedule = FaultSchedule(seed, events)
+        model, _, src = _build_engine()
+        _, _, dst = _build_engine()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        log = []
+        dropped = 0
+        try:
+            for rnd in range(1, 6):  # round 5 is chaos-free
+                schedule.advance(rnd)
+                entry = _soak_round(
+                    schedule,
+                    model,
+                    src,
+                    dst,
+                    dst_b,
+                    rnd,
+                    list(range(rnd, rnd + 8)),
+                )
+                dropped += entry[-1]
+                log.append(entry)
+        finally:
+            dst_b.stop()
+        assert schedule.pending() == []
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+        return {"digest": rec.digest(), "log": log, "dropped": dropped}
+
+
+def test_migration_soak_bit_reproducible():
+    """ISSUE 16 acceptance: the seeded migration soak — every chaos
+    point fires once, every sequence completes in full (dropped == 0),
+    the ladder's outcomes are the scheduled ones, and two same-seed
+    runs journal BIT-IDENTICALLY (recorder digest + the structured
+    log, tokens included)."""
+    r1 = _run_migration_soak(seed=1609)
+    assert r1["dropped"] == 0
+    by_round = {e[0]: e[1:5] for e in r1["log"]}
+    # (migrated, fallback, cold, failed) per scheduled chaos point
+    assert by_round[1] == (0, 1, 0, 0)  # kill-during-push -> fallback
+    assert by_round[2] == (0, 1, 0, 0)  # torn block -> fallback
+    assert by_round[3] == (0, 1, 0, 0)  # dest exhaustion -> fallback
+    assert by_round[4] == (1, 0, 0, 0)  # swap -> clean push, dest re-prefill
+    assert by_round[5] == (1, 0, 0, 0)  # chaos-free -> clean migration
+    r2 = _run_migration_soak(seed=1609)
+    assert r1["digest"] == r2["digest"], "journals diverged across reruns"
+    assert r1["log"] == r2["log"], "soak evidence diverged across reruns"

@@ -256,6 +256,7 @@ def bench_serving() -> dict:
         "interference": bench_interference(),
         "drain": bench_drain(),
         "migrate": bench_migrate(),
+        "prefix": bench_prefix(),
     }
 
 
@@ -1008,5 +1009,166 @@ def bench_migrate() -> dict:
         "bit_identical": bit_identical,
         "dropped": dropped,
         "drained_all": drained_all,
+        "steady_state_xla_compiles": steady_compiles,
+    }
+
+
+def bench_prefix() -> dict:
+    """Content-addressed KV prefix cache section (ISSUE 17): N
+    sessions share one long system prompt with divergent tails — the
+    traffic shape prefix caching exists for — decoded twice over the
+    same engine and prompts: COLD (``prefix_cache=False``, every
+    admission prefills from token 0) then WARM (``prefix_cache=True``,
+    admissions skip straight to the first cold block).  Published:
+    per-phase TTFT p50/p95 (exact per-request values, not histogram
+    buckets), the warm/cold TTFT p95 ratio, the warm phase's hit
+    ratio / reused blocks, and the cross-phase bit-identity of every
+    session's tokens.  Gated: warm_vs_cold_ttft_p95_ratio <= 0.5,
+    hit_ratio >= 0.9, steady-state compiles == 0, dropped == 0."""
+    import time
+
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import DecodeEngine, TokenContinuousBatcher
+
+    on_tpu = jax.default_backend() == "tpu"
+    # The long-context family is the shared-system-prompt shape: the
+    # prefix covers 3/4 of the window and the per-user tail is small.
+    model = get_model("longcontext_lm", tiny=not on_tpu)
+    params = model.init_params(jax.random.key(1))
+    opt = optax.adam(1e-3)
+    store = HostDRAMStore()
+    store.save_async(
+        TrainState(
+            step=jnp.asarray(1, jnp.int32),
+            params=params,
+            opt_state=opt.init(params),
+        )
+    )
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        max_chunk_tokens=32,
+    )
+    engine.load()
+    engine.warm()
+    bt = engine.block_tokens
+    shared_tokens = (engine.max_context * 3 // 4 // bt) * bt  # block-aligned
+    tail_tokens = bt // 2
+    sessions = 12
+    max_new = 4
+
+    rng = np.random.RandomState(17)
+    corpus = model.synth_batch(rng, sessions + 1)["tokens"]
+    shared = list(int(x) for x in corpus[0][:shared_tokens])
+    prompts = [
+        shared + [int(x) for x in corpus[1 + i][:tail_tokens]]
+        for i in range(sessions)
+    ]
+
+    import jax._src.compiler as _compiler
+
+    reg = telemetry.get_registry()
+    m_compiles = reg.counter("edl_xla_compiles_total")
+    compiles_before = m_compiles.value()
+    _real_bc = _compiler.backend_compile
+
+    def _counting_bc(*args, **kwargs):
+        m_compiles.inc()
+        return _real_bc(*args, **kwargs)
+
+    def _phase(batcher):
+        """Sequential sessions (each TTFT isolated from queueing) ->
+        (per-session tokens, per-session ttft seconds, dropped)."""
+        toks, ttfts, dropped = [], [], 0
+        try:
+            for p in prompts:
+                t = batcher.submit_generate(
+                    {"tokens": p},
+                    max_new_tokens=max_new,
+                    deadline_s=120.0,
+                )
+                tokens, meta = t.result(timeout=120)
+                if len(tokens) != max_new or meta["ttft_s"] is None:
+                    dropped += 1
+                toks.append(list(tokens))
+                ttfts.append(meta["ttft_s"])
+        finally:
+            batcher.stop()
+        return toks, ttfts, dropped
+
+    def _q(vals, q):
+        ordered = sorted(v for v in vals if v is not None)
+        if not ordered:
+            return None
+        return ordered[min(len(ordered) - 1, int(q * (len(ordered) - 1)))]
+
+    _compiler.backend_compile = _counting_bc
+    try:
+        cold_toks, cold_ttft, cold_drop = _phase(
+            TokenContinuousBatcher(
+                engine,
+                refresh=False,
+                default_deadline_s=120.0,
+                prefix_cache=False,
+            ).start()
+        )
+        warm_b = TokenContinuousBatcher(
+            engine, refresh=False, default_deadline_s=120.0
+        ).start()
+        warm_toks, warm_ttft, warm_drop = _phase(warm_b)
+        stats = dict(warm_b.prefix.stats)
+        steady_compiles = int(m_compiles.value() - compiles_before)
+    finally:
+        _compiler.backend_compile = _real_bc
+
+    dropped = cold_drop + warm_drop
+    bit_identical = warm_toks == cold_toks
+    hit_ratio = stats["hits"] / max(1, stats["hits"] + stats["misses"])
+    cold_p95 = _q(cold_ttft, 0.95)
+    warm_p95 = _q(warm_ttft, 0.95)
+    ratio = (
+        round(warm_p95 / cold_p95, 4) if cold_p95 and warm_p95 else None
+    )
+    assert dropped == 0, f"{dropped} sessions dropped in the prefix bench"
+    assert bit_identical, "warm (reused-block) tokens diverged from cold"
+    assert steady_compiles == 0, (
+        f"{steady_compiles} XLA compiles on the warm admission path"
+    )
+    return {
+        "model": model.name,
+        "sessions": sessions,
+        "shared_prompt_tokens": shared_tokens,
+        "tail_tokens": tail_tokens,
+        "max_new_tokens": max_new,
+        "block_tokens": bt,
+        "cold": {
+            "ttft_p50_ms": round(_q(cold_ttft, 0.5) * 1000, 3),
+            "ttft_p95_ms": round(cold_p95 * 1000, 3),
+        },
+        "warm": {
+            "ttft_p50_ms": round(_q(warm_ttft, 0.5) * 1000, 3),
+            "ttft_p95_ms": round(warm_p95 * 1000, 3),
+            "hit_ratio": round(hit_ratio, 4),
+            "hits": stats["hits"],
+            "misses": stats["misses"],
+            "blocks_reused": stats["blocks_reused"],
+            "evictions": stats["evictions"],
+        },
+        "warm_vs_cold_ttft_p95_ratio": ratio,
+        "bit_identical": bit_identical,
+        "dropped": dropped,
         "steady_state_xla_compiles": steady_compiles,
     }

@@ -122,6 +122,16 @@ KNOWN_POINTS = (
                                  # the generation-key check must route
                                  # the sequence to re-prefill, never
                                  # mix weights generations
+    # (8d) content-addressed KV prefix cache (ISSUE 17)
+    "serve.prefix.evicted",      # force-evict arg (default 1) LRU
+                                 # cached prefix blocks as if under
+                                 # allocation pressure — a subsequent
+                                 # same-prefix admission must prefill
+                                 # the evicted blocks cold, correctly
+    "serve.prefix.hash.skew",    # a lookup's chain hash is treated as
+                                 # colliding: the stored (h_prev,
+                                 # tokens) verification must reject
+                                 # the entry (miss, never wrong K/V)
 )
 
 

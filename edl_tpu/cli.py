@@ -455,6 +455,35 @@ def cmd_metrics(args) -> int:
                     f"  {'prefill_stall_p95':<24} "
                     f"{f'{s95 * 1000:.2f} ms' if s95 is not None else 'n/a'}"
                 )
+        # Prefix-cache stats (ISSUE 17): shared-prefix admission reuse
+        # — how often warm admissions skipped to the first cold block,
+        # and what the retention cost under pressure.
+        phits = counters_all.get("edl_serve_prefix_hits_total") or {}
+        if phits:
+            pmiss = (
+                counters_all.get("edl_serve_prefix_misses_total") or {}
+            )
+            print(f"  {'prefix_hits':<24} {sum(phits.values()):g}")
+            print(f"  {'prefix_misses':<24} {sum(pmiss.values()):g}")
+            ratio = gauges_all.get("edl_serve_prefix_hit_ratio") or {}
+            if ratio:
+                print(
+                    f"  {'prefix_hit_ratio':<24} "
+                    f"{max(ratio.values()):.3f}"
+                )
+            reused = (
+                counters_all.get("edl_serve_prefix_blocks_reused_total")
+                or {}
+            )
+            print(
+                f"  {'prefix_blocks_reused':<24} "
+                f"{sum(reused.values()):g}"
+            )
+            pev = (
+                counters_all.get("edl_serve_prefix_evictions_total")
+                or {}
+            )
+            print(f"  {'prefix_evictions':<24} {sum(pev.values()):g}")
         req = counters_all.get("edl_serve_requests_total") or {}
         for key in sorted(req):
             print(f"  requests{{{key}}}{'':<10} {req[key]:g}")

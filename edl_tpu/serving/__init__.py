@@ -42,6 +42,7 @@ from edl_tpu.serving.batcher import (
     TokenContinuousBatcher,
 )
 from edl_tpu.serving.engine import (
+    BlockOwnershipError,
     DecodeEngine,
     DispatchWedgedError,
     InferenceEngine,
@@ -49,6 +50,7 @@ from edl_tpu.serving.engine import (
     NotReadyError,
     PromptTooLongError,
 )
+from edl_tpu.serving.prefix import PrefixCache, chain_hashes
 from edl_tpu.serving.migrate import (
     MigrationError,
     MigrationReceiver,
@@ -59,6 +61,7 @@ from edl_tpu.serving.migrate import (
 from edl_tpu.serving.server import ServingReplica, ServingServer, serve_run
 
 __all__ = [
+    "BlockOwnershipError",
     "ContinuousBatcher",
     "DeadlineExceededError",
     "DecodeEngine",
@@ -71,6 +74,7 @@ __all__ = [
     "MigrationReceiver",
     "MigrationRefusedError",
     "NotReadyError",
+    "PrefixCache",
     "PromptTooLongError",
     "QueueFullError",
     "ServingReplica",
@@ -78,6 +82,7 @@ __all__ = [
     "Ticket",
     "TokenContinuousBatcher",
     "TornMigrationError",
+    "chain_hashes",
     "migrate_out",
     "serve_run",
 ]

@@ -366,7 +366,7 @@ class GenerateTicket:
         "prompt", "max_new", "deadline", "eos_id", "enqueued", "on_event",
         "state", "blocks", "table", "length", "last_token", "tokens",
         "restarts", "last_time", "prefilled", "chunks", "first_time",
-        "migrated", "_done", "_result", "_error",
+        "migrated", "reused_blocks", "_done", "_result", "_error",
     )
 
     def __init__(
@@ -407,6 +407,10 @@ class GenerateTicket:
         #: migration or cold requeue) — it no longer counts toward the
         #: local drain; its caller's future resolves via the relay
         self.migrated = False
+        #: KV blocks claimed from the prefix cache at admission (this
+        #: many blocks of prompt were never prefilled here; a restart
+        #: resets it alongside ``prefilled``)
+        self.reused_blocks = 0
         self._done = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -488,6 +492,7 @@ class TokenContinuousBatcher:
         chaos=None,
         chunked_prefill: Optional[bool] = None,
         prefill_token_budget: int = 0,
+        prefix_cache: Optional[bool] = None,
     ):
         self.engine = engine
         self.queue_limit = int(queue_limit)
@@ -514,6 +519,23 @@ class TokenContinuousBatcher:
             or getattr(engine, "max_chunk_tokens", 0)
             or 64
         )
+        #: content-addressed prefix reuse (serving/prefix.py): chunked
+        #: mode only — the skip-to-cold offset IS a chunk offset.  On
+        #: by default; ``prefix_cache=False`` is the A/B baseline.
+        if prefix_cache is None:
+            prefix_cache = self.chunked_prefill
+        self.prefix = None
+        if prefix_cache:
+            if not self.chunked_prefill:
+                raise ValueError(
+                    "prefix_cache requires chunked prefill (the cached "
+                    "run's skip offset is a chunk offset)"
+                )
+            from edl_tpu.serving.prefix import PrefixCache
+
+            self.prefix = PrefixCache(
+                engine.pool, engine.block_tokens, chaos=self.chaos
+            )
         self._cv = threading.Condition()
         self._queue: deque = deque()
         #: FIFO of admitted, partially-prefilled sequences (chunked
@@ -704,6 +726,7 @@ class TokenContinuousBatcher:
             self._prefilling_tokens -= int(t.prompt.shape[0]) - t.prefilled
             self._free_blocks(t)
             t.prefilled = 0
+            t.reused_blocks = 0
             t.state = _QUEUED
             out.append(t)
         for t in out:
@@ -726,6 +749,7 @@ class TokenContinuousBatcher:
         t.length = 0
         t.last_token = 0
         t.prefilled = 0
+        t.reused_blocks = 0
         with self._cv:
             self._queue.appendleft(t)
             self._queued_tokens += int(t.prompt.shape[0])
@@ -778,6 +802,7 @@ class TokenContinuousBatcher:
                 t.length = 0
                 t.last_token = 0
                 t.prefilled = 0
+                t.reused_blocks = 0
                 t.restarts += 1
                 t._event(
                     {
@@ -878,6 +903,7 @@ class TokenContinuousBatcher:
                 "restarts": t.restarts,
                 "prompt_tokens": int(t.prompt.shape[0]),
                 "prefill_chunks": t.chunks,
+                "reused_blocks": t.reused_blocks,
                 "ttft_s": (
                     round(t.first_time - t.enqueued, 6)
                     if t.first_time is not None
@@ -914,6 +940,7 @@ class TokenContinuousBatcher:
                 self._free_blocks(t)
                 t.state = _QUEUED
                 t.prefilled = 0
+                t.reused_blocks = 0
                 self._queue.appendleft(t)
                 self._queued_tokens += int(t.prompt.shape[0])
             for t in reversed(restarted):
@@ -923,6 +950,7 @@ class TokenContinuousBatcher:
                 t.length = 0
                 t.last_token = 0
                 t.prefilled = 0
+                t.reused_blocks = 0
                 t.restarts += 1
                 t._event(
                     {
@@ -1058,10 +1086,27 @@ class TokenContinuousBatcher:
                 self._queue.popleft()
                 self._queued_tokens -= int(t.prompt.shape[0])
                 self._g_depth.set(len(self._queue))
-            self._prefilling_tokens += int(t.prompt.shape[0]) - t.prefilled
             t.state = _PREFILLING
             if t.table is None:
                 t.table = np.zeros(self.engine.blocks_per_seq, np.int32)
+            if (
+                self.prefix is not None
+                and t.prefilled == 0
+                and not t.blocks
+            ):
+                run, skip = self.prefix.claim(t.prompt)
+                if skip:
+                    # Shared-prefix hit: seed the run/table with the
+                    # claimed (refcounted, read-only) blocks and skip
+                    # the FIFO straight to the first cold block.  The
+                    # claimer never writes these blocks — all its
+                    # writes land at positions >= skip, in blocks the
+                    # prefill loop allocates privately.
+                    t.blocks = list(run)
+                    t.table[: len(run)] = run
+                    t.prefilled = skip
+                    t.reused_blocks = len(run)
+            self._prefilling_tokens += int(t.prompt.shape[0]) - t.prefilled
             self._prefilling.append(t)
             joined += 1
         return joined
@@ -1157,6 +1202,13 @@ class TokenContinuousBatcher:
             self._m_prefill_tokens.inc(clen)
             if t.prefilled >= plen:
                 self._prefilling.popleft()
+                if self.prefix is not None:
+                    # Publish the fully-filled prompt blocks into the
+                    # prefix index (the trailing partial block stays
+                    # private).  This sequence's own refcount keeps
+                    # them alive while it decodes; at refcount 0 they
+                    # park on the pool's cached LRU for reuse.
+                    self.prefix.publish(t.prompt, t.blocks)
                 self._join_decode(t, first, plen)
         return dispatched
 
@@ -1293,6 +1345,12 @@ class TokenContinuousBatcher:
                 continue
             epoch = getattr(self.engine, "cache_epoch", 0)
             if w.generation != self._bound_gen or epoch != self._bound_epoch:
+                if self.prefix is not None:
+                    # Rekey BEFORE the restart frees any blocks: the
+                    # index drops atomically, published marks clear,
+                    # and no admission under the new weights can ever
+                    # claim a block filled by the old ones.
+                    self.prefix.rekey((w.generation, epoch))
                 if self._bound_gen >= 0:
                     # A swap (new generation) or a rebuilt pool (new
                     # cache epoch after a failed donated dispatch):
@@ -1303,6 +1361,10 @@ class TokenContinuousBatcher:
                 self._bound_step = w.step
                 self._bound_digest = w.digest
                 self._bound_epoch = epoch
+            if self.prefix is not None and self.chaos is not None:
+                # chaos[serve.prefix.evicted]: force LRU evictions of
+                # cached prefix blocks as if allocation pressure hit.
+                self.prefix.chaos_tick()
             # 1b. adopt migrated-in sequences (generation-key checked
             # against the weights just bound — skew re-prefills cold).
             adopted_work = self._adopt_pending(w) if self._adopted else 0

@@ -64,6 +64,15 @@ class DispatchWedgedError(RuntimeError):
     genuine compute error still rejects)."""
 
 
+class BlockOwnershipError(RuntimeError):
+    """A ``KVBlockPool`` block was freed or referenced without being
+    owned.  The double-free case is the dangerous one: a repeated
+    free-list entry would eventually hand ONE block to TWO sequences,
+    whose decode steps then write each other's K/V — silent output
+    corruption, not a crash.  Raising at the bad ``free`` turns that
+    into an immediate, attributable bug."""
+
+
 @dataclass(frozen=True)
 class _Weights:
     """One installed weight set.  Immutable and swapped atomically:
@@ -562,6 +571,21 @@ class KVBlockPool:
     partial grant) so a prompt either gets its full block run or waits
     at admission — a half-allocated sequence could neither prefill nor
     free cleanly.
+
+    Blocks are REFCOUNTED so the prefix cache (``serving/prefix.py``)
+    can share one filled block across every sequence whose prompt
+    starts with its tokens: ``alloc`` grants at refcount 1, ``ref``
+    bumps an existing owner, and ``free`` decrements — a block returns
+    to circulation only at refcount 0.  A refcount-0 block that the
+    prefix cache PUBLISHED is not freed outright: it parks on an LRU
+    of cached blocks (its K/V stays valid and claimable) and is
+    evicted back to the free list lazily, only when ``alloc`` would
+    otherwise come up short — so prefix retention can never starve
+    admission.  Sharing is copy-on-write by construction rather than
+    by copying: a claiming sequence's writes all land at cache
+    positions ≥ its skip offset, i.e. in its own freshly allocated
+    blocks — shared blocks are only ever READ through the table, and
+    the trailing partial block of any prompt is always private.
     """
 
     def __init__(
@@ -587,6 +611,14 @@ class KVBlockPool:
         self.kpool = jax.device_put(jnp.zeros(self._shape, dtype), sharding)
         self.vpool = jax.device_put(jnp.zeros(self._shape, dtype), sharding)
         self._free = deque(range(1, num_blocks))
+        self._ref: Dict[int, int] = {}       # owned block -> refcount
+        self._published: set = set()          # prefix-indexed blocks
+        self._cached: Dict[int, None] = {}    # refcount-0 published, LRU
+        self._lock = threading.Lock()
+        # Called with a block id when a cached block is evicted, so the
+        # prefix index drops its entry before the id can be re-granted.
+        self.on_evict = None
+        self.evictions = 0
 
     @property
     def usable_blocks(self) -> int:
@@ -594,35 +626,139 @@ class KVBlockPool:
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: truly free + evictable cached."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def cached_blocks(self) -> int:
+        return len(self._cached)
 
     @property
     def used_blocks(self) -> int:
-        return self.usable_blocks - len(self._free)
+        """Blocks held by live sequences (cached ones count as free —
+        they are reclaimable on demand)."""
+        return self.usable_blocks - self.free_blocks
 
     def occupancy(self) -> float:
         return self.used_blocks / max(1, self.usable_blocks)
 
+    def _evict_locked(self) -> None:
+        b = next(iter(self._cached))  # LRU end (insertion order)
+        del self._cached[b]
+        self._published.discard(b)
+        if self.on_evict is not None:
+            self.on_evict(b)
+        self._free.append(b)
+        self.evictions += 1
+
+    def evict_cached(self, n: int = 1) -> int:
+        """Evict up to ``n`` LRU cached blocks back to the free list
+        (chaos ``serve.prefix.evicted`` forces this; ``alloc`` does it
+        lazily under pressure).  Returns how many were evicted."""
+        with self._lock:
+            k = min(int(n), len(self._cached))
+            for _ in range(k):
+                self._evict_locked()
+            return k
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Take ``n`` blocks or None (never a partial grant)."""
-        if n > len(self._free):
-            return None
-        return [self._free.popleft() for _ in range(n)]
+        """Take ``n`` blocks at refcount 1, or None (never a partial
+        grant).  Evicts LRU cached prefix blocks if the free list
+        alone is short — retention never starves admission."""
+        with self._lock:
+            if n > len(self._free) + len(self._cached):
+                return None
+            while len(self._free) < n:
+                self._evict_locked()
+            got = [self._free.popleft() for _ in range(n)]
+            for b in got:
+                self._ref[b] = 1
+            return got
+
+    def ref(self, block: int) -> None:
+        """Claim a share of an owned or cached block (prefix reuse):
+        an owner's refcount bumps; a cached block revives at 1."""
+        b = int(block)
+        with self._lock:
+            if b in self._ref:
+                self._ref[b] += 1
+            elif b in self._cached:
+                del self._cached[b]
+                self._ref[b] = 1
+            else:
+                raise BlockOwnershipError(
+                    f"block {b} is neither owned nor cached"
+                )
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(int(block), 0)
+
+    def publish(self, block: int) -> None:
+        """Mark an owned block as prefix-indexed: at refcount 0 it
+        parks on the cached LRU instead of returning to the free
+        list."""
+        b = int(block)
+        with self._lock:
+            if b not in self._ref and b not in self._cached:
+                raise BlockOwnershipError(
+                    f"cannot publish unowned block {b}"
+                )
+            self._published.add(b)
+
+    def drop_published(self) -> None:
+        """Forget every published mark (prefix-pool invalidation on a
+        hot swap / rebuild): cached blocks return to the free list;
+        blocks still held by live sequences only lose the mark — their
+        eventual ``free`` goes straight to the free list."""
+        with self._lock:
+            for b in self._cached:
+                self._free.append(b)
+            self._cached.clear()
+            self._published.clear()
 
     def free(self, blocks: Sequence[int]) -> None:
-        for b in blocks:
-            if b == 0:
-                raise ValueError("block 0 (trash) is never owned")
-            self._free.append(int(b))
+        """Drop one reference per id.  Freeing a block this pool does
+        not consider owned raises ``BlockOwnershipError`` — the silent
+        pre-guard behaviour let a double free enqueue one id twice and
+        hand the block to two sequences."""
+        with self._lock:
+            for b in blocks:
+                b = int(b)
+                if b == 0:
+                    raise ValueError("block 0 (trash) is never owned")
+                n = self._ref.get(b)
+                if n is None:
+                    raise BlockOwnershipError(
+                        f"block {b} freed without being owned "
+                        "(double free or stray id)"
+                    )
+                if n > 1:
+                    self._ref[b] = n - 1
+                elif b in self._published:
+                    del self._ref[b]
+                    self._cached[b] = None  # MRU end of the LRU
+                else:
+                    del self._ref[b]
+                    self._free.append(b)
 
     def reset(self) -> None:
         """Return every block to the free list (engine re-warm /
         tests).  Stale bytes need no scrub: a reused block is fully
         overwritten by prefill, and decode masks never expose
-        positions beyond a sequence's written length."""
+        positions beyond a sequence's written length.  Any prefix
+        index over this pool must be invalidated alongside (the
+        batcher's generation rekey does; ``on_evict`` fires here for
+        published blocks as a belt-and-braces hook)."""
         from collections import deque
 
-        self._free = deque(range(1, self.num_blocks))
+        with self._lock:
+            if self.on_evict is not None:
+                for b in list(self._published):
+                    self.on_evict(b)
+            self._ref.clear()
+            self._published.clear()
+            self._cached.clear()
+            self._free = deque(range(1, self.num_blocks))
 
     def rebuild(self) -> None:
         """Replace the device arrays with fresh zeros, keeping the

@@ -137,6 +137,7 @@ def _seq_meta(t: GenerateTicket) -> Dict[str, Any]:
         "deadline_left_s": round(max(0.001, t.deadline - now), 6),
         "restarts": int(t.restarts),
         "chunks": int(t.chunks),
+        "reused_blocks": int(t.reused_blocks),
         "ttft_s": (
             round(t.first_time - t.enqueued, 6)
             if t.first_time is not None
@@ -150,7 +151,14 @@ def snapshot_sequence(engine, t: GenerateTicket, weights) -> Dict[str, Any]:
     blocks gathered device->host (leaf j = K block j, leaf n+j = V
     block j, each contiguous) plus the offer frame with per-leaf sizes
     and crc32 digests.  MUST run with the batcher frozen — the next
-    donated dispatch invalidates the buffers the gather reads."""
+    donated dispatch invalidates the buffers the gather reads.
+
+    Prefix-cache interplay (ISSUE 17): a sequence may hold SHARED
+    (refcount > 1) prefix blocks — the export is a host COPY, so
+    other claimants on the source are untouched, and the eventual
+    ``detach`` only DECREMENTS refcounts (``KVBlockPool.free``); the
+    destination's grant lands the copies in PRIVATE refcount-1 blocks
+    that are never published into its prefix index."""
     bt = engine.block_tokens
     nblk = max(1, -(-int(t.length) // bt))
     ids = list(t.blocks[:nblk])
@@ -791,6 +799,10 @@ class MigrationReceiver:
         t.tokens = [int(x) for x in seq["tokens"]]
         t.restarts = int(seq.get("restarts", 0))
         t.chunks = int(seq.get("chunks", 0))
+        # Source-side prefix reuse is part of the client-visible meta;
+        # it must survive the hop (the granted blocks themselves land
+        # PRIVATE here — never published into the dest's prefix index).
+        t.reused_blocks = int(seq.get("reused_blocks", 0))
         if seq.get("ttft_s") is not None:
             # TTFT was already observed at the source; pin first_time
             # so adoption never re-samples it AND the finish meta

@@ -1,0 +1,262 @@
+"""Content-addressed KV prefix cache: shared-prefix admissions skip
+straight to the first cold block.
+
+At millions of users, serving traffic is dominated by shared prefixes
+— system prompts, few-shot templates, multi-turn history — yet a
+plain paged-KV admission re-prefills every prompt from token 0.  This
+module is the vLLM prefix-caching recipe (PAPERS.md) on the repo's
+TPU posture: a REPLICA-LOCAL, content-addressed index over the
+``KVBlockPool``'s already-filled blocks.
+
+**Hash scheme.**  Prompts are hashed per BLOCK with a chain hash:
+
+    h_0 = crc32(block 0 token bytes, seed)
+    h_i = crc32(block i token bytes, h_{i-1})
+
+so ``h_i`` names the entire prefix up to and including block i, not
+just block i's own tokens — two prompts share an index entry iff they
+share everything before it.  A lookup walks the chain block by block
+and returns the longest run of already-published blocks.  CRC32 is
+not collision-proof, so every entry stores ``(h_prev, token bytes)``
+and a match requires BOTH to equal the probe's — a colliding hash is
+a miss, never someone else's K/V (the ``serve.prefix.hash.skew``
+chaos point forces this rejection path).
+
+**Claiming is refcounting, not copying.**  ``claim`` bumps each
+matched block's refcount (``KVBlockPool.ref``) and the admission
+seeds the sequence's block run + table with the claimed ids; the
+chunked-prefill FIFO then starts at ``skip = matched_blocks *
+block_tokens`` — the first cold block.  Copy-on-write needs no copy:
+the claimer's writes all land at cache positions ≥ ``skip``, i.e. in
+its own private blocks, and the trailing partial block of any prompt
+is never published, so it is ALWAYS private.  ``skip`` is capped at
+``((plen - 1) // block_tokens) * block_tokens`` so at least the final
+prompt token is always prefilled — that final chunk produces the
+first sampled token, which is why a fully-cached prompt's TTFT
+collapses to roughly ONE chunk dispatch rather than zero.
+
+**Publication and eviction.**  When a sequence's prefill completes,
+its fully-filled prompt blocks are published into the index; its own
+refcount keeps them alive while it decodes, and at refcount 0 a
+published block parks on the pool's cached LRU instead of returning
+to the free list.  ``alloc`` under pressure evicts that LRU lazily
+(``pool.on_evict`` drops the index entry first), so retention can
+never starve admission.
+
+**Generation keying.**  The index is keyed by ``(weights generation,
+cache_epoch)``: a hot swap or a pool rebuild changes the key and
+``rekey`` invalidates the WHOLE index atomically (and releases the
+pool's cached blocks) — a reused block can never carry
+old-generation K/V, which is what makes reused-block decode
+bit-identical to cold prefill.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_H_SEED = 0x45444C50  # "EDLP"
+
+
+class _Entry:
+    """One published block: keyed in the index by its chain hash."""
+
+    __slots__ = ("block", "h_prev", "tokens")
+
+    def __init__(self, block: int, h_prev: int, tokens: bytes):
+        self.block = block
+        self.h_prev = h_prev
+        self.tokens = tokens
+
+
+def chain_hashes(prompt: np.ndarray, block_tokens: int) -> List[int]:
+    """The per-block chain hashes of every FULLY-FILLED block of
+    ``prompt`` (the trailing partial block is never hashed — it is
+    always private)."""
+    bt = int(block_tokens)
+    toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+    out: List[int] = []
+    h = _H_SEED
+    for i in range(len(toks) // bt):
+        h = zlib.crc32(toks[i * bt:(i + 1) * bt].tobytes(), h)
+        out.append(h)
+    return out
+
+
+class PrefixCache:
+    """Replica-local content-addressed index over a ``KVBlockPool``'s
+    published blocks.  All mutation happens on the batcher worker
+    thread except ``_on_evict``, which the pool may call from any
+    allocating thread (migration receiver grants) — both sides are
+    serialized by the pool's lock plus GIL-atomic dict ops here.
+    """
+
+    def __init__(self, pool, block_tokens: int, chaos=None):
+        self.pool = pool
+        self.block_tokens = int(block_tokens)
+        self.chaos = chaos
+        #: (weights generation, cache_epoch) the index was built under
+        self.key: Optional[Tuple[int, int]] = None
+        self._index: Dict[int, _Entry] = {}   # chain hash -> entry
+        self._by_block: Dict[int, int] = {}   # block id -> chain hash
+        self.stats = {
+            "hits": 0, "misses": 0, "blocks_reused": 0,
+            "evictions": 0, "invalidations": 0, "skew_rejected": 0,
+        }
+        pool.on_evict = self._on_evict
+
+        from edl_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self.recorder = telemetry.get_recorder()
+        self._m_hits = reg.counter("edl_serve_prefix_hits_total")
+        self._m_misses = reg.counter("edl_serve_prefix_misses_total")
+        self._m_reused = reg.counter("edl_serve_prefix_blocks_reused_total")
+        self._m_evictions = reg.counter("edl_serve_prefix_evictions_total")
+        self._g_ratio = reg.gauge("edl_serve_prefix_hit_ratio")
+
+    # -- index maintenance --------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _on_evict(self, block: int) -> None:
+        h = self._by_block.pop(int(block), None)
+        if h is not None:
+            self._index.pop(h, None)
+        self.stats["evictions"] += 1
+        self._m_evictions.inc()
+
+    def rekey(self, key: Tuple[int, int]) -> bool:
+        """Bind the index to ``(generation, cache_epoch)``; a changed
+        key invalidates everything the previous generation published —
+        atomically, BEFORE any admission under the new weights can
+        look up.  Returns True if an invalidation happened."""
+        if key == self.key:
+            return False
+        invalidated = self.key is not None
+        prev = self.key
+        self.key = key
+        if invalidated:
+            dropped = len(self._index)
+            self._index.clear()
+            self._by_block.clear()
+            self.pool.drop_published()
+            self.stats["invalidations"] += 1
+            # Entry/reuse counts at the moment of a swap are
+            # scheduling-dependent; they ride the non-identity timing
+            # field so same-seed journals stay bit-identical.
+            self.recorder.record(
+                "serve.prefix",
+                {
+                    "outcome": "invalidated",
+                    "from": list(prev),
+                    "to": list(key),
+                },
+                timing={"entries_dropped": dropped,
+                        "hits": self.stats["hits"],
+                        "blocks_reused": self.stats["blocks_reused"]},
+            )
+        return invalidated
+
+    # -- admission side -----------------------------------------------------
+    def claim(self, prompt: np.ndarray) -> Tuple[List[int], int]:
+        """Walk the chain for ``prompt`` and claim (refcount-bump) the
+        longest published run.  Returns ``(blocks, skip_tokens)`` —
+        empty/0 on a miss.  The run is capped one block short of the
+        prompt's end so the final token is always prefilled cold."""
+        bt = self.block_tokens
+        plen = int(len(prompt))
+        limit = (plen - 1) // bt  # max claimable blocks
+        if limit <= 0:
+            return [], 0
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        skew: Optional[bool] = None
+        run: List[int] = []
+        h_prev = _H_SEED
+        for i in range(limit):
+            blk = toks[i * bt:(i + 1) * bt].tobytes()
+            h = zlib.crc32(blk, h_prev)
+            ent = self._index.get(h)
+            if ent is None:
+                break
+            if skew is None:
+                # Consulted lazily, once per lookup, and only when a
+                # candidate entry exists: a cold lookup has nothing to
+                # verify and must not consume the trip.
+                skew = self.chaos is not None and bool(
+                    self.chaos.due("serve.prefix.hash.skew")
+                )
+            if skew or ent.h_prev != h_prev or ent.tokens != blk:
+                # A chain-hash collision (or a chaos-forced skew
+                # simulating one): the stored (h_prev, tokens) pair is
+                # the ground truth and it disagrees — treat as a miss
+                # rather than serve someone else's K/V.
+                self.stats["skew_rejected"] += 1
+                self.recorder.record(
+                    "serve.prefix",
+                    {"outcome": "hash_skew_rejected",
+                     "forced": bool(skew)},
+                    timing={"at_block": i},
+                )
+                break
+            try:
+                self.pool.ref(ent.block)
+            except Exception:
+                # Raced an eviction between index read and claim —
+                # the entry is already being dropped; stop the run.
+                break
+            run.append(ent.block)
+            h_prev = h
+        skip = len(run) * bt
+        if run:
+            self.stats["hits"] += 1
+            self.stats["blocks_reused"] += len(run)
+            self._m_hits.inc()
+            self._m_reused.inc(len(run))
+        else:
+            self.stats["misses"] += 1
+            self._m_misses.inc()
+        total = self.stats["hits"] + self.stats["misses"]
+        if total:
+            self._g_ratio.set(self.stats["hits"] / total)
+        return run, skip
+
+    def publish(self, prompt: np.ndarray, blocks: List[int]) -> int:
+        """Index a finished prefill's fully-filled prompt blocks (the
+        trailing partial block stays private).  Blocks already indexed
+        — including the ones this sequence itself claimed — are left
+        alone.  Returns how many NEW entries were added."""
+        bt = self.block_tokens
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        full = min(int(len(toks)) // bt, len(blocks))
+        added = 0
+        h_prev = _H_SEED
+        for i in range(full):
+            blk = toks[i * bt:(i + 1) * bt].tobytes()
+            h = zlib.crc32(blk, h_prev)
+            if h not in self._index and blocks[i] not in self._by_block:
+                b = int(blocks[i])
+                self.pool.publish(b)
+                self._index[h] = _Entry(b, h_prev, blk)
+                self._by_block[b] = h
+                added += 1
+            h_prev = h
+        return added
+
+    # -- chaos --------------------------------------------------------------
+    def chaos_tick(self) -> None:
+        """Fire due ``serve.prefix.evicted`` trips: force-evict LRU
+        cached blocks as if allocation pressure demanded it."""
+        if self.chaos is None:
+            return
+        for ev in self.chaos.due("serve.prefix.evicted"):
+            want = int(ev.arg or 1)
+            got = self.pool.evict_cached(want)
+            self.recorder.record(
+                "serve.prefix",
+                {"outcome": "chaos_evicted", "requested": want},
+                timing={"evicted": got},
+            )

@@ -400,6 +400,10 @@ class ServingServer:
                         # the enqueue->first-token TTFT the server
                         # accounts for it (spans ALL chunks)
                         "prefill_chunks": meta.get("prefill_chunks", 0),
+                        # prefix-cache receipt (ISSUE 17): how many
+                        # KV blocks this admission reused instead of
+                        # re-prefilling — 0 on a cold prompt
+                        "reused_blocks": meta.get("reused_blocks", 0),
                         "ttft_ms": (
                             round(meta["ttft_s"] * 1000.0, 3)
                             if meta.get("ttft_s") is not None

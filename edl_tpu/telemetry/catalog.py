@@ -546,6 +546,40 @@ CATALOG = {
         ),
         "labels": (),
     },
+    "edl_serve_prefix_hits_total": {
+        "type": "counter",
+        "help": "Admissions whose prompt matched a published prefix "
+        "run in the content-addressed KV prefix cache (ISSUE 17) — "
+        "the sequence skipped straight to its first cold block.",
+        "labels": (),
+    },
+    "edl_serve_prefix_misses_total": {
+        "type": "counter",
+        "help": "Admissions that walked the prefix chain and matched "
+        "nothing (prompts too short to span one block are not "
+        "counted — they are uncacheable, not missed).",
+        "labels": (),
+    },
+    "edl_serve_prefix_blocks_reused_total": {
+        "type": "counter",
+        "help": "KV blocks claimed by refcount bump instead of being "
+        "allocated and prefilled (each is one block of prompt "
+        "compute the replica never paid).",
+        "labels": (),
+    },
+    "edl_serve_prefix_evictions_total": {
+        "type": "counter",
+        "help": "Refcount-0 cached prefix blocks evicted back to the "
+        "free list (LRU, under allocation pressure or a chaos "
+        "serve.prefix.evicted trip).",
+        "labels": (),
+    },
+    "edl_serve_prefix_hit_ratio": {
+        "type": "gauge",
+        "help": "Running hits / (hits + misses) of the prefix cache "
+        "since the batcher started (invalidations do not reset it).",
+        "labels": (),
+    },
     "edl_serve_intertoken_seconds": {
         "type": "histogram",
         "help": "Gap between consecutive tokens of one sequence "
@@ -664,6 +698,7 @@ KNOWN_EVENT_KINDS = {
     "serve.drain": "a replica drain started / completed",
     "serve.watchdog": "a serving dispatch missed the watchdog deadline",
     "serve.migrate": "a live KV sequence moved (or fell back) at drain",
+    "serve.prefix": "the KV prefix cache invalidated / rejected / evicted",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

@@ -1,0 +1,582 @@
+"""Content-addressed KV prefix cache (ISSUE 17): shared-prefix
+admissions skip straight to the first cold block.
+
+Key guarantees under test:
+
+- **refcounted pool**: shared blocks return to circulation only at
+  refcount 0; a published refcount-0 block parks on the cached LRU
+  and is evicted lazily — retention never starves admission; freeing
+  an unowned id raises ``BlockOwnershipError`` (the double-free
+  regression that would hand one block to two sequences);
+- **chain-hash lookup**: the longest published run is claimed, the
+  divergence block onward prefills cold, the trailing partial block
+  is always private, and a colliding hash (chaos ``hash.skew``) is a
+  miss — never someone else's K/V;
+- **bit-identical reuse**: a warm admission's tokens equal both the
+  cold-prefill reference AND a cold same-prompt run, per LM family;
+- **generation keying**: a hot swap invalidates the whole index
+  atomically — zero cross-generation reuse, asserted per swap in the
+  seeded soak, which also journals bit-identically across same-seed
+  reruns.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.serving import (
+    BlockOwnershipError,
+    DecodeEngine,
+    KVBlockPool,
+    PrefixCache,
+    TokenContinuousBatcher,
+    chain_hashes,
+)
+from tests.test_decode_serving import _lm_state, _reference_decode
+
+
+def _pool(num_blocks=8):
+    return KVBlockPool(
+        2, 4, 16, num_blocks=num_blocks, block_tokens=16,
+        dtype=jnp.bfloat16, sharding=None,
+    )
+
+
+def _build_engine(step=1, seed=1, **kw):
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, step, seed), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[:1],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        **kw,
+    )
+    assert engine.load()
+    engine.warm()
+    return model, store, engine
+
+
+@pytest.fixture(scope="module")
+def prefix_lm():
+    """One warmed transformer_lm DecodeEngine shared by the tests that
+    don't hot-swap; every test must leave the pool with zero
+    live-sequence blocks."""
+    return _build_engine()
+
+
+def _gen(batcher, prompt, n=4, timeout=60):
+    return batcher.submit_generate(
+        {"tokens": list(prompt)}, max_new_tokens=n, deadline_s=60.0
+    ).result(timeout=timeout)
+
+
+# -- the refcounted pool ------------------------------------------------------
+
+
+def test_pool_double_free_raises_typed_error():
+    """ISSUE 17 satellite regression: pre-guard, ``free`` accepted any
+    id silently — a double free enqueued one block twice and two
+    later sequences shared it.  Now it raises."""
+    pool = _pool()
+    got = pool.alloc(2)
+    pool.free(got)
+    with pytest.raises(BlockOwnershipError, match="without being owned"):
+        pool.free([got[0]])  # double free
+    with pytest.raises(BlockOwnershipError):
+        pool.free([pool.num_blocks - 1])  # never-allocated stray id
+    with pytest.raises(ValueError, match="trash"):
+        pool.free([0])
+    # the guard kept the free list clean: every id grants exactly once
+    grant = pool.alloc(pool.usable_blocks)
+    assert sorted(grant) == list(range(1, pool.num_blocks))
+
+
+def test_pool_refcount_shares_and_returns_at_zero():
+    pool = _pool()
+    (b,) = pool.alloc(1)
+    pool.ref(b)  # a second claimant
+    assert pool.refcount(b) == 2
+    pool.free([b])
+    assert pool.refcount(b) == 1, "refcount>0: not freed"
+    assert pool.free_blocks == pool.usable_blocks - 1
+    pool.free([b])
+    assert pool.refcount(b) == 0
+    assert pool.free_blocks == pool.usable_blocks
+    with pytest.raises(BlockOwnershipError):
+        pool.ref(b)  # neither owned nor cached anymore
+
+
+def test_pool_published_blocks_park_on_lru_and_evict_under_pressure():
+    """A refcount-0 PUBLISHED block is cached (claimable), not freed;
+    ``alloc`` under pressure evicts the LRU cached block and tells
+    the index via ``on_evict`` — retention never starves admission."""
+    pool = _pool(num_blocks=5)  # 4 usable
+    a = pool.alloc(4)
+    for b in a[:2]:
+        pool.publish(b)
+    pool.free(a)
+    assert pool.cached_blocks == 2 and pool.free_blocks == 4
+    pool.ref(a[0])  # revive from the cache: refcount 0 -> 1
+    assert pool.refcount(a[0]) == 1 and pool.cached_blocks == 1
+    pool.free([a[0]])  # back to the cache (still published)
+    evicted = []
+    pool.on_evict = evicted.append
+    grant = pool.alloc(4)  # needs both cached blocks evicted
+    assert grant is not None and len(grant) == 4
+    assert sorted(evicted) == sorted(a[:2])
+    assert pool.evictions == 2
+    pool.free(grant)
+
+
+def test_pool_alloc_still_all_or_nothing_with_cache():
+    pool = _pool(num_blocks=5)
+    a = pool.alloc(3)
+    pool.publish(a[0])
+    pool.free(a)  # 2 to the free list, 1 parks cached; 1 never left
+    assert pool.free_blocks == 4
+    assert pool.alloc(5) is None, "over capacity: no partial grant"
+    assert pool.free_blocks == 4, "a refused alloc evicts nothing"
+
+
+# -- chain hashing and the index ---------------------------------------------
+
+
+def test_chain_hashes_name_the_whole_prefix():
+    p = np.arange(40, dtype=np.int32)
+    hs = chain_hashes(p, 16)
+    assert len(hs) == 2, "the trailing partial block is never hashed"
+    q = p.copy()
+    q[0] = 99  # perturb block 0: EVERY downstream hash must change
+    ht = chain_hashes(q, 16)
+    assert hs[0] != ht[0] and hs[1] != ht[1]
+    r = p.copy()
+    r[20] = 99  # perturb block 1 only: block 0's hash is unchanged
+    hr = chain_hashes(r, 16)
+    assert hs[0] == hr[0] and hs[1] != hr[1]
+
+
+def test_prefix_claim_longest_run_and_divergence():
+    with telemetry.scoped():
+        pool = _pool(num_blocks=12)
+        cache = PrefixCache(pool, 16)
+        prompt = np.arange(100, 170, dtype=np.int32)  # 70 tokens
+        blocks = pool.alloc(5)
+        assert cache.publish(prompt, blocks) == 4, "4 full blocks indexed"
+        pool.free(blocks)
+
+        # same prompt: claims all 4 full blocks, final 6 tokens cold
+        run, skip = cache.claim(prompt)
+        assert run == blocks[:4] and skip == 64
+        assert all(pool.refcount(b) == 1 for b in run)
+        pool.free(run)
+
+        # divergence inside block 2: only blocks 0-1 match
+        div = prompt.copy()
+        div[40] += 1
+        run, skip = cache.claim(div)
+        assert run == blocks[:2] and skip == 32
+        pool.free(run)
+
+        # block-aligned prompt: the LAST block stays cold so the final
+        # chunk still produces the first token
+        run, skip = cache.claim(prompt[:64])
+        assert len(run) == 3 and skip == 48
+        pool.free(run)
+
+        # under one block: uncacheable, not a miss
+        misses0 = cache.stats["misses"]
+        assert cache.claim(prompt[:9]) == ([], 0)
+        assert cache.stats["misses"] == misses0
+
+
+def test_prefix_eviction_drops_index_entries():
+    with telemetry.scoped():
+        pool = _pool(num_blocks=4)  # 3 usable
+        cache = PrefixCache(pool, 16)
+        prompt = np.arange(33, dtype=np.int32)
+        blocks = pool.alloc(3)
+        cache.publish(prompt, blocks)
+        pool.free(blocks)
+        assert len(cache) == 2 and pool.cached_blocks == 2
+        grant = pool.alloc(3)  # evicts both cached blocks
+        assert grant is not None
+        assert len(cache) == 0, "on_evict dropped the index entries"
+        assert cache.claim(prompt) == ([], 0)
+        assert cache.stats["evictions"] == 2
+        pool.free(grant)
+
+
+def test_prefix_rekey_invalidates_atomically():
+    with telemetry.scoped() as (_, rec):
+        pool = _pool(num_blocks=8)
+        cache = PrefixCache(pool, 16)
+        assert cache.rekey((0, 0)) is False, "first bind: nothing to drop"
+        prompt = np.arange(50, dtype=np.int32)
+        blocks = pool.alloc(3)
+        cache.publish(prompt, blocks)
+        pool.free(blocks)
+        assert cache.rekey((0, 0)) is False, "same key: index survives"
+        assert len(cache) == 3  # 50 tokens cover 3 full 16-token blocks
+        assert cache.rekey((1, 0)) is True, "new generation: invalidated"
+        assert len(cache) == 0 and pool.cached_blocks == 0
+        assert pool.free_blocks == pool.usable_blocks
+        assert cache.claim(prompt) == ([], 0), "zero cross-generation reuse"
+        kinds = [e.kind for e in rec.events()]
+        assert "serve.prefix" in kinds
+
+
+# -- end-to-end through the batcher ------------------------------------------
+
+
+@pytest.mark.parametrize("name", ["transformer_lm", "moe_lm",
+                                  "longcontext_lm"])
+def test_warm_admission_bit_identical_per_family(name):
+    """ISSUE 17 acceptance: reused-block decode is bit-identical to
+    cold prefill, per LM family, under one seed — and the warm
+    admission demonstrably skipped to the first cold block."""
+    model = get_model(name, tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model, store, devices=jax.devices()[:1], max_batch=1,
+        max_seqs=4, block_tokens=16, max_chunk_tokens=16,
+    )
+    assert engine.load()
+    engine.warm()
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(engine, refresh=False).start()
+        try:
+            rng = np.random.RandomState(1)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            cold_t, cold_m = _gen(batcher, prompt)
+            warm_t, warm_m = _gen(batcher, prompt)
+            assert cold_m["reused_blocks"] == 0
+            assert warm_m["reused_blocks"] == 2, "(40-1)//16 blocks claimed"
+            assert warm_m["prefill_chunks"] < cold_m["prefill_chunks"]
+            assert warm_t == cold_t
+            w = engine.current_weights()
+            ref = _reference_decode(model, w.params, list(prompt), 4, engine)
+            assert warm_t == ref, "reused-block decode impure vs reference"
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_divergent_tail_reuses_shared_run_only(prefix_lm):
+    model, _, engine = prefix_lm
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(engine, refresh=False).start()
+        try:
+            rng = np.random.RandomState(2)
+            base = model.synth_batch(rng, 1)["tokens"][0, :48]
+            tail = model.synth_batch(rng, 1)["tokens"][0, :10]
+            _gen(batcher, base)
+            div = list(base[:32]) + list(tail)
+            toks, meta = _gen(batcher, div)
+            assert meta["reused_blocks"] == 2, "shared 32-token run only"
+            w = engine.current_weights()
+            assert toks == _reference_decode(model, w.params, div, 4, engine)
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_hot_swap_invalidates_pool_zero_cross_generation_reuse():
+    """A swap between two same-prompt admissions must invalidate the
+    index: the post-swap admission reuses NOTHING and its tokens are
+    the new generation's pure decode."""
+    model, store, engine = _build_engine()
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(engine).start()
+        try:
+            rng = np.random.RandomState(3)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            old_t, old_m = _gen(batcher, prompt)
+            assert old_m["weights_step"] == 1
+            store.save_async(_lm_state(model, 2, 2), generation=1)
+            store.wait()
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                toks, meta = _gen(batcher, prompt)
+                if meta["weights_step"] == 2:
+                    break
+                time.sleep(0.01)
+            assert meta["weights_step"] == 2, "swap never observed"
+            assert meta["reused_blocks"] == 0, "cross-generation reuse!"
+            assert batcher.prefix.stats["invalidations"] >= 1
+            w = engine.current_weights()
+            ref = _reference_decode(model, w.params, list(prompt), 4, engine)
+            assert toks == ref, "post-swap tokens impure"
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_chaos_hash_skew_rejects_reuse_correctly(prefix_lm):
+    """chaos[serve.prefix.hash.skew]: the verification path treats the
+    lookup as colliding — a miss and a cold prefill, never wrong K/V."""
+    model, _, engine = prefix_lm
+    chaos = FaultSchedule(0, [FaultEvent(0, "serve.prefix.hash.skew")])
+    chaos.advance(0)
+    with telemetry.scoped() as (_, rec):
+        batcher = TokenContinuousBatcher(
+            engine, refresh=False, chaos=chaos
+        ).start()
+        try:
+            rng = np.random.RandomState(4)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            _gen(batcher, prompt)
+            toks, meta = _gen(batcher, prompt)  # the skewed lookup
+            assert meta["reused_blocks"] == 0
+            assert batcher.prefix.stats["skew_rejected"] == 1
+            toks2, meta2 = _gen(batcher, prompt)  # chaos consumed: hits
+            assert meta2["reused_blocks"] == 2
+            assert toks2 == toks
+            evs = [e for e in rec.events() if e.kind == "serve.prefix"]
+            assert any(
+                e.data.get("outcome") == "hash_skew_rejected" for e in evs
+            )
+        finally:
+            batcher.stop()
+    assert chaos.pending() == []
+    assert engine.pool.used_blocks == 0
+
+
+def test_chaos_forced_eviction_refills_cold(prefix_lm):
+    """chaos[serve.prefix.evicted]: cached blocks force-evict; the
+    next same-prefix admission prefills the evicted run cold and
+    still emits identical tokens."""
+    model, _, engine = prefix_lm
+    chaos = FaultSchedule(0, [FaultEvent(1, "serve.prefix.evicted", 99)])
+    with telemetry.scoped() as (_, rec):
+        batcher = TokenContinuousBatcher(
+            engine, refresh=False, chaos=chaos
+        ).start()
+        try:
+            rng = np.random.RandomState(5)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            toks, _ = _gen(batcher, prompt)
+            chaos.advance(1)
+            # The worker runs chaos_tick at the top of the iteration
+            # that admits the next request — the eviction lands BEFORE
+            # its lookup, deterministically.
+            toks2, meta2 = _gen(batcher, prompt)
+            assert chaos.pending() == []
+            assert meta2["reused_blocks"] == 0, "evicted: nothing to claim"
+            assert toks2 == toks
+            assert batcher.prefix.stats["evictions"] >= 1
+            evs = [e for e in rec.events() if e.kind == "serve.prefix"]
+            assert any(
+                e.data.get("outcome") == "chaos_evicted" for e in evs
+            )
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_prefix_disabled_is_the_cold_baseline(prefix_lm):
+    model, _, engine = prefix_lm
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(
+            engine, refresh=False, prefix_cache=False
+        ).start()
+        try:
+            assert batcher.prefix is None
+            rng = np.random.RandomState(6)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            t1, m1 = _gen(batcher, prompt)
+            t2, m2 = _gen(batcher, prompt)
+            assert m2["reused_blocks"] == 0
+            assert m2["prefill_chunks"] == m1["prefill_chunks"]
+            assert t1 == t2
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+def test_prefix_pressure_never_starves_admission():
+    """Fill the whole pool with cached prefix runs, then admit a
+    prompt needing more blocks than the raw free list holds: the LRU
+    eviction inside ``alloc`` must make room transparently."""
+    model, store, engine = _build_engine(num_blocks=9)  # 8 usable
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(engine, refresh=False).start()
+        try:
+            rng = np.random.RandomState(7)
+            for i in range(3):  # 3 finished 2-block runs stay cached
+                p = model.synth_batch(rng, 1)["tokens"][0, :33]
+                _gen(batcher, p, n=2)
+            assert engine.pool.cached_blocks >= 4
+            long = model.synth_batch(rng, 1)["tokens"][0, :60]
+            toks, meta = _gen(batcher, long, n=2)
+            assert len(toks) == 2
+            assert batcher.prefix.stats["evictions"] >= 1
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+# -- the seeded prefix soak ---------------------------------------------------
+
+
+def _wait(cond, timeout=30.0, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"wait timed out: {what}")
+
+
+def _run_prefix_soak(seed: int):
+    """Mixed shared-prefix/divergent traffic across 2 hot swaps, with
+    a forced hash skew riding round-1 traffic and a forced eviction
+    riding round-3 traffic.  The worker only runs between admissions
+    it has work for, and submission is sequential, so every trip's
+    consumption point — and therefore every hit/miss — is
+    deterministic.  Returns what must be bit-identical across
+    same-seed runs."""
+    events = [
+        FaultEvent(1, "serve.prefix.hash.skew"),
+        FaultEvent(2, "serve.prefix.evicted", 2),
+    ]
+    with telemetry.scoped() as (_, rec):
+        schedule = FaultSchedule(seed, events)
+        model, store, engine = _build_engine()
+        batcher = TokenContinuousBatcher(engine, chaos=schedule).start()
+        rng = np.random.RandomState(seed % 2**31)
+        #: ONE shared system prefix across all three generations — the
+        #: post-swap rounds resubmit it, so any nonzero reuse on their
+        #: first admission would be cross-generation reuse.
+        shared = model.synth_batch(rng, 1)["tokens"][0, :48]
+        log = []
+        try:
+            for step in (1, 2, 3):
+                if step > 1:  # hot swap (>= 2 over the soak)
+                    store.save_async(
+                        _lm_state(model, step, step), generation=step - 1
+                    )
+                    store.wait()
+                    # The idle worker only notices the swap at its
+                    # next admission — the round's first _gen below
+                    # deterministically binds the new generation
+                    # (refresh runs before the admission's lookup),
+                    # so the post-swap asserts live after i == 0.
+                for i in range(5):
+                    if step == 1 and i == 2:
+                        # Forced hash skew: THIS admission's claim (a
+                        # warm shared-prefix one) must reject its
+                        # match and prefill cold.
+                        schedule.advance(1)
+                    if step == 3 and i == 2:
+                        # Forced eviction: the worker's chaos_tick at
+                        # the top of THIS admission's iteration evicts
+                        # the 2 LRU cached shared blocks before the
+                        # lookup — the chain breaks at block 0 and the
+                        # run re-prefills cold.
+                        schedule.advance(2)
+                    if i < 4:  # shared prefix, divergent tails
+                        tail = model.synth_batch(rng, 1)["tokens"][0, :8]
+                        prompt = list(shared) + list(tail)
+                    else:  # fully divergent short prompt
+                        prompt = list(
+                            model.synth_batch(rng, 1)["tokens"][0, :24]
+                        )
+                    toks, meta = _gen(batcher, prompt, n=4)
+                    assert meta["weights_step"] == step
+                    if i == 0:
+                        # The engine generation is a 1-based swap
+                        # counter: first load binds (1, 0), each swap
+                        # advances it by one — and each swap's rekey
+                        # invalidated the whole index.
+                        assert batcher.prefix.key == (step, 0)
+                        assert (
+                            batcher.prefix.stats["invalidations"]
+                            == step - 1
+                        )
+                    w = engine.current_weights()
+                    ref = _reference_decode(
+                        model, w.params, prompt, 4, engine
+                    )
+                    assert toks == ref, "soak tokens diverge from cold ref"
+                    log.append(
+                        (step, i, meta["reused_blocks"], tuple(toks))
+                    )
+                # first admission of a post-swap round resubmitted the
+                # SAME shared prefix the old generation published:
+                assert log[-5][2] == 0, "cross-generation reuse"
+        finally:
+            batcher.stop()
+        assert schedule.pending() == []
+        assert engine.pool.used_blocks == 0
+        stats = dict(batcher.prefix.stats)
+        assert stats["invalidations"] == 2
+        assert stats["skew_rejected"] == 1
+        assert stats["evictions"] == 2
+        # hits: i1/i3 every round + i2 in round 2 (round 1's i2 is the
+        # skew, round 3's follows the forced eviction) = 7 admissions,
+        # 3 shared blocks each
+        assert stats["hits"] == 7 and stats["blocks_reused"] == 21
+        return {"digest": rec.digest(), "log": log, "stats": stats}
+
+
+def test_prefix_soak_bit_reproducible():
+    """ISSUE 17 satellite: the seeded prefix soak — 2 hot swaps each
+    invalidate the pool (zero cross-generation reuse), every sequence
+    equals its cold-prefill reference, and two same-seed runs journal
+    bit-identically (recorder digest + the structured log)."""
+    r1 = _run_prefix_soak(seed=1709)
+    r2 = _run_prefix_soak(seed=1709)
+    assert r1["log"] == r2["log"], "soak logs diverged across reruns"
+    assert r1["digest"] == r2["digest"], "journals diverged across reruns"
+    assert r1["stats"] == r2["stats"]
+
+
+# -- edl metrics: the operator view -------------------------------------------
+
+
+def test_metrics_cli_prints_prefix_section(capsys):
+    """ISSUE 17 satellite: `edl metrics` serving section surfaces the
+    prefix-cache counters — hits, hit ratio, blocks reused,
+    evictions."""
+    from edl_tpu.cli import main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.telemetry import MetricsRegistry
+
+    coord = LocalCoordinator(target_world=1, max_world=2)
+    coord.register("serve-0")
+    reg = MetricsRegistry()
+    reg.counter("edl_serve_requests_total").inc(3, status="ok")
+    reg.counter("edl_serve_prefix_hits_total").inc(9)
+    reg.counter("edl_serve_prefix_misses_total").inc(1)
+    reg.counter("edl_serve_prefix_blocks_reused_total").inc(27)
+    reg.counter("edl_serve_prefix_evictions_total").inc(2)
+    reg.gauge("edl_serve_prefix_hit_ratio").set(0.9)
+    coord.report_telemetry("serve-0", snapshot=reg.snapshot(), seq=1)
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start(
+        evict=False
+    )
+    try:
+        assert main(["metrics", f"127.0.0.1:{server.port}"]) == 0
+        out = capsys.readouterr().out
+        assert "prefix_hits" in out and "9" in out
+        assert "prefix_hit_ratio" in out and "0.9" in out
+        assert "prefix_blocks_reused" in out and "27" in out
+        assert "prefix_evictions" in out and "2" in out
+    finally:
+        server.stop()

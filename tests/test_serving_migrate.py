@@ -789,3 +789,83 @@ def test_migration_soak_bit_reproducible():
     r2 = _run_migration_soak(seed=1609)
     assert r1["digest"] == r2["digest"], "journals diverged across reruns"
     assert r1["log"] == r2["log"], "soak evidence diverged across reruns"
+
+
+# -- prefix-cache interplay (ISSUE 17) ----------------------------------------
+
+
+def test_migrate_with_shared_prefix_copies_blocks_lands_private(mig_pair):
+    """ISSUE 17 satellite: migrating a sequence whose leading KV blocks
+    are SHARED through the prefix cache (refcount > 1) must export a
+    host COPY — the source keeps the blocks for the other claimants and
+    the cache — and the granted blocks land PRIVATE on the dest (never
+    published into ITS prefix index).
+
+    Two live claimants (B, C) share a 2-block prefix published by a
+    finished sequence A, so the shared blocks sit at refcount 2 when
+    ``migrate_out`` snapshots them.  Both migrate; the source must end
+    with the shared blocks refcount-0 AND still parked + claimable in
+    its cache, and the dest must end with nothing cached at all."""
+    model, src, dst = mig_pair
+    # Stale published marks from earlier tests' batchers would make the
+    # cached-block counts below nondeterministic — start both pools
+    # with an empty cache tier.
+    src.pool.drop_published()
+    dst.pool.drop_published()
+    with telemetry.scoped():
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b, replica_id="dst").start()
+        try:
+            shared = list(range(1, 33))  # 32 tokens = 2 full blocks
+            pa = shared + [101, 102, 103, 104]
+            pb = shared + [111, 112, 113, 114]
+            pc = shared + [121, 122, 123, 124]
+            # A publishes the shared run, finishes, parks it cached.
+            src_b.submit_generate(
+                {"tokens": pa}, max_new_tokens=2, deadline_s=60.0
+            ).result(timeout=60)
+            tb = src_b.submit_generate(
+                {"tokens": pb}, max_new_tokens=10, deadline_s=60.0
+            )
+            tc = src_b.submit_generate(
+                {"tokens": pc}, max_new_tokens=10, deadline_s=60.0
+            )
+            _wait(
+                lambda: len(tb.tokens) >= 2 and len(tc.tokens) >= 2,
+                what="both claimants decoding pre-migration",
+            )
+            assert tb.reused_blocks == 2 and tc.reused_blocks == 2
+            sblocks = list(tb.blocks[:2])
+            assert sblocks == list(tc.blocks[:2]), "claimants not sharing"
+            assert all(src.pool.refcount(b) == 2 for b in sblocks)
+            src_b.close_admission()
+            s = migrate_out(src, src_b, f"tcp://127.0.0.1:{recv.port}")
+            assert s["migrated"] == 2 and s["fallback"] == 0
+            assert s["failed"] == 0
+            w = src.current_weights()
+            toks_b, meta_b = tb.result(timeout=30)
+            toks_c, meta_c = tc.result(timeout=30)
+            assert toks_b == _reference_decode(model, w.params, pb, 10, src)
+            assert toks_c == _reference_decode(model, w.params, pc, 10, src)
+            assert meta_b.get("migrated") is True
+            assert meta_b["reused_blocks"] == 2
+            assert meta_c["reused_blocks"] == 2
+            # Source: the two detaches DECREMENTED (2 -> 1 -> 0); the
+            # published blocks parked cached, index intact, claimable.
+            assert all(src.pool.refcount(b) == 0 for b in sblocks)
+            assert src.pool.cached_blocks == 2
+            assert len(src_b.prefix) == 2
+            run, skip = src_b.prefix.claim(np.asarray(pb, dtype=np.int32))
+            assert list(run) == sblocks and skip == 32
+            src.pool.free(list(run))  # return the probe's refs
+            # Dest: the grants landed PRIVATE — nothing entered its
+            # prefix index, so every freed block went to the free list.
+            assert len(dst_b.prefix) == 0
+            assert dst.pool.cached_blocks == 0
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0

@@ -618,6 +618,9 @@ class KVBlockPool:
         # Called with a block id when a cached block is evicted, so the
         # prefix index drops its entry before the id can be re-granted.
         self.on_evict = None
+        # Called (no args) on reset(): the prefix index drops wholesale
+        # without counting the drops as capacity evictions.
+        self.on_reset = None
         self.evictions = 0
 
     @property
@@ -747,14 +750,14 @@ class KVBlockPool:
         overwritten by prefill, and decode masks never expose
         positions beyond a sequence's written length.  Any prefix
         index over this pool must be invalidated alongside (the
-        batcher's generation rekey does; ``on_evict`` fires here for
-        published blocks as a belt-and-braces hook)."""
+        batcher's generation rekey does; ``on_reset`` fires here as a
+        belt-and-braces hook — NOT ``on_evict``, so a routine re-warm
+        never inflates capacity-eviction stats)."""
         from collections import deque
 
         with self._lock:
-            if self.on_evict is not None:
-                for b in list(self._published):
-                    self.on_evict(b)
+            if self.on_reset is not None:
+                self.on_reset()
             self._ref.clear()
             self._published.clear()
             self._cached.clear()

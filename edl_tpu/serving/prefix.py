@@ -107,6 +107,7 @@ class PrefixCache:
             "evictions": 0, "invalidations": 0, "skew_rejected": 0,
         }
         pool.on_evict = self._on_evict
+        pool.on_reset = self._on_reset
 
         from edl_tpu import telemetry
 
@@ -128,6 +129,14 @@ class PrefixCache:
             self._index.pop(h, None)
         self.stats["evictions"] += 1
         self._m_evictions.inc()
+
+    def _on_reset(self) -> None:
+        """Pool reset (engine re-warm / tests): drop the whole index.
+        Unlike ``_on_evict`` this does not touch eviction stats — a
+        reset is not capacity pressure, and conflating the two would
+        skew the eviction counters the observability relies on."""
+        self._index.clear()
+        self._by_block.clear()
 
     def rekey(self, key: Tuple[int, int]) -> bool:
         """Bind the index to ``(generation, cache_epoch)``; a changed
@@ -207,6 +216,17 @@ class PrefixCache:
             except Exception:
                 # Raced an eviction between index read and claim —
                 # the entry is already being dropped; stop the run.
+                break
+            if self._by_block.get(ent.block) != h:
+                # The block was evicted AND re-granted to another
+                # sequence between the lock-free index read and the
+                # ref (one allocating lock hold can do both), so the
+                # ref landed on a now-foreign private block.
+                # ``_on_evict`` pops ``_by_block`` under the pool lock
+                # before the id can be re-granted, and a ref'd block
+                # can no longer be evicted — so this check is
+                # race-free: mismatch means foreign, drop the share.
+                self.pool.free([ent.block])
                 break
             run.append(ent.block)
             h_prev = h

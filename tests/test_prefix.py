@@ -219,6 +219,63 @@ def test_prefix_eviction_drops_index_entries():
         pool.free(grant)
 
 
+def test_prefix_claim_revalidates_block_regranted_mid_claim():
+    """TOCTOU regression: between ``claim``'s lock-free index read and
+    ``pool.ref``, a concurrent alloc (migration-receiver grant) can
+    evict the refcount-0 cached block AND re-grant it to a new
+    sequence within one lock hold — ``ref`` then lands on a foreign
+    private block.  ``claim`` must re-validate ownership via
+    ``_by_block`` after the ref and drop the share on mismatch."""
+    with telemetry.scoped():
+        pool = _pool(num_blocks=4)  # 3 usable
+        cache = PrefixCache(pool, 16)
+        prompt = np.arange(33, dtype=np.int32)
+        blocks = pool.alloc(3)
+        cache.publish(prompt, blocks)
+        pool.free(blocks)
+        assert pool.cached_blocks == 2
+        # Interpose on ref to run the racing alloc at the worst
+        # moment: after claim read the entry, before the ref lands.
+        real_ref = pool.ref
+        foreign = []
+
+        def racing_ref(b):
+            if not foreign:
+                foreign.extend(pool.alloc(2))  # evicts + re-grants both
+            real_ref(b)
+
+        pool.ref = racing_ref
+        try:
+            run, skip = cache.claim(prompt)
+        finally:
+            pool.ref = real_ref
+        assert run == [] and skip == 0, "foreign block must not be claimed"
+        # the racing sequence's grant is untouched: still sole owner
+        assert blocks[0] in foreign, "the contended block was re-granted"
+        assert all(pool.refcount(b) == 1 for b in foreign)
+        pool.free(foreign)
+
+
+def test_pool_reset_invalidates_index_without_counting_evictions():
+    """``reset()`` (engine re-warm) drops the index via the dedicated
+    ``on_reset`` hook — NOT ``on_evict`` — so eviction stats keep
+    meaning capacity pressure only."""
+    with telemetry.scoped():
+        pool = _pool(num_blocks=6)
+        cache = PrefixCache(pool, 16)
+        prompt = np.arange(50, dtype=np.int32)
+        blocks = pool.alloc(3)
+        cache.publish(prompt, blocks)
+        pool.free(blocks)
+        assert len(cache) == 3 and pool.cached_blocks == 3
+        pool.reset()
+        assert len(cache) == 0, "on_reset dropped the index"
+        assert cache.claim(prompt) == ([], 0)
+        assert cache.stats["evictions"] == 0, "a re-warm is not an eviction"
+        assert pool.evictions == 0
+        assert pool.free_blocks == pool.usable_blocks
+
+
 def test_prefix_rekey_invalidates_atomically():
     with telemetry.scoped() as (_, rec):
         pool = _pool(num_blocks=8)

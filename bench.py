@@ -413,100 +413,13 @@ def bench_fleet() -> dict:
 
 
 def bench_steady_state(steps: int = 30) -> dict:
-    """Steady-state step-pipeline A/B: the SAME elastic run with the
-    async pipeline off (depth 0: per-step host<->device sync — the
-    pre-pipeline loop) vs on (depth 2: background batch staging +
-    lag-deferred metrics harvest), per model.  Publishes median step
-    seconds for both modes, the speedup, the pipelined run's per-step
-    phase breakdown (host stage / jit dispatch / harvest device-wait),
-    and asserts the loss stream is bit-identical — the pipeline changes
-    WHEN values are read, never WHAT is computed."""
-    import jax
-    import optax
+    """Steady-state step-pipeline A/B — moved to
+    ``bench_lib.steady_state`` (the ROADMAP-item-5 per-module rule:
+    sections move as they next change; same sections, same
+    thresholds)."""
+    from bench_lib.steady_state import bench_steady_state as _bench_ss
 
-    from edl_tpu.models.base import get_model
-    from edl_tpu.runtime.coordinator import LocalCoordinator
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
-    from edl_tpu.runtime.elastic import ElasticTrainer
-
-    devices = jax.devices()
-    n_dev = len(devices)
-    on_tpu = jax.default_backend() == "tpu"
-    # mnist (BASELINE config 1/2) + two LM shapes: the models whose
-    # steady state the acceptance bar measures.  Tiny variants off-TPU
-    # keep the CPU A/B honest about overlap without hour-long runs.
-    sections = [
-        ("mnist", {}, 32 * n_dev),
-        ("transformer_base", {"tiny": not on_tpu}, (64 if on_tpu else 2) * n_dev),
-        ("moe_lm", {"tiny": not on_tpu}, (8 if on_tpu else 2) * n_dev),
-    ]
-    out = {}
-    for name, kwargs, batch in sections:
-        def one_mode(depth, name=name, kwargs=kwargs, batch=batch):
-            model = get_model(name, **kwargs)
-            data = ShardedDataIterator(
-                synthetic_dataset(model.synth_batch, max(2 * batch, 64)),
-                global_batch_size=batch,
-            )
-            coord = LocalCoordinator(target_world=n_dev, max_world=n_dev)
-            for i in range(n_dev):
-                coord.register(f"t{i}")
-            et = ElasticTrainer(
-                model,
-                optax.sgd(0.01),
-                data,
-                coord,
-                devices=devices,
-                checkpoint_interval=0,  # pure steady state, no saves
-            )
-            et.pipeline_depth = depth
-            et.run(steps)
-            et.store.wait()
-            losses = [r.loss for r in et.history]
-            warm = [r.seconds for r in et.history[3:]]  # skip compile
-            stats = dict(et.pipeline_stats)
-            stats.update(
-                (et._stager.stats if et._stager is not None else {})
-            )
-            return losses, statistics.median(warm), stats
-
-        def run_section():
-            sync_losses, sync_med, _ = one_mode(0)
-            pipe_losses, pipe_med, stats = one_mode(2)
-            # pipeline_stats accumulate over ALL iterations (warmup
-            # included), so normalize by the full step count — dividing
-            # by the median's warm subset would overstate every phase.
-            per_step = max(1, steps)
-            # THE determinism claim, ENFORCED: a regression must fail
-            # the section (surfacing in _attempt's error field), not
-            # publish losses_bit_identical=false and exit 0.
-            assert sync_losses == pipe_losses, (
-                "steady-state loss stream diverged between pipeline "
-                "off and on"
-            )
-            return {
-                "sync_median_step_s": round(sync_med, 6),
-                "pipelined_median_step_s": round(pipe_med, 6),
-                "speedup": round(sync_med / max(pipe_med, 1e-9), 3),
-                # THE determinism claim: identical float stream, not
-                # merely allclose — the pipeline must not change math.
-                "losses_bit_identical": sync_losses == pipe_losses,
-                "phases": {
-                    "stage_s": round(stats["stage_s"] / per_step, 6),
-                    "dispatch_s": round(stats["dispatch_s"] / per_step, 6),
-                    "device_wait_s": round(
-                        stats["device_wait_s"] / per_step, 6
-                    ),
-                },
-                "max_in_flight": stats["max_in_flight"],
-                "staged_hits": stats.get("hits", 0),
-                "staged_misses": stats.get("misses", 0),
-                "batch": batch,
-                "steps": steps,
-            }
-
-        out[name] = _attempt(run_section, f"steady_state:{name}", retries=0)
-    return out
+    return _bench_ss(steps=steps)
 
 
 def bench_cpu_cross_size(n_devices: int = 8) -> dict:

@@ -123,6 +123,26 @@ def deployment_shard_bytes() -> int:
     )
 
 
+def gspmd_chunk(extent: int, world: int) -> int:
+    """Rows per member under GSPMD's ceil-chunked equal split of an
+    axis — THE chunk rule.  One definition on purpose: jax's
+    ``NamedSharding.shard_shape``, ``ShardLayout.owner`` and the
+    serving engine's per-device swap-staging accounting must all agree
+    on where a tp/fsdp slice boundary falls, or the fabric's "each
+    member already holds its shards" serving preference (and the
+    engine's 1/tp swap-traffic claim) silently drifts."""
+    return -(-int(extent) // max(1, int(world)))
+
+
+def gspmd_owner(start_row: int, extent: int, world: int) -> int:
+    """The member whose ceil-chunked axis-0 slice contains
+    ``start_row`` (clamped: tail rows past the last full chunk belong
+    to the last member)."""
+    if world <= 1:
+        return 0
+    return min(int(start_row) // gspmd_chunk(extent, world), world - 1)
+
+
 def leaf_rows(leaves) -> List[int]:
     """Per-leaf axis-0 extent (0 for 0-d leaves) — the row rule shard
     boundaries align to.  ONE definition on purpose: it is
@@ -300,8 +320,7 @@ class ShardLayout:
         if self.world <= 1:
             return 0
         if s.start_row >= 0 and self.rows[s.leaf] > 0:
-            chunk = -(-self.rows[s.leaf] // self.world)  # ceil
-            return min(s.start_row // chunk, self.world - 1)
+            return gspmd_owner(s.start_row, self.rows[s.leaf], self.world)
         return (s.leaf + s.index) % self.world
 
     def holders(self, s: Shard) -> Tuple[int, ...]:

@@ -96,6 +96,25 @@ def leaf_placer(mesh: Mesh):
 
     def place(x, s):
         if not multiproc:
+            if (
+                cpu
+                and isinstance(x, np.ndarray)
+                and not s.is_fully_replicated
+            ):
+                # Sharded target (the tp serving mesh): stage each
+                # device's SLICE through jnp.array instead of the whole
+                # leaf — swap/restore staging traffic per device is the
+                # shard's bytes (1/tp for a tp-sharded kernel), and the
+                # owned-buffer discipline is the multiproc branch's
+                # (a raw numpy slice would be zero-copied by this
+                # jaxlib without keeping the temp alive — dangling
+                # buffers; see below).  Slice boundaries are jax's own
+                # ceil-chunk rule — the same one
+                # ``checkpoint.fabric.gspmd_chunk`` encodes for the
+                # shard fabric, so the two accountings agree.
+                return jax.make_array_from_callback(
+                    x.shape, s, lambda idx: jnp.array(x[idx])
+                )
             if cpu and isinstance(x, np.ndarray):
                 # CPU backend: device_put ZERO-COPIES aligned numpy — a
                 # replicated target then backs every per-device

@@ -342,6 +342,23 @@ def cmd_metrics(args) -> int:
         wstep = gauges_all.get("edl_serve_weights_step") or {}
         if wstep:
             print(f"  {'weights_step':<24} {max(wstep.values()):g}")
+        # Serving mesh shape + per-device footprint (ISSUE 18): dp×tp
+        # and the bytes ONE device actually holds — the numbers an HBM
+        # budget (and the hot-swap staging bill) are gated on.
+        mdp = gauges_all.get("edl_serve_mesh_dp") or {}
+        mtp = gauges_all.get("edl_serve_mesh_tp") or {}
+        if mdp or mtp:
+            dp_v = int(max(mdp.values())) if mdp else 1
+            tp_v = int(max(mtp.values())) if mtp else 1
+            print(f"  {'mesh':<24} dp={dp_v} tp={tp_v}")
+        for gname, tag in (
+            ("edl_serve_weight_shard_bytes_per_device", "weight_bytes/dev"),
+            ("edl_serve_kv_pool_bytes_per_device", "kv_pool_bytes/dev"),
+            ("edl_serve_kv_used_bytes_per_device", "kv_used_bytes/dev"),
+        ):
+            g = gauges_all.get(gname) or {}
+            if g:
+                print(f"  {tag:<24} {max(g.values()):g}")
         # Per-replica drain posture (ISSUE 15): which replicas are
         # serving / draining / drained, plus the drain counters — the
         # operator view of a rolling scale-down.

@@ -37,6 +37,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from edl_tpu.parallel.mesh import hint_activation
+
 #: physical block id every padding row / unallocated table slot points
 #: at.  Real sequences never own block 0.
 TRASH_BLOCK = 0
@@ -95,8 +97,16 @@ def paged_chunk_attention(q, kpool_l, vpool_l, tables, offsets):
     b, mb = tables.shape
     c = q.shape[1]
     m = mb * bt
-    k_g = kpool_l[tables].reshape(b, m, h, d)
-    v_g = vpool_l[tables].reshape(b, m, h, d)
+    # Head axis pinned over tp (ambient-mesh filtered: a no-op off tp
+    # meshes): the gather, scores and PV einsums are all head-parallel,
+    # so pinning keeps GSPMD from replicating the cache window.
+    q = hint_activation(q, None, None, "tp", None)
+    k_g = hint_activation(
+        kpool_l[tables].reshape(b, m, h, d), None, None, "tp", None
+    )
+    v_g = hint_activation(
+        vpool_l[tables].reshape(b, m, h, d), None, None, "tp", None
+    )
     scale = 1.0 / (d ** 0.5)
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk",
@@ -140,8 +150,11 @@ def paged_decode_attention(q, kpool_l, vpool_l, tables, lengths):
     v_g = vpool_l[tables]
     b, mb = tables.shape
     m = mb * bt
-    k_g = k_g.reshape(b, m, h, d)
-    v_g = v_g.reshape(b, m, h, d)
+    # Head-parallel throughout: pin the head axis over tp (no-op off
+    # tp meshes) so the gathered cache stays sharded like the pool.
+    q = hint_activation(q, None, "tp", None)
+    k_g = hint_activation(k_g.reshape(b, m, h, d), None, None, "tp", None)
+    v_g = hint_activation(v_g.reshape(b, m, h, d), None, None, "tp", None)
     scale = 1.0 / (d ** 0.5)
     scores = jnp.einsum(
         "bhd,bkhd->bhk",

@@ -127,6 +127,43 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def filter_partition_spec(spec: P, axis_names) -> P:
+    """Drop references to axes not in ``axis_names`` so ONE rule set
+    serves every mesh: a pure-DP mesh simply ignores tp/fsdp
+    placements, a dp×tp serving mesh ignores fsdp/ep, and so on.
+    Tuple entries filter member-wise (an empty survivor becomes None).
+    This is the rule the Trainer has always applied to
+    ``model.param_partition`` specs, extracted so the serving plane
+    places weights by the SAME rules training shards them with."""
+    names = set(axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def partition_shardings(mesh: Mesh, spec_tree):
+    """A pytree of PartitionSpec rules -> a congruent pytree of
+    ``NamedSharding`` on ``mesh``, with absent axes filtered per
+    ``filter_partition_spec``.  The one-call bridge from a model's
+    ``param_partition`` rules to concrete placements."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(
+            mesh, filter_partition_spec(s, mesh.axis_names)
+        ),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def hint_activation(x, *entries):
     """Pin an activation's layout on the AMBIENT mesh (a no-op when
     there is none, or when none of the named axes exist on it).

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu import telemetry
 from edl_tpu.models.base import ModelDef
-from edl_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP
+from edl_tpu.parallel.mesh import AXIS_DP, AXIS_FSDP, filter_partition_spec
 
 
 @struct.dataclass
@@ -71,22 +71,13 @@ class Trainer:
         self._param_spec_fn = model.param_partition
         self._state_shardings = None  # cached after init_state()
 
-        axis_names = set(mesh.axis_names)
+        axis_names = mesh.axis_names
 
         def filter_spec(spec: P) -> P:
-            """Drop references to axes this mesh doesn't have, so one
-            rule set serves every mesh (a pure-DP mesh simply ignores
-            tp/fsdp placements)."""
-
-            def keep(entry):
-                if entry is None:
-                    return None
-                if isinstance(entry, (tuple, list)):
-                    kept = tuple(a for a in entry if a in axis_names)
-                    return kept if kept else None
-                return entry if entry in axis_names else None
-
-            return P(*(keep(e) for e in spec))
+            # Shared with the serving plane (parallel.mesh): one rule
+            # set serves every mesh — a pure-DP mesh simply ignores
+            # tp/fsdp placements, the dp×tp serving mesh ignores fsdp.
+            return filter_partition_spec(spec, axis_names)
 
         def constrain(params):
             """Pin params to the model's partition rules on this mesh;

@@ -588,6 +588,11 @@ class TokenContinuousBatcher:
         self._g_depth = reg.gauge("edl_serve_decode_queue_depth")
         self._g_active = reg.gauge("edl_serve_active_sequences")
         self._g_kv = reg.gauge("edl_serve_kv_occupancy")
+        # tp-aware block accounting: block COUNTS are tp-invariant
+        # (tables/free list are host-side), but the bytes one device
+        # carries for them shrink 1/tp with the pool's head sharding —
+        # per-device bytes are what an HBM budget actually gates.
+        self._g_kv_bytes = reg.gauge("edl_serve_kv_used_bytes_per_device")
         self._m_ttft = reg.histogram("edl_serve_ttft_seconds")
         self._m_intertoken = reg.histogram("edl_serve_intertoken_seconds")
         self._m_occupancy = reg.histogram("edl_serve_batch_occupancy")
@@ -1396,6 +1401,13 @@ class TokenContinuousBatcher:
             progress += self._decode_iteration(w)
             self._g_active.set(len(self._active))
             self._g_kv.set(self.engine.pool.occupancy())
+            self._g_kv_bytes.set(
+                self.engine.pool.used_blocks
+                * (
+                    self.engine.kv_pool_bytes_per_device()
+                    // self.engine.pool.num_blocks
+                )
+            )
             self._g_prefill_queued.set(self.queued_prefill_tokens)
             if not progress and (
                 self._active or self._queue or self._prefilling

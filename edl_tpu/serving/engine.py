@@ -38,7 +38,7 @@ from edl_tpu.checkpoint import HostDRAMStore
 from edl_tpu.checkpoint.hostdram import HostCheckpoint, leaf_placer
 from edl_tpu.consensus.watchdog import CollectiveTimeout, CollectiveWatchdog
 from edl_tpu.models.base import ModelDef
-from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh, partition_shardings
 
 
 class NotReadyError(RuntimeError):
@@ -104,6 +104,7 @@ class InferenceEngine:
         seed: int = 0,
         optimizer=None,
         chaos=None,
+        tp: int = 1,
     ):
         if model.predict_fn is None:
             raise ValueError(
@@ -123,12 +124,31 @@ class InferenceEngine:
             self.store, "chaos", None
         )
         devs = list(devices) if devices is not None else jax.devices()
-        self.mesh: Mesh = build_mesh(MeshSpec.create(dp=len(devs)), devs)
-        dp = len(devs)
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if len(devs) % tp != 0:
+            raise ValueError(
+                f"tp {tp} does not divide the {len(devs)}-device replica "
+                "(the serving mesh is dp x tp)"
+            )
+        #: serving mesh extents.  ``tp`` shards attention heads / FFN
+        #: hidden dims (and the KV pools' head axis) via the SAME
+        #: partition rules training uses; ``dp`` replicates weights and
+        #: shards the single-shot /predict batch.  tp=1 keeps the axis
+        #: (MeshSpec keeps size-1 axes so PartitionSpecs stay valid at
+        #: every scale) — a tp=1 engine is bit-for-bit the old
+        #: replicated one.
+        self.tp = tp
+        self.dp = len(devs) // tp
+        self.mesh: Mesh = build_mesh(
+            MeshSpec.create(dp=self.dp, tp=tp), devs
+        )
+        dp = self.dp
         if max_batch < dp:
             raise ValueError(
-                f"max_batch {max_batch} < {dp} devices (the smallest "
-                "bucket must shard over the replica's dp extent)"
+                f"max_batch {max_batch} < the replica's dp extent {dp} "
+                "(the smallest bucket must shard over it)"
             )
         #: padded batch buckets: dp, 2*dp, 4*dp ... plus max_batch
         #: itself as the final bucket — power-of-2 growth keeps the
@@ -143,8 +163,8 @@ class InferenceEngine:
 
             print(
                 f"[edl-serve] max_batch {max_batch} rounded down to "
-                f"{eff} (must be a multiple of the replica's {dp} "
-                "devices)",
+                f"{eff} (must be a multiple of the replica's dp "
+                f"extent {dp})",
                 file=sys.stderr,
             )
         buckets: List[int] = []
@@ -196,6 +216,22 @@ class InferenceEngine:
         self._abstract_params = jax.eval_shape(
             model.init_params, jax.random.key(seed)
         )
+        #: per-leaf weight placement on the serving mesh: the model's
+        #: OWN partition rules (the ones training shards with),
+        #: filtered to the axes this mesh has — so qkv/out kernels and
+        #: MoE expert FFNs shard over tp while fsdp/ep entries drop out
+        #: (weights replicate over dp; "dp" never names a weight dim).
+        #: Models without rules replicate every leaf — the pre-tp
+        #: behaviour.
+        if model.param_partition is not None:
+            self._param_shardings = partition_shardings(
+                self.mesh, model.param_partition(self._abstract_params)
+            )
+        else:
+            replicated = NamedSharding(self.mesh, P())
+            self._param_shardings = jax.tree_util.tree_map(
+                lambda _: replicated, self._abstract_params
+            )
 
         from edl_tpu import telemetry
 
@@ -209,6 +245,41 @@ class InferenceEngine:
         self._m_compile_seconds = self.telemetry.histogram(
             "edl_compile_seconds"
         )
+        # Mesh-shape + per-device footprint gauges: the fleet view must
+        # be able to tell a replicated engine from a sharded one.
+        self.telemetry.gauge("edl_serve_mesh_dp").set(self.dp)
+        self.telemetry.gauge("edl_serve_mesh_tp").set(self.tp)
+        self._m_weight_shard_bytes = self.telemetry.gauge(
+            "edl_serve_weight_shard_bytes_per_device"
+        )
+        self._m_weight_shard_bytes.set(self.weight_shard_bytes_per_device())
+
+    # -- per-device footprint ------------------------------------------------
+    def weight_full_bytes(self) -> int:
+        """Unsharded weight footprint (what a tp=1 device holds)."""
+        return sum(
+            int(np.prod(l.shape, dtype=np.int64))
+            * np.dtype(l.dtype).itemsize
+            for l in jax.tree_util.tree_leaves(self._abstract_params)
+        )
+
+    def weight_shard_bytes_per_device(self) -> int:
+        """Weight bytes ONE device holds under the partition rules —
+        ``shard_shape`` applies jax's ceil-chunk split (the
+        ``checkpoint.fabric.gspmd_chunk`` rule), so a tp-sharded kernel
+        counts at 1/tp.  This is also the hot-swap staging traffic per
+        device: ``leaf_placer`` stages exactly each device's slice."""
+        total = 0
+        for l, s in zip(
+            jax.tree_util.tree_leaves(self._abstract_params),
+            jax.tree_util.tree_leaves(self._param_shardings),
+        ):
+            shp = s.shard_shape(tuple(l.shape))
+            total += (
+                int(np.prod(shp, dtype=np.int64))
+                * np.dtype(l.dtype).itemsize
+            )
+        return total
 
     # -- weights ------------------------------------------------------------
     @property
@@ -257,16 +328,17 @@ class InferenceEngine:
         return jax.eval_shape(init_fn, jax.random.key(self.seed))
 
     def _install(self, ckpt: HostCheckpoint) -> None:
-        """Place ``ckpt``'s params on the serving mesh (replicated) and
-        publish them as the next weight generation.  ONLY the params
-        leave the host — serving never pays the optimizer state's
-        placement or memory."""
+        """Place ``ckpt``'s params on the serving mesh via the model's
+        partition rules and publish them as the next weight
+        generation.  ONLY the params leave the host — serving never
+        pays the optimizer state's placement or memory — and on a tp
+        mesh each device stages only ITS weight shard (``leaf_placer``
+        slices per device), so swap traffic is 1/tp per device."""
         state_host = ckpt.unflatten()
         params_host = getattr(state_host, "params", state_host)
         place = leaf_placer(self.mesh)
-        sharding = NamedSharding(self.mesh, P())
         params = jax.tree_util.tree_map(
-            lambda x: place(x, sharding), params_host
+            place, params_host, self._param_shardings
         )
         with self._swap_lock:
             gen = (self._weights.generation + 1) if self._weights else 1
@@ -410,15 +482,28 @@ class InferenceEngine:
         # warm them here so even the FIRST swap stages zero compiles.
         from edl_tpu.checkpoint.hostdram import warm_leaf_conversions
 
-        warm_leaf_conversions(
-            jax.tree_util.tree_leaves(self._abstract_params)
-        )
-        replicated = NamedSharding(self.mesh, P())
+        # Replicated leaves stage whole; tp-sharded leaves stage each
+        # device's SLICE (leaf_placer's sharded branch) — warm the
+        # staging conversion at the shape it will actually run.
+        staging = [
+            jax.ShapeDtypeStruct(
+                l.shape
+                if s.is_fully_replicated
+                else s.shard_shape(tuple(l.shape)),
+                l.dtype,
+            )
+            for l, s in zip(
+                jax.tree_util.tree_leaves(self._abstract_params),
+                jax.tree_util.tree_leaves(self._param_shardings),
+            )
+        ]
+        warm_leaf_conversions(staging)
         abs_params = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(
-                a.shape, a.dtype, sharding=replicated
+            lambda a, s: jax.ShapeDtypeStruct(
+                a.shape, a.dtype, sharding=s
             ),
             self._abstract_params,
+            self._param_shardings,
         )
         warmed = 0
         for b in buckets if buckets is not None else self.buckets:
@@ -828,6 +913,7 @@ class DecodeEngine(InferenceEngine):
         num_blocks: Optional[int] = None,
         max_chunk_tokens: Optional[int] = None,
         dispatch_timeout: Optional[float] = None,
+        tp: int = 1,
     ):
         if model.decode is None:
             raise ValueError(
@@ -835,21 +921,37 @@ class DecodeEngine(InferenceEngine):
                 "only serve single-shot forwards (InferenceEngine)"
             )
         devs = list(devices) if devices is not None else jax.devices()
-        if max_batch < len(devs):
+        tp = int(tp)
+        if tp >= 1 and model.decode.heads % tp != 0:
+            # Checked BEFORE the base engine builds weight shardings: a
+            # non-dividing tp would otherwise surface as an opaque
+            # GSPMD shard-shape error from the byte-accounting gauges.
+            raise ValueError(
+                f"tp {tp} does not divide the model's "
+                f"{model.decode.heads} KV heads (attention kernels and "
+                "the pool shard their head axis across tp)"
+            )
+        dp_extent = len(devs) // tp if tp >= 1 and len(devs) % tp == 0 else 1
+        if max_batch < dp_extent:
             # The single-shot /predict buckets must shard over the dp
             # extent, but a decode-focused fleet sizes max_batch for
-            # generate traffic (decode tensors are replicated, any
-            # count works) — lift the single-shot cap instead of
-            # refusing to boot.
+            # generate traffic (decode tensors are replicated over dp,
+            # any count works) — lift the single-shot cap instead of
+            # refusing to boot.  The lift target is the DP extent
+            # (devices / tp), NOT the device count: on a dp×tp mesh the
+            # tp devices hold shards of ONE replica, and lifting to
+            # len(devs) would over-size every /predict bucket (and its
+            # held executable) tp-fold.
             import sys
 
             print(
                 f"[edl-serve] max_batch {max_batch} raised to the "
-                f"{len(devs)}-device dp extent (single-shot bucket "
-                "floor; decode batching is unaffected)",
+                f"dp extent {dp_extent} ({len(devs)} devices / tp {tp}; "
+                "single-shot bucket floor — decode batching is "
+                "unaffected)",
                 file=sys.stderr,
             )
-            max_batch = len(devs)
+            max_batch = dp_extent
         super().__init__(
             model,
             store,
@@ -858,6 +960,7 @@ class DecodeEngine(InferenceEngine):
             seed=seed,
             optimizer=optimizer,
             chaos=chaos,
+            tp=tp,
         )
         spec = model.decode
         self.spec = spec
@@ -873,6 +976,13 @@ class DecodeEngine(InferenceEngine):
             # Enough for every slot's full context + the trash block.
             num_blocks = self.max_seqs * self.blocks_per_seq + 1
         self._replicated = NamedSharding(self.mesh, P())
+        #: KV pools shard their HEAD axis over tp — each device holds
+        #: [L, blocks, block_tokens, H/tp, D] — while block tables, the
+        #: free list, refcounts and the prefix index stay host-side and
+        #: tp-invariant (they speak block ids, never head slices).
+        self._kv_sharding = NamedSharding(
+            self.mesh, P(None, None, None, "tp", None)
+        )
         self.pool = KVBlockPool(
             spec.layers,
             spec.heads,
@@ -880,8 +990,12 @@ class DecodeEngine(InferenceEngine):
             num_blocks,
             self.block_tokens,
             spec.cache_dtype,
-            self._replicated,
+            self._kv_sharding,
         )
+        self._m_kv_shard_bytes = self.telemetry.gauge(
+            "edl_serve_kv_pool_bytes_per_device"
+        )
+        self._m_kv_shard_bytes.set(self.kv_pool_bytes_per_device())
         #: decode-batch buckets (active sequence counts)
         buckets = []
         b = 1
@@ -986,6 +1100,17 @@ class DecodeEngine(InferenceEngine):
             on_trip=_wedge_trip,
         )
 
+    # -- per-device footprint ------------------------------------------------
+    def kv_pool_bytes_per_device(self) -> int:
+        """Bytes ONE device holds for BOTH pool planes (k + v): the
+        head axis shards over tp, so a tp=2 engine's per-device pool is
+        half a tp=1 engine's."""
+        shard = self._kv_sharding.shard_shape(self.pool.kpool.shape)
+        per_plane = int(np.prod(shard, dtype=np.int64)) * np.dtype(
+            self.pool.kpool.dtype
+        ).itemsize
+        return 2 * per_plane
+
     # -- buckets ------------------------------------------------------------
     @property
     def max_prompt(self) -> int:
@@ -1072,12 +1197,18 @@ class DecodeEngine(InferenceEngine):
         kind = key[0]
         spec = self.spec
         rep = self._replicated
+        # Pools carry the tp head-sharding; params their partition-rule
+        # shardings; host-fed inputs (tokens/lengths/tables/offsets)
+        # stay replicated — block tables are tp-invariant.
         pool = jax.ShapeDtypeStruct(
-            self.pool.kpool.shape, self.pool.kpool.dtype, sharding=rep
+            self.pool.kpool.shape,
+            self.pool.kpool.dtype,
+            sharding=self._kv_sharding,
         )
         abs_params = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep),
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
             getattr(self._abstract_params, "params", self._abstract_params),
+            self._param_shardings,
         )
         if kind in ("prefill", "chunk"):
             tokens = jax.ShapeDtypeStruct(
@@ -1331,16 +1462,19 @@ class DecodeEngine(InferenceEngine):
         """Scatter migrated host K/V planes into freshly granted pool
         slots (the dest half of a live migration).  Rebinds the pool
         arrays like ``_run`` does after a donated dispatch, keeping the
-        replicated sharding the held executables were lowered for."""
+        head-sharded layout the held executables were lowered for.
+        The WIRE format stays tp-invariant full-head blocks
+        (``export_kv`` gathers shards to host), so a sequence can
+        migrate between replicas of different tp."""
         import jax.numpy as jnp
 
         pool = self.pool
         ids = jnp.asarray(list(block_ids), jnp.int32)
         pool.kpool = jax.device_put(
             pool.kpool.at[:, ids].set(jnp.asarray(k, pool.kpool.dtype)),
-            self._replicated,
+            self._kv_sharding,
         )
         pool.vpool = jax.device_put(
             pool.vpool.at[:, ids].set(jnp.asarray(v, pool.vpool.dtype)),
-            self._replicated,
+            self._kv_sharding,
         )

@@ -121,6 +121,15 @@ class ServingServer:
                         or (gen0 is not None and gen0.draining),
                         "in_flight": self.server_batcher.in_flight
                         + (gen0.in_flight if gen0 is not None else 0),
+                        # serving mesh shape + per-device weight
+                        # footprint (ISSUE 18): a poller (or the
+                        # autoscaler's serving lane) can tell a
+                        # replicated engine from a tp-sharded one and
+                        # size HBM budgets off per-device bytes.
+                        "mesh": {"dp": engine.dp, "tp": engine.tp},
+                        "weight_shard_bytes_per_device": (
+                            engine.weight_shard_bytes_per_device()
+                        ),
                     }
                     gen = self.server_gen_batcher
                     if gen is not None:
@@ -132,6 +141,9 @@ class ServingServer:
                             "decode_queue_depth": gen.depth,
                             "kv_occupancy": round(
                                 engine.pool.occupancy(), 4
+                            ),
+                            "kv_pool_bytes_per_device": (
+                                engine.kv_pool_bytes_per_device()
                             ),
                             # chunked-prefill posture (ISSUE 14): how
                             # admission shares iterations with decode

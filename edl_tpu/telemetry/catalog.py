@@ -408,6 +408,41 @@ CATALOG = {
         "help": "Training step of the checkpoint currently serving.",
         "labels": (),
     },
+    # -- serving mesh shape + per-device footprint (tensor-parallel
+    # decode): the fleet view must distinguish a replicated engine from
+    # a sharded one, and per-device bytes are what an HBM budget gates.
+    "edl_serve_mesh_dp": {
+        "type": "gauge",
+        "help": "Serving mesh dp extent (weight replicas; single-shot "
+        "batches shard over it).",
+        "labels": (),
+    },
+    "edl_serve_mesh_tp": {
+        "type": "gauge",
+        "help": "Serving mesh tp extent (attention heads / FFN hidden "
+        "dims and the KV pools' head axis shard over it).",
+        "labels": (),
+    },
+    "edl_serve_weight_shard_bytes_per_device": {
+        "type": "gauge",
+        "help": "Weight bytes ONE device holds under the model's "
+        "partition rules (tp-sharded kernels count at 1/tp) — also the "
+        "hot-swap staging traffic per device.",
+        "labels": (),
+    },
+    "edl_serve_kv_pool_bytes_per_device": {
+        "type": "gauge",
+        "help": "KV pool bytes ONE device holds (both planes; the head "
+        "axis shards over tp).",
+        "labels": (),
+    },
+    "edl_serve_kv_used_bytes_per_device": {
+        "type": "gauge",
+        "help": "KV bytes ONE device holds for blocks owned by live "
+        "sequences: the host-side block count times the per-device "
+        "per-block footprint (1/tp of the full-head block).",
+        "labels": (),
+    },
     # -- serving-plane fault tolerance (graceful drain + watchdog) -----------
     "edl_serve_draining": {
         "type": "gauge",

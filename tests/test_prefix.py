@@ -20,7 +20,6 @@ Key guarantees under test:
   reruns.
 """
 
-import threading
 import time
 
 import jax

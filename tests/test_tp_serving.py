@@ -1,0 +1,335 @@
+"""Tensor-parallel decode serving (ISSUE 18).
+
+The serving plane shards over a ``tp`` axis of the serving mesh with
+the SAME GSPMD rules training uses for the qkv/out kernels: attention
+heads (and the MoE FFN hidden dims) split across tp, the KV pools'
+head axis splits with them, and everything host-side — block tables,
+free list, refcounts, prefix hashing, the migration wire format —
+stays tp-invariant.  These tests pin the acceptance criteria:
+
+- tp=2 decodes BIT-IDENTICALLY to tp=1 per LM family, with zero
+  steady-state compiles at the backend_compile seam;
+- per-device KV/weight bytes shrink with tp (the capacity claim);
+- max_batch lifts to the DP EXTENT (devices / tp), not the device
+  count (the satellite-1 regression);
+- the prefix cache and live migration keep working on tp>=2 — shared
+  (refcount > 1) prefix blocks export as host copies and land private
+  on the dest, and a sequence migrates BETWEEN tp shapes because the
+  exported blocks carry full heads.
+"""
+
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.serving import (
+    ContinuousBatcher,
+    DecodeEngine,
+    MigrationReceiver,
+    ServingServer,
+    TokenContinuousBatcher,
+    migrate_out,
+)
+from tests.test_decode_serving import _lm_state, _reference_decode
+from tests.test_serving_migrate import _wait
+
+
+def _build_engine(name="transformer_lm", tp=1, ndev=None, step=1, seed=1,
+                  **kw):
+    model = get_model(name, tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, step, seed), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model,
+        store,
+        devices=jax.devices()[: (ndev if ndev is not None else tp)],
+        max_batch=1,
+        max_seqs=4,
+        block_tokens=16,
+        tp=tp,
+        **kw,
+    )
+    assert engine.load()
+    engine.warm()
+    return model, store, engine
+
+
+def _greedy(engine, prompt, n, count_compiles=False):
+    """Prefill + n-1 decode steps on one sequence, straight through the
+    engine (no batcher): returns (tokens, steady_state_compiles)."""
+    import jax._src.compiler as _compiler
+
+    w = engine.current_weights()
+    tab = np.asarray(engine.pool.alloc(engine.blocks_per_seq), np.int32)
+    try:
+        out = [int(engine.prefill(w, prompt, tab))]
+        ln = np.asarray([len(prompt)], np.int32)
+        real = _compiler.backend_compile
+        count = {"n": 0}
+
+        def counting(*a, **k):
+            count["n"] += 1
+            return real(*a, **k)
+
+        _compiler.backend_compile = counting
+        try:
+            while len(out) < n:
+                ids = engine.decode_step(
+                    w, np.asarray([out[-1]], np.int32), ln, tab[None]
+                )
+                out.append(int(ids[0]))
+                ln = ln + 1
+        finally:
+            _compiler.backend_compile = real
+    finally:
+        engine.pool.free([b for b in tab.tolist() if b != 0])
+    return out, count["n"]
+
+
+# -- the acceptance criterion: bit-identity + 0 compiles, per family ----------
+
+
+@pytest.mark.parametrize(
+    "name", ["transformer_lm", "moe_lm", "longcontext_lm"]
+)
+def test_decode_bit_identical_across_tp_per_family(name):
+    """tp=2 must produce the SAME greedy tokens as tp=1 from the same
+    spilled state, and the steady decode loop must perform zero XLA
+    compiles on both shapes."""
+    prompt = np.arange(3, 3 + 9, dtype=np.int32)
+    _, _, e1 = _build_engine(name, tp=1)
+    t1, c1 = _greedy(e1, prompt, 12)
+    _, _, e2 = _build_engine(name, tp=2)
+    t2, c2 = _greedy(e2, prompt, 12)
+    assert t1 == t2, f"{name}: tp=2 tokens diverged from tp=1"
+    assert c1 == 0 and c2 == 0, (name, c1, c2)
+    assert e1.pool.used_blocks == 0 and e2.pool.used_blocks == 0
+
+
+def test_per_device_bytes_shrink_with_tp():
+    """The capacity claim, as byte math: the KV pool's per-device bytes
+    HALVE at tp=2 (the head axis shards exactly), and the weight shard
+    lands between 1/2 and 0.6x the full state (tp-sharded kernels at
+    1/2, layernorm/bias/position leaves replicated)."""
+    _, _, e1 = _build_engine(tp=1)
+    _, _, e2 = _build_engine(tp=2)
+    assert e2.kv_pool_bytes_per_device() * 2 == e1.kv_pool_bytes_per_device()
+    full = e2.weight_full_bytes()
+    shard = e2.weight_shard_bytes_per_device()
+    assert full == e1.weight_shard_bytes_per_device()
+    assert 0.5 * full <= shard <= 0.6 * full, (shard, full)
+
+
+def test_mesh_shape_and_heads_divisibility():
+    """tp must divide the device count, and the model's head count must
+    divide tp (a head never splits)."""
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    with pytest.raises(ValueError, match="tp"):
+        DecodeEngine(
+            model, store, devices=jax.devices()[:3], max_batch=1,
+            max_seqs=4, block_tokens=16, tp=2,
+        )
+    with pytest.raises(ValueError, match="heads"):
+        # tiny transformer_lm has 4 heads; 4 % 3 != 0
+        DecodeEngine(
+            model, store, devices=jax.devices()[:3], max_batch=1,
+            max_seqs=4, block_tokens=16, tp=3,
+        )
+
+
+def test_max_batch_lifts_to_dp_extent_not_device_count():
+    """Satellite-1 regression: the single-shot max-batch floor is the
+    DP EXTENT (devices / tp — each replica row spans tp devices), not
+    ``len(devices)``.  Pre-fix, a 4-device tp=2 engine lifted
+    max_batch to 4 and single-shot batches sharded 4-wide over a
+    2-replica mesh."""
+    model = get_model("transformer_lm", tiny=True)
+    store = HostDRAMStore()
+    store.save_async(_lm_state(model, 1, 1), generation=0)
+    store.wait()
+    engine = DecodeEngine(
+        model, store, devices=jax.devices()[:4], max_batch=1,
+        max_seqs=4, block_tokens=16, tp=2,
+    )
+    assert engine.dp == 2 and engine.tp == 2
+    assert engine.max_batch == 2, "floor must be dp extent, not n_devices"
+    # and at tp=1 the old behavior holds: floor == device count
+    engine1 = DecodeEngine(
+        model, store, devices=jax.devices()[:4], max_batch=1,
+        max_seqs=4, block_tokens=16,
+    )
+    assert engine1.max_batch == 4
+
+
+# -- prefix cache on tp>=2 ----------------------------------------------------
+
+
+def test_prefix_warm_admission_bit_identical_on_tp2():
+    """ISSUE 18 satellite: the prefix cache's host-side hashing and
+    refcounts never see the tp split — a warm (reused-block) admission
+    on a tp=2 engine decodes bit-identically to its own cold prefill
+    AND to the single-device reference."""
+    model, _, engine = _build_engine(tp=2, max_chunk_tokens=16)
+    with telemetry.scoped():
+        batcher = TokenContinuousBatcher(engine, refresh=False).start()
+        try:
+            rng = np.random.RandomState(1)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :40]
+            gen = lambda: batcher.submit_generate(
+                {"tokens": list(prompt)}, max_new_tokens=4, deadline_s=60.0
+            ).result(timeout=60)
+            cold_t, cold_m = gen()
+            warm_t, warm_m = gen()
+            assert cold_m["reused_blocks"] == 0
+            assert warm_m["reused_blocks"] == 2, "(40-1)//16 blocks claimed"
+            assert warm_t == cold_t
+            w = engine.current_weights()
+            ref = _reference_decode(model, w.params, list(prompt), 4, engine)
+            assert warm_t == ref, "tp=2 reused-block decode impure"
+        finally:
+            batcher.stop()
+    assert engine.pool.used_blocks == 0
+
+
+# -- live migration on tp>=2 --------------------------------------------------
+
+
+def test_migration_between_tp_shapes_bit_identical():
+    """The KV wire format is tp-INVARIANT (export gathers every shard
+    to full-head host blocks): a sequence decoding on a tp=1 source
+    migrates mid-generation to a tp=2 survivor and finishes
+    bit-identically to the unmigrated reference."""
+    model, _, src = _build_engine(tp=1)
+    _, _, dst = _build_engine(tp=2)
+    with telemetry.scoped():
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b, replica_id="dst").start()
+        try:
+            prompt, n = list(range(1, 9)), 24
+            t = src_b.submit_generate(
+                {"tokens": prompt}, max_new_tokens=n, deadline_s=60.0
+            )
+            _wait(lambda: len(t.tokens) >= 5, what="5 tokens pre-migration")
+            src_b.close_admission()
+            s = migrate_out(
+                src, src_b, f"tcp://127.0.0.1:{recv.port}", replica_id="src"
+            )
+            assert s["migrated"] == 1 and s["failed"] == 0
+            tokens, meta = t.result(timeout=30)
+            ref = _reference_decode(
+                model, src.current_weights().params, prompt, n, src
+            )
+            assert tokens == ref, "tokens diverged across the tp hop"
+            assert meta.get("migrated") is True
+            assert dst_b.stats["prefills"] == 0, "survivor re-prefilled"
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+def test_migration_tp2_shared_prefix_copies_land_private():
+    """Shared (refcount > 1) prefix blocks on a tp=2 source export as
+    host COPIES — the source keeps them parked + claimable — and the
+    granted blocks land PRIVATE on the tp=2 dest (nothing published
+    into its index)."""
+    model, _, src = _build_engine(tp=2)
+    _, _, dst = _build_engine(tp=2)
+    src.pool.drop_published()
+    dst.pool.drop_published()
+    with telemetry.scoped():
+        src_b = TokenContinuousBatcher(src, refresh=False).start()
+        dst_b = TokenContinuousBatcher(dst, refresh=False).start()
+        recv = MigrationReceiver(dst, dst_b, replica_id="dst").start()
+        try:
+            shared = list(range(1, 33))  # 32 tokens = 2 full blocks
+            pa = shared + [101, 102, 103, 104]
+            pb = shared + [111, 112, 113, 114]
+            pc = shared + [121, 122, 123, 124]
+            src_b.submit_generate(
+                {"tokens": pa}, max_new_tokens=2, deadline_s=60.0
+            ).result(timeout=60)
+            tb = src_b.submit_generate(
+                {"tokens": pb}, max_new_tokens=10, deadline_s=60.0
+            )
+            tc = src_b.submit_generate(
+                {"tokens": pc}, max_new_tokens=10, deadline_s=60.0
+            )
+            _wait(
+                lambda: len(tb.tokens) >= 2 and len(tc.tokens) >= 2,
+                what="both claimants decoding pre-migration",
+            )
+            assert tb.reused_blocks == 2 and tc.reused_blocks == 2
+            sblocks = list(tb.blocks[:2])
+            assert all(src.pool.refcount(b) == 2 for b in sblocks)
+            src_b.close_admission()
+            s = migrate_out(src, src_b, f"tcp://127.0.0.1:{recv.port}")
+            assert s["migrated"] == 2 and s["failed"] == 0
+            w = src.current_weights()
+            toks_b, meta_b = tb.result(timeout=30)
+            toks_c, meta_c = tc.result(timeout=30)
+            assert toks_b == _reference_decode(model, w.params, pb, 10, src)
+            assert toks_c == _reference_decode(model, w.params, pc, 10, src)
+            assert meta_b["reused_blocks"] == 2
+            assert meta_c["reused_blocks"] == 2
+            # source keeps the shared run cached + claimable
+            assert all(src.pool.refcount(b) == 0 for b in sblocks)
+            assert src.pool.cached_blocks == 2
+            run, skip = src_b.prefix.claim(np.asarray(pb, dtype=np.int32))
+            assert list(run) == sblocks and skip == 32
+            src.pool.free(list(run))
+            # dest grants landed private
+            assert len(dst_b.prefix) == 0
+            assert dst.pool.cached_blocks == 0
+        finally:
+            src_b.stop()
+            dst_b.stop()
+            recv.stop()
+        assert src.pool.used_blocks == 0
+        assert dst.pool.used_blocks == 0
+
+
+# -- the observability surface ------------------------------------------------
+
+
+def test_healthz_reports_mesh_and_per_device_bytes():
+    """/healthz carries the serving mesh shape and the per-device
+    weight/KV byte footprints (satellite 4)."""
+    _, _, engine = _build_engine(tp=2)
+    batcher = ContinuousBatcher(engine).start()
+    gen_batcher = TokenContinuousBatcher(engine, refresh=False).start()
+    server = ServingServer(
+        batcher, host="127.0.0.1", gen_batcher=gen_batcher
+    ).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz", timeout=5
+        ) as h:
+            health = json.loads(h.read())
+        assert health["mesh"] == {"dp": 1, "tp": 2}
+        assert (
+            health["weight_shard_bytes_per_device"]
+            == engine.weight_shard_bytes_per_device()
+        )
+        assert (
+            health["decode"]["kv_pool_bytes_per_device"]
+            == engine.kv_pool_bytes_per_device()
+        )
+        assert health["decode"]["kv_pool_bytes_per_device"] > 0
+    finally:
+        server.stop()
+        gen_batcher.stop()
+        batcher.stop()

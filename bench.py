@@ -11,614 +11,93 @@ the target is the <60s re-converge budget from BASELINE.json.
 Runs on whatever accelerator jax finds (the driver provides one real
 TPU chip); world sizes cycle over the available devices the same way
 the elastic runtime does in production.
+
+Every section lives in its own ``bench_lib/`` module (ROADMAP item
+5's per-module split, completed this round with resize, scale_down
+and the LM family): this file only composes sections into the one
+record.  Heavy sections spawn their own hermetic children via
+``python -m bench_lib.<module>``, so the driver never initializes a
+TPU client before the chip-exclusive LM children run.
 """
 
 from __future__ import annotations
 
 import json
-import statistics
 import sys
 
-
-RESIZE_BUDGET_S = 60.0
+from bench_lib.resize import RESIZE_BUDGET_S
 
 
 def bench_resize(model_name: str = "mnist", steps_per_phase: int = 10) -> dict:
-    import jax
-    import optax
+    from bench_lib.resize import bench_resize as _bench_resize
 
-    from edl_tpu.models.base import get_model
-    from edl_tpu.runtime.coordinator import LocalCoordinator
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
-    from edl_tpu.runtime.elastic import ElasticTrainer
-
-    devices = jax.devices()
-    n_dev = len(devices)
-    sizes = sorted({1, max(1, n_dev // 2), n_dev})
-
-    model = get_model(model_name)
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, 4096),
-        global_batch_size=max(64, 8 * n_dev),
-    )
-    coord = LocalCoordinator(target_world=1, max_world=n_dev)
-    for i in range(n_dev):
-        coord.register(f"t{i}")
-    et = ElasticTrainer(
-        model,
-        optax.sgd(0.05),
-        data,
-        coord,
-        devices=devices,
-        # Coprime with steps_per_phase: resizes then land BETWEEN
-        # interval saves, so the measured flush is the real split flush
-        # (ordered d2h + overlapped hash/spill, with flush_bg phases
-        # published) — a divisible interval would dedupe every resize
-        # flush against the just-landed interval save and hide it.
-        checkpoint_interval=7,
-    )
-    # Warm the compiled-step executables for every size (abstract AOT —
-    # zero device allocation) so the measured window is the true warm
-    # resize path, not first-compile; production gets the same warmth
-    # from the autoscaler prewarm hint + persistent compile cache.
-    et.precompile(sizes)
-    # The warm run must cross ONE interval save: the save path's d2h
-    # snapshot-copy jits compile on their first dispatch, and without a
-    # pre-cycle save the first resize's flush would pay them inside the
-    # measured window (they are steady-state cost, not resize cost).
-    target = max(steps_per_phase, et.checkpoint_interval + 1)
-    et.run(target)
-
-    # Count TRUE XLA compiles per resize window at the backend_compile
-    # seam (persistent-cache hits bypass it): the acceptance bar is
-    # ZERO inside a warm resize, and a nonzero count here names the
-    # exact cycle that regressed.  The count lives in the SHARED
-    # telemetry registry (edl_xla_compiles_total) — bench reads the
-    # same exposition surface production scrapes, instead of the
-    # private list it used to keep.
-    import jax._src.compiler as _compiler
-
-    from edl_tpu import telemetry
-
-    m_compiles = telemetry.get_registry().counter("edl_xla_compiles_total")
-    _real_bc = _compiler.backend_compile
-
-    def _counting_bc(*args, **kwargs):
-        m_compiles.inc()
-        return _real_bc(*args, **kwargs)
-
-    resize_windows = []
-    step_times = []
-    resize_events = []
-    # Per-phase samples (flush / remesh / restore / first_step) so a
-    # headline regression is attributable to ONE phase (the r4->r5
-    # resize_max 0.33->0.80s jump was not).
-    phase_samples: dict = {}
-    # Cycle up then down through world sizes (e.g. 1 -> 4 -> 8 -> 4 -> 1).
-    # On a single chip every entry is 1: the resize is then forced via
-    # membership churn (leave+rejoin), which runs the identical barrier.
-    cycle = (sizes[1:] + sizes[:-1][::-1]) or [1, 1, 1]
-    prev_w = sizes[0]
-    _compiler.backend_compile = _counting_bc
-    try:
-        for w in cycle:
-            if w == prev_w:
-                coord.deregister(f"t{w - 1}")
-                coord.register(f"t{w - 1}")
-            else:
-                coord.set_target_world(w)
-            prev_w = w
-            compiles_before = m_compiles.value()
-            first_step_marks: dict = {}
-
-            def on_step(rec, marks=first_step_marks):
-                # compile counter right after the FIRST step of each
-                # generation: (mark - before) bounds the whole
-                # resize-window-plus-first-step compile count, before
-                # any later interval save's copy jits muddy it.
-                if rec.generation not in marks:
-                    marks[rec.generation] = m_compiles.value()
-
-            et.maybe_resize()
-            target += steps_per_phase
-            et.run(target, on_step=on_step)
-            gen = et.generation
-            first = next(r for r in et.history if r.generation == gen)
-            # Window = resize barrier (event.seconds) + first post-resize
-            # step.
-            event = et.resize_events[-1]
-            assert event.generation == gen
-            resize_windows.append(event.seconds + first.seconds)
-            for name, secs in (event.phase_seconds or {}).items():
-                phase_samples.setdefault(name, []).append(secs)
-            phase_samples.setdefault("first_step", []).append(first.seconds)
-            step_times.extend(r.seconds for r in et.history[-3:])
-            resize_events.append(
-                {
-                    "world_size": event.world_size,
-                    "graceful": event.graceful,
-                    "seconds": round(event.seconds, 4),
-                    "first_step_s": round(first.seconds, 4),
-                    "xla_compiles": int(
-                        first_step_marks.get(gen, m_compiles.value())
-                        - compiles_before
-                    ),
-                    "phase_seconds": event.phase_seconds,
-                }
-            )
-    finally:
-        _compiler.backend_compile = _real_bc
-
-    # Join any in-flight async checkpoint thread before teardown (a live
-    # device->host copy racing interpreter exit aborts the TPU runtime).
-    et.store.wait()
-
-    # Steady-state telemetry overhead: time the EXACT per-step ops the
-    # elastic loop performs (recorder context stamp + steps counter inc
-    # + step-seconds histogram observe) on a scoped throwaway registry,
-    # and express the per-step cost against this run's median step time
-    # — the default-on registry's acceptance bar is < 1%.
-    import time
-
-    median_step = statistics.median(step_times)
-    with telemetry.scoped() as (treg, trec):
-        tc = treg.counter("edl_steps_total")
-        th = treg.histogram("edl_step_seconds")
-        n_ops = 20000
-        t0 = time.perf_counter()
-        for i in range(n_ops):
-            trec.set_context(i, 0)
-            tc.inc()
-            th.observe(0.001)
-        per_step_overhead = (time.perf_counter() - t0) / n_ops
-
-    # Goodput ledger across the whole cycle (steady stepping + every
-    # resize + any replay), read from the same shared registry a
-    # production scrape sees: the fraction of wall clock spent
-    # stepping, with the resizing[:phase] / holding / replaying
-    # decomposition the autoscaler's decision log records.
-    from edl_tpu.telemetry import goodput_decomposition
-
-    goodput = goodput_decomposition(
-        telemetry.get_registry().snapshot()
-    )
-
-    return {
-        "telemetry": {
-            "per_step_overhead_s": round(per_step_overhead, 9),
-            "median_step_s": round(median_step, 6),
-            "overhead_frac": round(per_step_overhead / median_step, 6),
-            # read back from the SHARED registry (what /metrics serves)
-            "steps_total": et._m_steps.value(),
-        },
-        "goodput": goodput,
-        "goodput_frac": (goodput or {}).get("frac"),
-        "resize_s": statistics.median(resize_windows),
-        "resize_max_s": max(resize_windows),
-        "step_s": statistics.median(step_times),
-        "n_devices": n_dev,
-        "world_cycle": cycle,
-        "resize_phases": {
-            name: {
-                "median_s": round(statistics.median(xs), 4),
-                "max_s": round(max(xs), 4),
-            }
-            for name, xs in sorted(phase_samples.items())
-        },
-        # Per-resize attribution (the r5 honesty fix): every resize's
-        # full phase breakdown + its true-compile count, published into
-        # the round record so the NEXT regression is attributable to
-        # one phase of one cycle instead of a single opaque max.
-        "resize_events": resize_events,
-        "warm_resize_xla_compiles": max(
-            (ev["xla_compiles"] for ev in resize_events), default=0
-        ),
-    }
+    return _bench_resize(model_name=model_name, steps_per_phase=steps_per_phase)
 
 
-V5E_BF16_PEAK_PER_CHIP = 197e12
+def bench_cpu_cross_size(n_devices: int = 8) -> dict:
+    from bench_lib.resize import bench_cpu_cross_size as _bench_cross
 
-
-def _timed_train_loop(model, batch_size: int, steps: int) -> dict:
-    """Shared measurement harness: compile-warm, pre-staged device
-    batches, float(loss) sync at the timing boundaries.
-
-    Pre-staging matters on a tunneled platform where each
-    host->device transfer blocks ~15ms and would pollute the compute
-    number (production pipelines prefetch/overlap; the resize bench
-    covers the data path separately).  The float(loss) sync matters
-    because block_until_ready returns before device completion on the
-    tunnel and wildly under-measures."""
-    import time
-
-    import jax
-    import optax
-
-    from edl_tpu.parallel.mesh import dp_mesh
-    from edl_tpu.runtime.data import ShardedDataIterator, synthetic_dataset
-    from edl_tpu.runtime.train import Trainer
-
-    n_dev = len(jax.devices())
-    mesh = dp_mesh(n_dev)
-    trainer = Trainer(model, optax.adamw(1e-4), mesh)
-    state = trainer.init_state()
-    data = ShardedDataIterator(
-        synthetic_dataset(model.synth_batch, max(64, 2 * batch_size)),
-        global_batch_size=batch_size,
-    )
-    batches = [data.device_batch(s, mesh) for s in range(steps + 1)]
-    jax.block_until_ready(batches)
-    state, metrics = trainer.step(state, batches[0])  # compile warm-up
-    float(metrics["loss"])
-    t0 = time.perf_counter()
-    for s in range(1, steps + 1):
-        state, metrics = trainer.step(state, batches[s])
-    float(metrics["loss"])  # sync: the whole chain must have executed
-    dt = (time.perf_counter() - t0) / steps
-    on_tpu = jax.default_backend() == "tpu"
-    peak = V5E_BF16_PEAK_PER_CHIP * n_dev
-    # Trained tokens/example comes from the MODEL, not a caller-passed
-    # constant that could silently diverge from the actual shapes
-    # (ADVICE r3); fall back to the widest batch dim for token models
-    # registered without the field.
-    seq_len = model.tokens_per_example or max(
-        (v.shape[1] for v in batches[0].values() if v.ndim >= 2), default=1
-    )
-    out = {
-        "step_s": dt,
-        "examples_per_s": batch_size / dt,
-        "tokens_per_s": batch_size * seq_len / dt,
-        "mfu": model.flops_per_example * batch_size / dt / peak
-        if on_tpu
-        else 0.0,
-        "batch": batch_size,
-        "seq_len": seq_len,
-    }
-    # Model-specific quality counters ride along (e.g. the MoE family's
-    # capacity-drop rate — an MFU figure must not hide dropped compute).
-    for k, v in metrics.items():
-        if k.startswith("moe_"):
-            out[k] = round(float(v), 5)
-    return out
+    return _bench_cross(n_devices=n_devices)
 
 
 def bench_transformer_throughput(steps: int = 20) -> dict:
-    """Flagship transformer-base training-step throughput on the local
-    device(s): tokens/s and MFU vs v5e bf16 peak (197 TFLOP/s/chip)."""
-    import jax
+    from bench_lib.lm import bench_transformer_throughput as _bench_thr
 
-    from edl_tpu.models.base import get_model
-
-    n_dev = len(jax.devices())
-    on_tpu = jax.default_backend() == "tpu"
-    model = get_model("transformer_base", tiny=not on_tpu)
-    batch_size = 64 * n_dev if on_tpu else 2 * n_dev
-    return _timed_train_loop(model, batch_size, steps)
-
-
-def bench_longcontext_lm(seq_len: int = 2048, batch: int = 8, steps: int = 8) -> dict:
-    """Decoder-only LM at long context on the Pallas flash-attention
-    path (XLA's fused attention OOMs here: its [B, H, T, T] f32 scores
-    alone exceed HBM at training batch sizes).  Evidence for the
-    long-context capability bar (SURVEY.md §5.7 — absent in the 2018
-    reference; first-class in the rebuild).
-
-    Runs in a fresh subprocess BEFORE any other section initializes the
-    TPU in this process: a second process sharing the (tunneled) chip
-    time-slices it and inflates this model's step ~70%.  The parent
-    must not import jax before spawning."""
-    return _run_bench_child(
-        "--longcontext-child", str(seq_len), str(batch), str(steps)
-    )
-
-
-def _longcontext_child(seq_len: int, batch: int, steps: int):
-    import jax
-
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"skipped": "flash path is TPU-only"}))
-        return
-    from edl_tpu.models.base import get_model
-
-    model = get_model("transformer_lm", seq_len=seq_len)
-    print(json.dumps(_timed_train_loop(model, batch, steps)))
-
-
-def bench_moe_lm(batch: int = 8, steps: int = 8, group: int = 0) -> dict:
-    """Full-size MoE LM (12L x 8 experts, T=2048, grouped top-1
-    routing) — the expert-parallel family's single-chip figure (MFU is
-    ACTIVE FLOPs: one expert per token plus routing einsums).  Child
-    process for the same chip-isolation reason as long context.
-    ``group`` overrides the routing group width (0 = model default)."""
-    return _run_bench_child(
-        "--moe-child", str(batch), str(steps), str(group)
-    )
-
-
-def _moe_child(batch: int, steps: int, group: int = 0):
-    import jax
-
-    if jax.default_backend() != "tpu":
-        print(json.dumps({"skipped": "full-size MoE bench is TPU-only"}))
-        return
-    from edl_tpu.models.base import get_model
-
-    kwargs = {"group_size": group} if group else {}
-    out = _timed_train_loop(get_model("moe_lm", **kwargs), batch, steps)
-    print(json.dumps(out))
-
-
-def _run_bench_child(*argv: str, env=None) -> dict:
-    """Spawn this file as a child bench section and parse the JSON line
-    it prints last (warnings go to stderr, so the parse is safe)."""
-    import os
-    import subprocess
-
-    proc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), *argv],
-        env=env,
-        capture_output=True,
-        text=True,
-        timeout=900,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
-    )
-    if proc.returncode != 0:
-        raise RuntimeError(
-            f"{argv[0]} subprocess rc={proc.returncode}: "
-            f"{proc.stderr[-2000:]}"
-        )
-    return json.loads(proc.stdout.strip().splitlines()[-1])
+    return _bench_thr(steps=steps)
 
 
 def bench_mnist_throughput(steps: int = 20) -> dict:
-    """MNIST ConvNet training-step throughput — the BASELINE config 1/2
-    model finally gets published numbers (VERDICT r5 #8): step_s and
-    examples/s on the local device(s)."""
-    import jax
+    from bench_lib.lm import bench_mnist_throughput as _bench_mnist
 
-    from edl_tpu.models.base import get_model
+    return _bench_mnist(steps=steps)
 
-    n_dev = len(jax.devices())
-    on_tpu = jax.default_backend() == "tpu"
-    batch = (256 if on_tpu else 32) * n_dev
-    r = _timed_train_loop(get_model("mnist"), batch, steps)
-    # images, not tokens: report examples/s and drop the LM-shaped keys
-    return {
-        "step_s": round(r["step_s"], 5),
-        "examples_per_s": round(r["examples_per_s"], 1),
-        "batch": r["batch"],
-    }
+
+def bench_longcontext_lm(seq_len: int = 2048, batch: int = 8, steps: int = 8) -> dict:
+    from bench_lib.lm import bench_longcontext_lm as _bench_lc
+
+    return _bench_lc(seq_len=seq_len, batch=batch, steps=steps)
+
+
+def bench_moe_lm(batch: int = 8, steps: int = 8, group: int = 0) -> dict:
+    from bench_lib.lm import bench_moe_lm as _bench_moe
+
+    return _bench_moe(batch=batch, steps=steps, group=group)
 
 
 def bench_serving() -> dict:
-    """Elastic inference serving — moved to ``bench_lib.serving`` (the
-    ROADMAP-item-5 per-section split; the sweep now rides the shared
-    OPEN-LOOP arrival generator in ``bench_lib.load``)."""
     from bench_lib.serving import bench_serving as _bench_serving
 
     return _bench_serving()
 
 
 def bench_fleet() -> dict:
-    """Multi-job fleet market under a scripted traffic storm
-    (``bench_lib.fleet``): REAL launcher pods, one chip inventory, a
-    serving p95 spike that preempts the lowest-priority trainer via a
-    consensus-clean scale-down and gives the chips back on recovery —
-    cluster-wide goodput decomposition, chips-over-time, SLO
-    attainment, stop-step skew (asserted 0), and the storm's
-    warm-resize true-compile count (from real worker journals)."""
     from bench_lib.fleet import bench_fleet as _bench_fleet
 
     return _bench_fleet()
 
 
 def bench_steady_state(steps: int = 30) -> dict:
-    """Steady-state step-pipeline A/B — moved to
-    ``bench_lib.steady_state`` (the ROADMAP-item-5 per-module rule:
-    sections move as they next change; same sections, same
-    thresholds)."""
     from bench_lib.steady_state import bench_steady_state as _bench_ss
 
     return _bench_ss(steps=steps)
 
 
-def bench_cpu_cross_size(n_devices: int = 8) -> dict:
-    """True cross-size resize (1 -> n/2 -> n -> n/2 -> 1) measured on a
-    forced ``n_devices`` virtual-CPU mesh in a hermetic subprocess.
-
-    The single-chip headline above can only exercise the leave/rejoin
-    barrier (world stays 1); this figure tracks the real re-mesh +
-    resharding-restore path the <60s BASELINE.md budget is about.
-    """
-    from edl_tpu.utils.hermetic import virtual_cpu_env
-
-    return _run_bench_child(
-        "--cross-size-child", env=virtual_cpu_env(n_devices)
-    )
-
-
 def bench_restore_paths() -> dict:
-    """Joiner restore paths side by side, plus the multi-source fabric
-    sweep to >= 2GB simulated state — moved to ``bench_lib/restore.py``
-    (ROADMAP item 5's per-module rule: sections move as they next
-    change)."""
     from bench_lib.restore import run_restore_paths
 
     return run_restore_paths()
 
 
+def bench_shard_only_restore() -> dict:
+    from bench_lib.restore import run_shard_only
+
+    return run_shard_only()
+
+
 def bench_scale_down() -> dict:
-    """Scale-down agreement on a REAL multi-process CPU world: four
-    launcher pods form a 4-wide world through the HTTP coordinator,
-    the target drops to 2, and the consensus step bus quiesces every
-    member at one agreed stop step before any teardown.
+    from bench_lib.scale_down import bench_scale_down as _bench_sd
 
-    Published: retarget->quiesce latency (the time from the retarget
-    landing to the slowest member parking at the boundary),
-    retarget->stepping (until the survivors step at world 2), the
-    agreed stop step, and the stop-step SKEW across all four members'
-    last old-world steps — asserted 0: "every member leaves the old
-    world at the same step boundary" is the claim this section exists
-    to keep measured (the pre-consensus poll-skew race hung 2/5 runs
-    of the equivalent test on a loaded box)."""
-    import json as _json
-    import os
-    import signal
-    import subprocess
-    import tempfile
-    import time
-
-    from edl_tpu.runtime.coord_service import CoordinatorServer
-    from edl_tpu.runtime.coordinator import LocalCoordinator
-
-    tmp = tempfile.mkdtemp(prefix="edl-bench-scaledown-")
-    coord = LocalCoordinator(
-        target_world=4, max_world=4, heartbeat_timeout=60.0,
-        legal_sizes=[1, 2, 4],
-    )
-    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
-    caddr = f"127.0.0.1:{server.port}"
-    names = ("s1", "s2", "s3", "s4")
-    hist = {n: os.path.join(tmp, f"{n}.jsonl") for n in names}
-    events = {n: os.path.join(tmp, f"{n}.events.jsonl") for n in names}
-    here = os.path.dirname(os.path.abspath(__file__))
-    procs = []
-
-    def read_jsonl(path):
-        if not os.path.exists(path):
-            return []
-        out = []
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    try:
-                        out.append(_json.loads(line))
-                    except _json.JSONDecodeError:
-                        pass  # partially written tail
-        return out
-
-    def steps_at(name, world):
-        return [
-            r["step"]
-            for r in read_jsonl(hist[name])
-            if "step" in r and r.get("world_size") == world
-        ]
-
-    try:
-        for i, n in enumerate(names):
-            env = dict(os.environ)
-            env["EDL_POD_NAME"] = n
-            env["EDL_FLIGHT_RECORDER_FILE"] = events[n]
-            env["XLA_FLAGS"] = " ".join(
-                f
-                for f in env.get("XLA_FLAGS", "").split()
-                if not f.startswith(
-                    "--xla_force_host_platform_device_count"
-                )
-            )
-            procs.append(
-                subprocess.Popen(
-                    [
-                        sys.executable, "-u", "-m", "edl_tpu.launcher",
-                        "--entrypoint", "fit_a_line",
-                        "--steps", "200000",
-                        "--coordinator", caddr,
-                        "--address", f"127.0.0.1:{12400 + 100 * i}",
-                        "--platform", "cpu",
-                        "--global-batch-size", "8",
-                        "--checkpoint-interval", "50",
-                        "--history-file", hist[n],
-                        "--lr", "1e-2",
-                    ],
-                    env=env,
-                    cwd=here,
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
-            )
-
-        def wait_for(pred, timeout, what):
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                if pred():
-                    return
-                for p in procs:
-                    if p.poll() is not None and p.returncode != 0:
-                        raise RuntimeError(
-                            f"scale_down worker died waiting for {what}: "
-                            f"{p.stdout.read()[-2000:]}"
-                        )
-                time.sleep(0.25)
-            raise RuntimeError(f"scale_down bench timed out on {what}")
-
-        wait_for(
-            lambda: all(len(steps_at(n, 4)) >= 5 for n in names),
-            300,
-            "the 4-pod world to step",
-        )
-        t0_wall = time.time()
-        t0 = time.monotonic()
-        coord.set_target_world(2)
-        # The coordinator keeps the FIRST-registered members (join
-        # order = rank order); with all four spawned at once that
-        # order is a race — read the survivors from the plan.
-        survivors = list(coord.plan().members)
-        wait_for(
-            lambda: all(steps_at(n, 2) for n in survivors),
-            300,
-            "the survivors to step at world 2",
-        )
-        stepping_s = time.monotonic() - t0
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        for p in procs:
-            p.wait(timeout=60)
-
-        # Every member's last old-world step: the SKEW across them is
-        # the claim (0 = one agreed boundary, nobody left early).
-        last_old = {n: max(steps_at(n, 4)) for n in names}
-        skew = max(last_old.values()) - min(last_old.values())
-        assert skew == 0, f"stop-step skew {skew}: {last_old}"
-        down = [
-            r["resize"]
-            for r in read_jsonl(hist[survivors[0]])
-            if "resize" in r and r["resize"]["world_size"] == 2
-        ]
-        stop_step = down[-1]["stop_step"] if down else -1
-        assert stop_step == last_old[survivors[0]] + 1, (
-            stop_step,
-            last_old,
-        )
-        # Quiesce latency from the members' flight recorders: the
-        # consensus.quiesce stamp of the SLOWEST member vs the
-        # retarget's wall clock.
-        quiesce_walls = [
-            ev.get("wall", 0.0)
-            for n in names
-            for ev in read_jsonl(events[n])
-            if ev.get("kind") == "consensus.quiesce"
-        ]
-        quiesce_s = (
-            max(quiesce_walls) - t0_wall if quiesce_walls else None
-        )
-        return {
-            "world_from": 4,
-            "world_to": 2,
-            "processes": 4,
-            "stop_step": stop_step,
-            "stop_skew_steps": skew,
-            "retarget_to_quiesce_s": (
-                round(quiesce_s, 4) if quiesce_s is not None else None
-            ),
-            "retarget_to_stepping_s": round(stepping_s, 4),
-        }
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        server.stop()
+    return _bench_sd()
 
 
 def _attempt(fn, label: str, retries: int = 1):
@@ -644,27 +123,9 @@ def _platform() -> str:
     return jax.default_backend()
 
 
-def _lm_summary(r: dict) -> dict:
-    """Per-model bench summary (one shape for every LM section); error
-    and skipped records pass through untouched.  Model-specific quality
-    counters (the ``moe_`` keys, e.g. the capacity-drop rate) pass
-    through too: an MFU figure must not hide dropped compute, and
-    stripping them here was how the r5 record lost the MoE drop rate
-    (VERDICT r5)."""
-    if "error" in r or "skipped" in r:
-        return r
-    out = {
-        "step_s": round(r["step_s"], 5),
-        "tokens_per_s": round(r["tokens_per_s"]),
-        "mfu": round(r["mfu"], 4),
-        "batch": r["batch"],
-        "seq_len": r["seq_len"],
-    }
-    out.update({k: v for k, v in r.items() if k.startswith("moe_")})
-    return out
-
-
 def main():
+    from bench_lib.lm import lm_summary
+
     # Long-context first: its child must own the chip alone (this
     # process has not initialized a TPU client yet).
     lc = _attempt(bench_longcontext_lm, "longcontext_lm", retries=0)
@@ -694,6 +155,15 @@ def main():
     steady = _attempt(bench_steady_state, "steady_state", retries=0)
     cross = _attempt(bench_cpu_cross_size, "cpu_cross_size", retries=0)
     restore = _attempt(bench_restore_paths, "restore_paths", retries=0)
+    shard_only = _attempt(
+        bench_shard_only_restore, "restore_paths.shard_only", retries=0
+    )
+    if isinstance(restore, dict):
+        # shard_only rides inside restore_paths in the round record
+        # (it is a restore-path figure), but is attempted separately so
+        # a failure in one half does not drop the other.
+        restore = dict(restore)
+        restore["shard_only"] = shard_only
     scale_down = _attempt(bench_scale_down, "scale_down", retries=0)
     serving = _attempt(bench_serving, "serving", retries=0)
     fleet = _attempt(bench_fleet, "fleet", retries=0)
@@ -749,12 +219,12 @@ def main():
                     "mnist": mnist,
                     # pipeline on/off A/B with per-step phase breakdown
                     "steady_state": steady,
-                    "transformer_base": _lm_summary(thr),
-                    "longcontext_lm": _lm_summary(lc),
-                    "longcontext_lm_4k": _lm_summary(lc4k),
-                    "longcontext_lm_8k": _lm_summary(lc8k),
-                    "longcontext_lm_16k": _lm_summary(lc16k),
-                    "moe_lm": _lm_summary(moe),
+                    "transformer_base": lm_summary(thr),
+                    "longcontext_lm": lm_summary(lc),
+                    "longcontext_lm_4k": lm_summary(lc4k),
+                    "longcontext_lm_8k": lm_summary(lc8k),
+                    "longcontext_lm_16k": lm_summary(lc16k),
+                    "moe_lm": lm_summary(moe),
                     "cpu_cross_size": (
                         cross
                         if "error" in cross
@@ -770,6 +240,9 @@ def main():
                             ),
                         }
                     ),
+                    # joiner restore paths side by side + the fabric
+                    # sweep + the shard-only cluster-memory figures
+                    # (peak per-member RSS vs full-copy, joiner wire)
                     "restore_paths": restore,
                     # retarget->quiesce latency + stop-step skew
                     # (asserted 0) across a real 4->2 process world
@@ -793,26 +266,5 @@ def main():
     )
 
 
-def _cross_size_child():
-    """Child entry: measure bench_resize on the forced-CPU mesh and print
-    its raw dict as JSON (consumed by bench_cpu_cross_size)."""
-    from edl_tpu.utils.hermetic import pin_cpu_platform
-
-    pin_cpu_platform()
-    r = bench_resize(steps_per_phase=5)
-    print(json.dumps(r))
-
-
 if __name__ == "__main__":
-    if "--cross-size-child" in sys.argv:
-        _cross_size_child()
-    elif "--longcontext-child" in sys.argv:
-        i = sys.argv.index("--longcontext-child")
-        sl, b, st = (int(x) for x in sys.argv[i + 1 : i + 4])
-        _longcontext_child(sl, b, st)
-    elif "--moe-child" in sys.argv:
-        i = sys.argv.index("--moe-child")
-        rest = [int(x) for x in sys.argv[i + 1 :][:3]]
-        _moe_child(*rest)
-    else:
-        main()
+    main()

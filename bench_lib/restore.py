@@ -439,6 +439,207 @@ def _fabric_sweep_child(spec_json: str):
     print(json.dumps(out))
 
 
+# ---------------------------------------------------------------------------
+# ISSUE 19: shard-only cluster memory vs the retired full-copy residency
+# ---------------------------------------------------------------------------
+
+#: simulated state for the shard-only figure; big enough that the
+#: per-member ratio is about layout arithmetic, not fixed overheads
+SHARD_ONLY_STATE_BYTES = 256 << 20
+SHARD_ONLY_WORLD = 5  # 4 shard-resident peers + 1 empty joiner
+SHARD_ONLY_K = 1
+
+
+def run_shard_only(
+    state_bytes: int = SHARD_ONLY_STATE_BYTES,
+    world: int = SHARD_ONLY_WORLD,
+    k: int = SHARD_ONLY_K,
+) -> dict:
+    """Parent half: run the shard-only memory figure in a hermetic
+    subprocess (hundreds of MB of simulated state must not live in the
+    bench driver)."""
+    spec = json.dumps(
+        {"total": int(state_bytes), "world": int(world), "k": int(k)}
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "bench_lib.restore",
+            "--shard-only-child",
+            spec,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"shard-only child rc={proc.returncode}: "
+            f"{proc.stderr[-2000:]}"
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _shard_only_child(spec_json: str):
+    """The ISSUE 19 memory claim, measured: a world restores with NO
+    member holding full state.  Each member's peak host checkpoint
+    bytes are its own GSPMD slice + K ring-buddy shards + ONE in-flight
+    shard buffer (``shard_restore`` pulls into per-shard buffers), vs
+    the retired full-copy residency where EVERY member held the whole
+    digest-agreed checkpoint.  The joiner's wire bytes are its wanted
+    ranges, not the state.  Gated: ``peak_member_bytes_ratio`` <= 0.6
+    at world >= 4 (at W=5/K=1 the layout puts (1+K)/W = 0.4 of the
+    state on each member), ``joiner_wire_ratio`` <= 0.55,
+    ``bit_identical`` true."""
+    import threading
+    import zlib
+
+    import numpy as np
+
+    import jax
+
+    from edl_tpu.checkpoint import fabric as fab
+    from edl_tpu.checkpoint import transfer as tx
+    from edl_tpu.checkpoint.hostdram import HostCheckpoint
+
+    spec = json.loads(spec_json)
+    W, K = int(spec["world"]), int(spec["k"])
+    shard_b = 4 << 20  # small shards: even 256MB spreads over the ring
+    leaves = _synthetic_leaves(int(spec["total"]))
+    total = sum(l.nbytes for l in leaves)
+    template = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    rows = [l.shape[0] for l in leaves]
+    layout = fab.ShardLayout.build(
+        [l.nbytes for l in leaves], W, k=K, shard_bytes=shard_b, rows=rows
+    )
+
+    def run_world(member_fns):
+        world = tx.LoopbackWorld(len(member_fns))
+        results = [None] * len(member_fns)
+        errors = [None] * len(member_fns)
+
+        def runner(rank, fn):
+            try:
+                results[rank] = fn(world.fabric(rank))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[rank] = e
+
+        threads = [
+            threading.Thread(target=runner, args=(r, fn), daemon=True)
+            for r, fn in enumerate(member_fns)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+            assert not t.is_alive(), "shard-only member hung"
+        for e in errors:
+            if e is not None:
+                raise e
+        return results, time.perf_counter() - t0
+
+    # --- retired full-copy residency, measured side by side: every
+    # member holds the whole checkpoint, the joiner pulls ALL of it.
+    _, treedef = jax.tree_util.tree_flatten(list(leaves))
+    cks = [
+        HostCheckpoint(
+            step=10, generation=1, leaves=list(leaves), treedef=treedef
+        )
+        for _ in range(W - 1)
+    ]
+    for ck in cks:
+        ck.leaf_digests()
+        ck.shard_digests(layout)
+    fns = [
+        (lambda f, ck=ck: fab.fabric_restore(f, template, ck, rows=rows))
+        for ck in cks
+    ]
+    fns.append(lambda f: fab.fabric_restore(f, template, None, rows=rows))
+    full_results, full_s = run_world(fns)
+    full_joiner_wire = full_results[-1].stats.bytes_received
+    assert full_joiner_wire == total
+    del full_results, cks
+
+    # --- shard-only residency: ranks 0..W-2 hold exactly their wanted
+    # shards, rank W-1 is an EMPTY joiner; nobody ever assembles a
+    # full leaf (shard_restore pulls into per-shard buffers).
+    residents = [fab.ShardReplicaStore(keep_steps=2) for _ in range(W)]
+    for r in range(W - 1):
+        for i in layout.wanted(r):
+            s = layout.shards[i]
+            data = np.frombuffer(
+                fab.byte_view(leaves[s.leaf])[
+                    s.offset : s.offset + s.length
+                ],
+                np.uint8,
+            ).copy()
+            residents[r].put(
+                10, s.leaf, s.offset, s.length, data, zlib.crc32(data)
+            )
+
+    def member(r):
+        return lambda f: fab.shard_restore(
+            f,
+            template,
+            residents[r],
+            rows=rows,
+            k=K,
+            shard_bytes=shard_b,
+        )
+
+    results, shard_s = run_world([member(r) for r in range(W)])
+    joiner = results[-1]
+    assert joiner.stats.mode == "fabric"
+    joiner_wire = joiner.stats.bytes_received
+
+    # Peak host checkpoint bytes per member: measured resident bytes
+    # + one in-flight shard buffer (the pull lands per shard).
+    peak_member = max(residents[r].nbytes() for r in range(W)) + shard_b
+    bit_identical = all(
+        bytes(residents[r].get(10, s.leaf, s.offset, s.length))
+        == bytes(
+            fab.byte_view(leaves[s.leaf])[s.offset : s.offset + s.length]
+        )
+        for r in range(W)
+        for s in (layout.shards[i] for i in layout.wanted(r))
+    )
+    covered = set()
+    for r in range(W):
+        covered.update(layout.wanted(r))
+
+    print(
+        json.dumps(
+            {
+                "world": W,
+                "k": K,
+                "state_mb": round(total / 1e6, 1),
+                "shard_mb": shard_b >> 20,
+                # the gated memory claim: shard-only peak vs the
+                # full-copy residency where member bytes == state
+                "peak_member_mb": round(peak_member / 1e6, 1),
+                "full_copy_member_mb": round(total / 1e6, 1),
+                "peak_member_bytes_ratio": round(peak_member / total, 4),
+                "joiner_wire_mb": round(joiner_wire / 1e6, 1),
+                "full_copy_joiner_wire_mb": round(
+                    full_joiner_wire / 1e6, 1
+                ),
+                "joiner_wire_ratio": round(joiner_wire / total, 4),
+                "bit_identical": bool(bit_identical),
+                "union_covers_all_shards": covered
+                == set(range(len(layout.shards))),
+                "shard_only_restore_s": round(shard_s, 4),
+                "full_copy_restore_s": round(full_s, 4),
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     if "--restore-child" in sys.argv:
         i = sys.argv.index("--restore-child")
@@ -446,3 +647,6 @@ if __name__ == "__main__":
     elif "--fabric-sweep-child" in sys.argv:
         i = sys.argv.index("--fabric-sweep-child")
         _fabric_sweep_child(sys.argv[i + 1])
+    elif "--shard-only-child" in sys.argv:
+        i = sys.argv.index("--shard-only-child")
+        _shard_only_child(sys.argv[i + 1])

@@ -1,0 +1,185 @@
+"""bench scale_down — consensus-clean 4->2 shrink on real processes.
+
+ROADMAP item 5's per-module split, final tranche: the scale-down
+agreement section moves here from the monolithic ``bench.py``.
+``bench.py`` stays the driver that composes sections into the ONE
+JSON round record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_scale_down() -> dict:
+    """Scale-down agreement on a REAL multi-process CPU world: four
+    launcher pods form a 4-wide world through the HTTP coordinator,
+    the target drops to 2, and the consensus step bus quiesces every
+    member at one agreed stop step before any teardown.
+
+    Published: retarget->quiesce latency (the time from the retarget
+    landing to the slowest member parking at the boundary),
+    retarget->stepping (until the survivors step at world 2), the
+    agreed stop step, and the stop-step SKEW across all four members'
+    last old-world steps — asserted 0: "every member leaves the old
+    world at the same step boundary" is the claim this section exists
+    to keep measured (the pre-consensus poll-skew race hung 2/5 runs
+    of the equivalent test on a loaded box)."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    tmp = tempfile.mkdtemp(prefix="edl-bench-scaledown-")
+    coord = LocalCoordinator(
+        target_world=4, max_world=4, heartbeat_timeout=60.0,
+        legal_sizes=[1, 2, 4],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    names = ("s1", "s2", "s3", "s4")
+    hist = {n: os.path.join(tmp, f"{n}.jsonl") for n in names}
+    events = {n: os.path.join(tmp, f"{n}.events.jsonl") for n in names}
+    procs = []
+
+    def read_jsonl(path):
+        if not os.path.exists(path):
+            return []
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass  # partially written tail
+        return out
+
+    def steps_at(name, world):
+        return [
+            r["step"]
+            for r in read_jsonl(hist[name])
+            if "step" in r and r.get("world_size") == world
+        ]
+
+    try:
+        for i, n in enumerate(names):
+            env = dict(os.environ)
+            env["EDL_POD_NAME"] = n
+            env["EDL_FLIGHT_RECORDER_FILE"] = events[n]
+            env["XLA_FLAGS"] = " ".join(
+                f
+                for f in env.get("XLA_FLAGS", "").split()
+                if not f.startswith(
+                    "--xla_force_host_platform_device_count"
+                )
+            )
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-u", "-m", "edl_tpu.launcher",
+                        "--entrypoint", "fit_a_line",
+                        "--steps", "200000",
+                        "--coordinator", caddr,
+                        "--address", f"127.0.0.1:{12400 + 100 * i}",
+                        "--platform", "cpu",
+                        "--global-batch-size", "8",
+                        "--checkpoint-interval", "50",
+                        "--history-file", hist[n],
+                        "--lr", "1e-2",
+                    ],
+                    env=env,
+                    cwd=REPO,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                )
+            )
+
+        def wait_for(pred, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                for p in procs:
+                    if p.poll() is not None and p.returncode != 0:
+                        raise RuntimeError(
+                            f"scale_down worker died waiting for {what}: "
+                            f"{p.stdout.read()[-2000:]}"
+                        )
+                time.sleep(0.25)
+            raise RuntimeError(f"scale_down bench timed out on {what}")
+
+        wait_for(
+            lambda: all(len(steps_at(n, 4)) >= 5 for n in names),
+            300,
+            "the 4-pod world to step",
+        )
+        t0_wall = time.time()
+        t0 = time.monotonic()
+        coord.set_target_world(2)
+        # The coordinator keeps the FIRST-registered members (join
+        # order = rank order); with all four spawned at once that
+        # order is a race — read the survivors from the plan.
+        survivors = list(coord.plan().members)
+        wait_for(
+            lambda: all(steps_at(n, 2) for n in survivors),
+            300,
+            "the survivors to step at world 2",
+        )
+        stepping_s = time.monotonic() - t0
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            p.wait(timeout=60)
+
+        # Every member's last old-world step: the SKEW across them is
+        # the claim (0 = one agreed boundary, nobody left early).
+        last_old = {n: max(steps_at(n, 4)) for n in names}
+        skew = max(last_old.values()) - min(last_old.values())
+        assert skew == 0, f"stop-step skew {skew}: {last_old}"
+        down = [
+            r["resize"]
+            for r in read_jsonl(hist[survivors[0]])
+            if "resize" in r and r["resize"]["world_size"] == 2
+        ]
+        stop_step = down[-1]["stop_step"] if down else -1
+        assert stop_step == last_old[survivors[0]] + 1, (
+            stop_step,
+            last_old,
+        )
+        # Quiesce latency from the members' flight recorders: the
+        # consensus.quiesce stamp of the SLOWEST member vs the
+        # retarget's wall clock.
+        quiesce_walls = [
+            ev.get("wall", 0.0)
+            for n in names
+            for ev in read_jsonl(events[n])
+            if ev.get("kind") == "consensus.quiesce"
+        ]
+        quiesce_s = (
+            max(quiesce_walls) - t0_wall if quiesce_walls else None
+        )
+        return {
+            "world_from": 4,
+            "world_to": 2,
+            "processes": 4,
+            "stop_step": stop_step,
+            "stop_skew_steps": skew,
+            "retarget_to_quiesce_s": (
+                round(quiesce_s, 4) if quiesce_s is not None else None
+            ),
+            "retarget_to_stepping_s": round(stepping_s, 4),
+        }
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

@@ -26,7 +26,7 @@ python tools/lint.py
 # thresholds, over the committed BENCH snapshot (or a fresh record
 # via EDL_BENCH_RECORD=path).  Milliseconds; a violated baseline
 # fails before the suite spends its budget.
-python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r13.json}" \
+python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r14.json}" \
   --thresholds bench_thresholds.json
 
 # Stress lane (EDL_STRESS=1): rerun the multipod elastic scale-down

@@ -153,6 +153,19 @@ def leaf_rows(leaves) -> List[int]:
     ]
 
 
+def leaf_nbytes(x) -> int:
+    """Byte size of a leaf, concrete OR abstract — ShapeDtypeStruct has
+    no ``.nbytes``, and the shard plane sizes layouts from abstract
+    templates (eval_shape) precisely so no full state gets allocated."""
+    nb = getattr(x, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    return int(
+        np.dtype(x.dtype).itemsize
+        * np.prod(tuple(x.shape), dtype=np.int64)
+    )
+
+
 def byte_view(buf) -> memoryview:
     """Flat byte view of an array/buffer.  ``memoryview(x).cast("B")``
     raises on zero-size multi-dim arrays ("zeros in shape or
@@ -340,6 +353,45 @@ class ShardLayout:
         what every member computes identically from the membership."""
         return {s.index: self.holders(s) for s in self.shards}
 
+    def wanted(self, rank: int) -> List[int]:
+        """Shard indices member ``rank`` is responsible for holding in
+        shard-only residency: its own GSPMD slice plus the K buddy
+        shards the ring assigns it.  This is THE per-member memory
+        contract — (1+K)/world of state instead of 1.0 — and every
+        member computes it identically from the membership."""
+        return [s.index for s in self.shards if rank in self.holders(s)]
+
+    def row_span(self, s: Shard) -> Tuple[int, int]:
+        """[row_lo, row_hi) of a row-aligned shard (the tail shard's
+        rounding means length//row_bytes can undercount: the span runs
+        to the NEXT shard's start row, or the leaf's end)."""
+        if s.start_row < 0 or self.rows[s.leaf] <= 0:
+            return (0, self.rows[s.leaf]) if self.rows[s.leaf] > 0 else (0, 0)
+        peers = self.by_leaf[s.leaf]
+        pos = peers.index(s)
+        hi = (
+            peers[pos + 1].start_row
+            if pos + 1 < len(peers)
+            else self.rows[s.leaf]
+        )
+        return (s.start_row, hi)
+
+    def shards_for_rows(self, leaf: int, lo: int, hi: int) -> List[Shard]:
+        """The shards of ``leaf`` whose row spans intersect [lo, hi) —
+        what a device slice must fetch to stage rows [lo, hi) without
+        materializing the whole leaf.  Non-row leaves (whole-leaf or
+        plain byte-range shards) return every shard: their bytes carry
+        no row structure, so any consumer needs all of them."""
+        shs = self.by_leaf.get(leaf, [])
+        if not shs or shs[0].start_row < 0:
+            return list(shs)
+        out = []
+        for s in shs:
+            s_lo, s_hi = self.row_span(s)
+            if s_lo < hi and s_hi > lo:
+                out.append(s)
+        return out
+
 
 def compute_shard_digests(
     leaves: Sequence[np.ndarray], layout: ShardLayout
@@ -453,6 +505,134 @@ class ShardReplicaStore:
     def nbytes(self) -> int:
         with self._lock:
             return sum(k[3] for k in self._shards)
+
+
+def adopt_resident(
+    resident: ShardReplicaStore,
+    leaves: Sequence[Any],
+    layout: ShardLayout,
+    rank: int,
+    step: int,
+    *,
+    want: Optional[Sequence[int]] = None,
+    crcs: Optional[Sequence[int]] = None,
+) -> int:
+    """Trim full leaves down to shard residency: copy the byte ranges
+    of the shards ``rank`` must hold (``ShardLayout.wanted`` unless
+    ``want`` overrides) into the resident store and return the bytes
+    adopted.  The copies are real (not views) so the caller can DROP
+    the full leaves afterwards — that drop is the whole point: host
+    memory falls from 1.0x state to (1+K)/world.  ``crcs``: the
+    layout-ordered shard digest vector when the caller already has one
+    (flush stage B computed it); absent entries are hashed here."""
+    idxs = layout.wanted(rank) if want is None else [int(s) for s in want]
+    adopted = 0
+    for s_idx in idxs:
+        sh = layout.shards[s_idx]
+        leaf = leaves[sh.leaf]
+        if leaf is None or getattr(leaf, "nbytes", 0) < sh.offset + sh.length:
+            continue
+        region = byte_view(leaf)[sh.offset : sh.offset + sh.length]
+        data = np.empty(sh.length, np.uint8)
+        memoryview(data)[:] = region
+        crc = (
+            int(crcs[s_idx])
+            if crcs is not None and s_idx < len(crcs)
+            else zlib.crc32(data)
+        )
+        if resident.put(step, sh.leaf, sh.offset, sh.length, data, crc):
+            adopted += sh.length
+    return adopted
+
+
+def assemble_from_resident(
+    resident: ShardReplicaStore,
+    layout: ShardLayout,
+    step: int,
+    leaf: int,
+    template_leaf: Any,
+) -> np.ndarray:
+    """One full leaf rebuilt from resident shard bytes (cold start /
+    verification paths).  Raises ``TransferError`` when coverage is
+    incomplete — shard-only residency plus this assembler is the
+    cluster-memory replacement for a full host copy."""
+    buf = np.empty(template_leaf.shape, np.dtype(template_leaf.dtype))
+    view = byte_view(buf)
+    for sh in layout.by_leaf.get(leaf, []):
+        src = resident.get(step, sh.leaf, sh.offset, sh.length)
+        if src is None:
+            raise TransferError(
+                f"shard-only assembly: leaf {leaf} missing shard "
+                f"{sh.index} at step {step}"
+            )
+        view[sh.offset : sh.offset + sh.length] = byte_view(src)
+    return buf
+
+
+def stage_slice_from_shards(
+    layout: ShardLayout,
+    leaf: int,
+    template_leaf: Any,
+    index: Any,
+    shard_src: Callable[[Shard], Any],
+) -> np.ndarray:
+    """The device slice ``template_leaf[index]`` assembled straight
+    from shard byte ranges — the staging primitive behind serving hot
+    swap and tp restore, with NO full-leaf materialization.
+
+    ``index`` is a jax device index (tuple of step-1 slices).  Row
+    leaves copy only the covering shards' overlapping rows, applying
+    the trailing-axis slices per shard block so a tp-sharded kernel
+    stages exactly its columns; whole-leaf / byte-range shards (≤ one
+    shard_bytes) assemble the small leaf then slice.  ``shard_src``
+    maps a ``Shard`` to its bytes — a view into a full host leaf (the
+    DRAM hot-swap path, zero extra copies), an npz entry of a
+    shard-only durable spill, or a resident-store hit — so every
+    consumer shares ONE offset arithmetic.  Bytes are bit-identical to
+    ``np.asarray(template[index])`` by construction."""
+    shape = tuple(template_leaf.shape)
+    dtype = np.dtype(template_leaf.dtype)
+    idx = tuple(index) if index is not None else ()
+    idx = idx + (slice(None),) * (len(shape) - len(idx))
+    shs = layout.by_leaf.get(leaf, [])
+    if not shs:
+        if not shape or int(np.prod(shape, dtype=np.int64)) == 0:
+            return np.empty(shape, dtype)[idx if shape else ()]
+        raise TransferError(f"no shards cover leaf {leaf}")
+    rows = layout.rows[leaf]
+    if not shape or rows <= 0 or shs[0].start_row < 0:
+        buf = np.empty(shape, dtype)
+        view = byte_view(buf)
+        for sh in shs:
+            view[sh.offset : sh.offset + sh.length] = byte_view(
+                shard_src(sh)
+            )[: sh.length]
+        return buf[idx] if shape else buf
+    s0 = idx[0]
+    lo = 0 if s0.start is None else int(s0.start)
+    hi = shape[0] if s0.stop is None else int(s0.stop)
+    rest = tuple(idx[1:])
+    tail = int(np.prod(shape[1:], dtype=np.int64))
+    out: Optional[np.ndarray] = None
+    for sh in layout.shards_for_rows(leaf, lo, hi):
+        s_lo, s_hi = layout.row_span(sh)
+        a, b = max(lo, s_lo), min(hi, s_hi)
+        if a >= b:
+            continue
+        src = np.frombuffer(
+            byte_view(shard_src(sh)), dtype, count=(s_hi - s_lo) * tail
+        ).reshape((s_hi - s_lo,) + shape[1:])
+        block = src[a - s_lo : b - s_lo]
+        if rest:
+            block = block[(slice(None),) + rest]
+        if out is None:
+            out = np.empty((hi - lo,) + block.shape[1:], dtype)
+        out[a - lo : b - lo] = block
+    if out is None:
+        raise TransferError(
+            f"no shards cover rows [{lo}, {hi}) of leaf {leaf}"
+        )
+    return out
 
 
 class ReplicaIngest:
@@ -712,17 +892,24 @@ def _pull_from_peer(
     peer_rank: int,
     step: int,
     shards: List[Shard],
-    bufs: Dict[int, np.ndarray],
+    bufs: Optional[Dict[int, np.ndarray]],
     reference: Dict[int, int],
     *,
     chunk_bytes: int,
     timeout: float,
     chaos,
+    regions: Optional[Callable[[Shard, int, int], memoryview]] = None,
 ) -> Tuple[List[Shard], List[Shard], int, int]:
     """Pull ``shards`` from one peer.  Returns (ok, failed,
     bytes_received, chunks).  Never raises: a dead/slow/torn peer
     costs only its unfinished shards — they go back to the pool and
-    the engine reassigns them to the next holder."""
+    the engine reassigns them to the next holder.
+
+    Received bytes land in ``bufs`` (full-leaf buffers, indexed by
+    absolute leaf offset) or — when ``regions`` is given — wherever
+    ``regions(shard, rel_offset, length)`` points, which is what lets
+    a shard-only member pull into per-shard buffers without ever
+    allocating a full leaf."""
     ok: List[Shard] = []
     failed: List[Shard] = []
     received = 0
@@ -794,7 +981,10 @@ def _pull_from_peer(
                         f"fabric pull: chunk overruns shard leaf={leaf} "
                         f"off={off} len={length}"
                     )
-                region = byte_view(bufs[leaf])[off : off + length]
+                if regions is not None:
+                    region = regions(s, off - s.offset, length)
+                else:
+                    region = byte_view(bufs[leaf])[off : off + length]
                 _recv_exact(conn, region)
                 if chaos is not None and not lost_due:
                     # chaos[fabric.peer.lost]: the peer dies mid-pull
@@ -924,17 +1114,31 @@ def replicate_to_buddies(
 ) -> dict:
     """Offer this member's owned shards to their buddy replicas.
     Buddies decline shards they already hold, so the common
-    collective-flush case moves zero payload bytes.  Best-effort: an
-    unreachable buddy is skipped (the next flush re-offers).  Returns
-    a summary dict for the ``fabric.replicate`` journal entry."""
+    collective-flush case moves zero payload bytes.  An unreachable
+    buddy is skipped wire-wise (the next flush re-offers), but the
+    summary now ACCOUNTS for it: ``underreplicated`` counts owned
+    shards that did not reach every ring buddy (a declined offer IS an
+    ack — the buddy already holds the bytes), which is what lets the
+    flush path enforce ``EDL_FABRIC_K`` instead of treating it as
+    advisory.  Returns a summary dict for the ``fabric.replicate``
+    journal entry."""
     offers: Dict[int, List[Tuple[int, int, int, int, Any]]] = {}
-    for s in layout.owned_by(my_rank):
+    owned = layout.owned_by(my_rank)
+    #: per-shard ring-buddy targets (K enforced against these; a buddy
+    #: with no known address can never ack, so it counts as expected
+    #: and missing — losing a peer's address IS under-replication)
+    expected: Dict[int, int] = {}
+    acks: Dict[int, int] = {}
+    for s in owned:
         src = shard_source(s)
         if src is None:
             continue
         buf, crc = src
-        for buddy in layout.holders(s)[1:]:
-            if buddy == my_rank or buddy not in peer_addrs:
+        buddies = [b for b in layout.holders(s)[1:] if b != my_rank]
+        expected[s.index] = len(buddies)
+        acks[s.index] = 0
+        for buddy in buddies:
+            if buddy not in peer_addrs:
                 continue
             offers.setdefault(buddy, []).append(
                 (s.leaf, s.offset, s.length, crc, buf)
@@ -946,12 +1150,14 @@ def replicate_to_buddies(
         "bytes": 0,
         "peers": sorted(offers),
         "dropped": 0,
+        "underreplicated": 0,
     }
     for buddy, items in offers.items():
         if chaos is not None and list(chaos.due("fabric.replica.lost")):
             # chaos[fabric.replica.lost]: the push never reaches the
-            # buddy (network partition, buddy OOM) — replication is
-            # best-effort and the next flush re-offers.
+            # buddy (network partition, buddy OOM) — the next flush
+            # re-offers, and the ack accounting below reports the
+            # window where K is not met.
             summary["dropped"] += len(items)
             continue
         try:
@@ -966,12 +1172,388 @@ def replicate_to_buddies(
             )
             summary["accepted"] += accepted
             summary["bytes"] += sent
+            # A completed OFFER session acks every item in it: the
+            # buddy either stored the shard or declined it because it
+            # already holds those bytes — both leave the ring covered.
+            by_range = {
+                (s.leaf, s.offset, s.length): s.index for s in owned
+            }
+            for leaf, off, length, _crc, _buf in items:
+                idx = by_range.get((leaf, off, length))
+                if idx is not None:
+                    acks[idx] = acks.get(idx, 0) + 1
         except (OSError, TransferError, struct.error):
             # An unreachable buddy — or one that closed the connection
             # mid-offer (e.g. parking for a scale-down) — is skipped;
             # the next flush re-offers.
             summary["dropped"] += len(items)
+    summary["underreplicated"] = sum(
+        1 for idx, want in expected.items() if acks.get(idx, 0) < want
+    )
     return summary
+
+
+def _record_degrade(step: int, dropped: int, reason: str) -> None:
+    """Journal a world-consistent coverage degrade LOUDLY: the
+    agreement proved ``step`` unrestorable (coverage below what the
+    ring promised) and every member is dropping it together so the
+    retry lands on the newest fully-covered step instead of
+    livelocking.  Silence here is how an advisory K rots into data
+    loss nobody noticed."""
+    from edl_tpu import telemetry
+
+    telemetry.get_recorder().record(
+        "fabric.degrade",
+        {"dropped_shards": int(dropped), "reason": reason},
+        step=int(step),
+    )
+
+
+# ---------------------------------------------------------------------------
+# shard-only residency: agree + pull ONLY the shards a member must hold
+# ---------------------------------------------------------------------------
+
+
+def shard_restore(
+    fabric,
+    template_leaves: Sequence[Any],
+    resident: ShardReplicaStore,
+    *,
+    rows: Optional[Sequence[int]] = None,
+    k: int = 1,
+    shard_bytes: int = DEFAULT_SHARD_BYTES,
+    want: Optional[Sequence[int]] = None,
+    server: Optional[FabricServer] = None,
+    chunk_bytes: int = DEFAULT_SHARD_BYTES,
+    timeout: float = 120.0,
+    chaos=None,
+    max_streams: int = 8,
+) -> TransferResult:
+    """Shard-RESIDENT restore: every member ends holding exactly its
+    ``want`` shards (default: own GSPMD slice + K ring-buddy shards,
+    ``ShardLayout.wanted``) — never a full leaf, never a full state.
+
+    This is the cluster-memory restore the shard-only host plane runs
+    on: the collective agreement is the same gather shape as
+    ``fabric_restore`` (every member advertises the per-shard crc
+    vector of its resident bytes), the reference digests come from the
+    union of advertisements (no full-checkpoint authority exists
+    anywhere by design), and the pull lands in PER-SHARD buffers via
+    ``_pull_from_peer``'s region hook, so a joiner's peak host bytes
+    are own-slice + K-buddy + the in-flight shard — not the state.
+
+    Coverage below the ring's promise degrades loudly and
+    world-consistently: any shard with NO advertiser at the agreed
+    step makes every member drop that step from its resident store and
+    raise ``TransferError`` — the caller's hold-and-retry re-agrees at
+    the newest fully-covered step (the killed-buddy discipline; a
+    livelock on identical partial inputs is the failure mode this
+    buys out of).  Every member of the world must call this in the
+    same window (two collectives: agree + confirm)."""
+    t0 = time.perf_counter()
+    sizes = _leaf_sizes(template_leaves)
+    n = len(sizes)
+    layout = ShardLayout.build(
+        sizes, fabric.world, k=k, shard_bytes=shard_bytes, rows=rows
+    )
+    m = len(layout.shards)
+    me = fabric.rank
+    want_idx = sorted(
+        set(layout.wanted(me)) if want is None else {int(s) for s in want}
+    )
+
+    adv_step = resident.newest_step()
+    have = adv_step >= 0
+
+    vec = np.full(_SUMMARY_HDR + n + m, _NO_LEAF, np.int64)
+    vec[0] = _MSG_FABRIC_AGREE
+    vec[1] = 1 if have else 0
+    vec[2] = adv_step if have else -1
+    vec[3] = -1  # shard-only members never hold a full-state digest
+    vec[4] = _ip_to_int(getattr(fabric, "advertise_host", "127.0.0.1"))
+
+    ephemeral = None
+    if server is None:
+
+        def lookup(step, leaf, offset, length):
+            return resident.get(step, leaf, offset, length)
+
+        ephemeral = FabricServer(
+            lookup,
+            ingest=ReplicaIngest(resident, lambda *a: False),
+            timeout=timeout,
+            chaos=chaos,
+        ).start()
+        server = ephemeral
+    vec[5] = server.port if server is not None else 0
+
+    by_range = {
+        (s.leaf, s.offset, s.length): s.index for s in layout.shards
+    }
+    if have:
+        for leaf, off, length, crc in resident.shards_at(adv_step):
+            idx = by_range.get((leaf, off, length))
+            if idx is not None:
+                vec[_SUMMARY_HDR + n + idx] = int(crc)
+
+    pull_sent0 = server.pull_bytes_sent if server is not None else 0
+
+    def cleanup():
+        if ephemeral is not None:
+            ephemeral.stop()
+
+    try:
+        world = _gather(fabric, vec, _MSG_FABRIC_AGREE)
+    except TransferError:
+        cleanup()
+        raise
+    W = world.shape[0]
+    haves, steps = world[:, 1], world[:, 2]
+    peer_addrs = {
+        r: (_int_to_ip(world[r, 4]), int(world[r, 5]))
+        for r in range(W)
+        if int(world[r, 5]) > 0
+    }
+
+    if not haves.any():
+        cleanup()
+        return TransferResult(
+            stats=TransferStats(mode="init"), peer_addrs=peer_addrs
+        )
+
+    agreed = int(steps.max())
+    at_step = [r for r in range(W) if haves[r] and int(steps[r]) == agreed]
+    shard_adv = world[:, _SUMMARY_HDR + n :]
+    order = sorted(at_step)
+    reference: List[int] = []
+    for s in range(m):
+        # Owner-first reference: the rank whose GSPMD slice the shard
+        # belongs to is the natural authority when it advertised; any
+        # other advertiser otherwise (deterministic: lowest rank).
+        own = layout.owner(layout.shards[s])
+        ranked = [own] + [r for r in order if r != own]
+        reference.append(
+            next(
+                (
+                    int(shard_adv[r, s])
+                    for r in ranked
+                    if r in at_step and int(shard_adv[r, s]) != _NO_LEAF
+                ),
+                _NO_LEAF,
+            )
+        )
+    gap = [s for s in range(m) if reference[s] == _NO_LEAF]
+    if gap:
+        cleanup()
+        dropped = resident.drop_step(agreed) if adv_step == agreed else 0
+        _record_degrade(
+            agreed, dropped, f"{len(gap)} shard(s) with no holder"
+        )
+        raise TransferError(
+            f"fabric shard restore: {len(gap)} shard(s) have no holder "
+            f"at the agreed step {agreed} (first: shard {min(gap)}); "
+            "coverage below the replication promise — degrading to the "
+            "newest fully-covered step"
+        )
+    holders: List[List[int]] = [
+        [r for r in at_step if int(shard_adv[r, s]) == reference[s]]
+        for s in range(m)
+    ]
+
+    stats = TransferStats(mode="fabric", source_rank=min(at_step), step=agreed)
+    #: shards I must hold but whose resident bytes are absent or
+    #: mismatch the agreed reference
+    mine: List[int] = []
+    for s in want_idx:
+        sh = layout.shards[s]
+        crc = resident.crc(agreed, sh.leaf, sh.offset, sh.length)
+        if crc is None or crc != reference[s]:
+            mine.append(s)
+    stats.bytes_scheduled = sum(layout.shards[s].length for s in mine)
+    stats.leaves_skipped = len(want_idx) - len(mine)
+
+    my_ok = True
+    fail_reason = ""
+    per_peer: Dict[str, int] = {}
+    if mine:
+        #: per-shard destination buffers — the ONLY assembly memory
+        #: this path ever allocates (never a leaf, never the state)
+        shard_bufs: Dict[int, np.ndarray] = {
+            s: np.empty(layout.shards[s].length, np.uint8) for s in mine
+        }
+
+        def regions(sh: Shard, rel: int, length: int) -> memoryview:
+            return memoryview(shard_bufs[sh.index])[rel : rel + length]
+
+        pending: Dict[int, Shard] = {s: layout.shards[s] for s in mine}
+        tried: Dict[int, set] = {s: set() for s in mine}
+        dead_peers: set = set()
+
+        def eligible(s_idx: int) -> List[int]:
+            sh = layout.shards[s_idx]
+            ladder = [r for r in layout.holders(sh) if r in holders[s_idx]]
+            ladder += [r for r in holders[s_idx] if r not in ladder]
+            return [
+                r
+                for r in ladder
+                if r != me
+                and r not in tried[s_idx]
+                and r not in dead_peers
+                and r in peer_addrs
+            ]
+
+        first_round = True
+        while pending and my_ok:
+            groups: Dict[int, List[Shard]] = {}
+            load: Dict[int, int] = {}
+            stuck = False
+            for s_idx in sorted(pending):
+                cands = eligible(s_idx)
+                if not cands:
+                    stuck = True
+                    break
+                sh = pending[s_idx]
+                owner = layout.owner(sh)
+                peer = min(
+                    cands,
+                    key=lambda r: (
+                        load.get(r, 0),
+                        0 if r == owner else 1,
+                        r,
+                    ),
+                )
+                load[peer] = load.get(peer, 0) + sh.length
+                groups.setdefault(peer, []).append(sh)
+            if stuck:
+                my_ok = False
+                fail_reason = "a wanted shard exhausted every holder"
+                break
+            if not first_round:
+                stats.shard_fallbacks += sum(len(v) for v in groups.values())
+            first_round = False
+            results: List[tuple] = []
+            res_lock = threading.Lock()
+
+            def pull(peer, shards_for_peer):
+                out = _pull_from_peer(
+                    peer_addrs[peer],
+                    me,
+                    peer,
+                    agreed,
+                    shards_for_peer,
+                    None,
+                    {s: reference[s] for s in mine},
+                    chunk_bytes=chunk_bytes,
+                    timeout=timeout,
+                    chaos=chaos,
+                    regions=regions,
+                )
+                with res_lock:
+                    results.append((peer, out))
+
+            peers_now = sorted(groups)
+            for wave_at in range(0, len(peers_now), max(1, max_streams)):
+                if not my_ok:
+                    break
+                wave = peers_now[wave_at : wave_at + max(1, max_streams)]
+                threads = [
+                    threading.Thread(
+                        target=pull,
+                        args=(p, groups[p]),
+                        daemon=True,
+                        name=f"edl-fabric-pull-r{p}",
+                    )
+                    for p in wave
+                ]
+                for t in threads:
+                    t.start()
+                deadline = time.monotonic() + timeout + 30
+                for t in threads:
+                    t.join(max(0.0, deadline - time.monotonic()))
+                    if t.is_alive():
+                        my_ok = False
+                        fail_reason = "a pull stream hung past timeout"
+            for peer, (ok_shs, failed_shs, rec, chs) in results:
+                stats.bytes_received += rec
+                stats.chunks_received += chs
+                if rec:
+                    per_peer[str(peer)] = per_peer.get(str(peer), 0) + rec
+                for sh in ok_shs:
+                    if sh.index not in pending:
+                        continue
+                    del pending[sh.index]
+                    # Adoption is immediate and crc-gated: the pulled
+                    # buffer becomes resident the moment its chained
+                    # crc matched the reference.
+                    resident.put(
+                        agreed,
+                        sh.leaf,
+                        sh.offset,
+                        sh.length,
+                        shard_bufs.pop(sh.index),
+                        reference[sh.index],
+                    )
+                    stats.leaves_received += 1
+                for sh in failed_shs:
+                    tried[sh.index].add(peer)
+                if failed_shs and not ok_shs and rec == 0:
+                    dead_peers.add(peer)
+    stats.per_peer = per_peer
+
+    # -- world-consistent verdict -------------------------------------------
+    vec2 = np.zeros(_SUMMARY_HDR + n + m, np.int64)
+    vec2[0] = _MSG_FABRIC_CONFIRM
+    vec2[1] = 1 if my_ok else 0
+    try:
+        ok_col = _gather(fabric, vec2, _MSG_FABRIC_CONFIRM)[:, 1]
+    finally:
+        if server is not None:
+            stats.bytes_sent = server.pull_bytes_sent - pull_sent0
+        cleanup()
+    if not ok_col.all():
+        bad = [r for r in range(len(ok_col)) if not ok_col[r]]
+        mine_msg = f" (this member: {fail_reason})" if fail_reason else ""
+        raise TornTransferError(
+            f"fabric shard restore: member(s) {bad} could not reach "
+            f"their resident coverage{mine_msg}: no member adopts; "
+            "resize retries"
+        )
+    stats.seconds = time.perf_counter() - t0
+
+    from edl_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    if stats.bytes_sent:
+        reg.counter("edl_fabric_bytes_sent_total").inc(stats.bytes_sent)
+    if stats.bytes_received:
+        reg.counter("edl_fabric_bytes_received_total").inc(
+            stats.bytes_received
+        )
+    if stats.per_peer:
+        reg.gauge("edl_fabric_pull_peers").set(len(stats.per_peer))
+    if stats.shard_fallbacks:
+        reg.counter("edl_fabric_shard_fallbacks_total").inc(
+            stats.shard_fallbacks
+        )
+    reg.gauge("edl_fabric_resident_bytes").set(resident.nbytes())
+    reg.histogram("edl_fabric_pull_seconds").observe(stats.seconds)
+    telemetry.get_recorder().record(
+        "fabric.pull",
+        {
+            "mode": "shard_only",
+            "step": stats.step,
+            "bytes_received": stats.bytes_received,
+            "bytes_sent": stats.bytes_sent,
+            "peers": sorted(stats.per_peer or ()),
+            "shard_fallbacks": stats.shard_fallbacks,
+            "wanted": len(want_idx),
+            "pulled": len(mine),
+            "resident_bytes": resident.nbytes(),
+        },
+        step=stats.step,
+        timing={"seconds": round(stats.seconds, 6)},
+    )
+    return TransferResult(stats=stats, peer_addrs=peer_addrs)
 
 
 # ---------------------------------------------------------------------------
@@ -1285,8 +1867,10 @@ def _fabric_restore(
         member's replica bytes at that step (every member reaches
         this from the same matrix, so all drop together) and the
         retry degrades to the newest FULL checkpoint step."""
+        dropped = 0
         if replica_store is not None and rep_step == agreed:
-            replica_store.drop_step(agreed)
+            dropped = replica_store.drop_step(agreed)
+        _record_degrade(agreed, dropped, "coverage gap at agreed step")
 
     if not needs:
         cleanup()

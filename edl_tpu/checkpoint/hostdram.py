@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -80,6 +81,104 @@ def _spill_shard_layout(ckpt):
         shard_bytes=deployment_shard_bytes(),
         rows=leaf_rows(ckpt.leaves),
     )
+
+
+#: shard-only durable spill naming: each fabric rank writes ONLY its
+#: owned shards (``ckpt-<step>.shard-r<rank>.{json,npz}``); the union
+#: across ranks is the durable full state.  Kept distinct from the
+#: full-copy ``ckpt-<step>.{json,npz}`` family so mixed dirs (rolling
+#: upgrade to shard-only) load either.
+_SHARD_SPILL_RE = re.compile(r"^ckpt-(\d{12})\.shard-r(\d+)\.json$")
+
+
+def scan_shard_spills(spill_dir: str) -> Dict[int, Dict[int, str]]:
+    """step -> {fabric rank -> manifest filename} for every shard-only
+    spill in ``spill_dir``."""
+    out: Dict[int, Dict[int, str]] = {}
+    try:
+        names = os.listdir(spill_dir)
+    except OSError:
+        return out
+    for f in names:
+        m = _SHARD_SPILL_RE.match(f)
+        if m:
+            out.setdefault(int(m.group(1)), {})[int(m.group(2))] = f
+    return out
+
+
+def newest_covered_shard_step(spill_dir: str) -> Optional[tuple]:
+    """``(step, {rank: (name, manifest)})`` for the NEWEST step whose
+    rank manifests together cover every shard index — the shard-only
+    analogue of "the newest intact full spill".  Coverage is judged
+    from the manifests alone (each records its indices and the total
+    shard count), so no template or byte read is needed to pick the
+    step.  None when no complete set exists (e.g. a rank's spill was
+    torn mid-write: that step is skipped, an older covered one
+    loads)."""
+    by_step = scan_shard_spills(spill_dir)
+    for step in sorted(by_step, reverse=True):
+        mans: Dict[int, tuple] = {}
+        covered: set = set()
+        total = None
+        ok = True
+        for rank, name in by_step[step].items():
+            try:
+                with open(os.path.join(spill_dir, name)) as f:
+                    man = json.load(f)
+            except (OSError, ValueError):
+                ok = False
+                break
+            mans[rank] = (name, man)
+            covered.update(int(i) for i in man.get("indices", ()))
+            n = int(man.get("n_shards", -1))
+            if total is None:
+                total = n
+            elif total != n:
+                ok = False  # mixed shard granularities: not one set
+                break
+        if ok and total is not None and covered >= set(range(total)):
+            return step, mans
+    return None
+
+
+def load_shard_spill_bytes(
+    spill_dir: str,
+    mans: Dict[int, tuple],
+    want: Optional[set] = None,
+) -> tuple:
+    """``({shard index: uint8 array}, {shard index: crc})`` read from a
+    shard-spill manifest set.  ``want`` restricts to those indices (a
+    shard-only member loads just its own slice + K buddy shards — the
+    cold-start memory contract); None loads all.  Every shard read is
+    CRC-checked against its manifest digest, so a torn/bit-rotted
+    spill localizes to ONE shard and raises rather than restoring."""
+    import zlib
+
+    out: Dict[int, np.ndarray] = {}
+    crcs: Dict[int, int] = {}
+    for rank in sorted(mans):
+        name, man = mans[rank]
+        idxs = [int(i) for i in man.get("indices", ())]
+        need = [
+            i for i in idxs if (want is None or i in want) and i not in out
+        ]
+        if not need:
+            continue
+        digs = {
+            int(i): int(d) for i, d in zip(idxs, man.get("digests", ()))
+        }
+        npz_path = os.path.join(spill_dir, name[: -len(".json")] + ".npz")
+        with np.load(npz_path) as z:
+            for i in need:
+                arr = np.asarray(z[f"s_{i}"], np.uint8)
+                if zlib.crc32(arr) != digs.get(i):
+                    raise RuntimeError(
+                        f"shard {i} in {npz_path} failed CRC "
+                        "verification (torn/bit-rotted shard spill)"
+                    )
+                out[i] = arr
+                crcs[i] = digs[i]
+    return out, crcs
 
 
 def leaf_placer(mesh: Mesh):
@@ -397,15 +496,33 @@ class HostDRAMStore:
         keep: int = 2,
         spill_dir: Optional[str] = None,
         chaos=None,
+        shard_only: bool = False,
     ):
         """``chaos``: optional ``edl_tpu.chaos.FaultSchedule``; when set
         the save worker and the spill path consult their named
         injection points (``checkpoint.save_thread``,
         ``checkpoint.spill``).  None in production — one branch per
-        save, no other cost."""
+        save, no other cost.
+
+        ``shard_only``: cluster-memory residency (EDL_SHARD_ONLY).  Once
+        ``bind_fabric`` supplies the fabric topology, this member keeps
+        only its own GSPMD slice plus its K ring-buddy shards resident
+        (in the fabric's ``ShardReplicaStore``) instead of full
+        checkpoints: flushes trim to shards after stage B, spills write
+        only owned shards, and cold starts seed the resident store from
+        the shard-spill union — host DRAM per member is (1+K)/world of
+        state, so aggregate cluster memory, not one host, caps model
+        size.  Until bound it behaves exactly like the full store
+        (single-process/test runs never lose the fast path)."""
         self.keep = keep
         self.spill_dir = spill_dir
         self.chaos = chaos
+        self.shard_only = bool(shard_only)
+        #: fabric topology (rank/world/k/shard_bytes) + the resident
+        #: ShardReplicaStore — set by bind_fabric(); rebound on every
+        #: resize (boundaries are world-independent, ownership is not)
+        self._fab: Optional[dict] = None
+        self._resident = None
         # Default-on telemetry (edl_tpu.telemetry): saves/flushes land
         # in the metrics registry and the flight recorder.  The journal
         # entry is written on the CALLER thread at submission so a
@@ -577,6 +694,139 @@ class HostDRAMStore:
             for s in extra:
                 del self._checkpoints[s]
 
+    # -- shard-only residency (cluster-memory checkpoints) -------------------
+    def bind_fabric(self, rank: int, world: int, *, k: int, shard_bytes: int, resident) -> None:
+        """Bind the fabric topology that defines WHICH shard ranges
+        this member keeps resident.  ``resident`` is the fabric's
+        ``ShardReplicaStore`` — the SAME one the member's FabricServer
+        serves pulls from, so trimming a full checkpoint down to
+        resident shards keeps this member a first-class fabric source
+        (peers, joiners, and the serving swap poll all read it through
+        the one lookup path)."""
+        self._fab = {
+            "rank": int(rank),
+            "world": int(world),
+            "k": int(k),
+            "shard_bytes": int(shard_bytes),
+        }
+        self._resident = resident
+
+    def shard_only_active(self) -> bool:
+        return (
+            self.shard_only
+            and self._fab is not None
+            and self._resident is not None
+        )
+
+    def resident_nbytes(self) -> int:
+        """Bytes held in the shard-resident store — the number the
+        (1+K)/world memory contract bounds."""
+        return int(self._resident.nbytes()) if self._resident is not None else 0
+
+    def _fab_layout(self, leaves):
+        """The bound deployment's shard table over ``leaves`` (abstract
+        or materialized — only shapes/nbytes are read)."""
+        from edl_tpu.checkpoint.fabric import (
+            ShardLayout,
+            leaf_nbytes,
+            leaf_rows,
+        )
+
+        fab = self._fab
+        return ShardLayout.build(
+            [leaf_nbytes(l) for l in leaves],
+            max(1, fab["world"]),
+            k=fab["k"],
+            shard_bytes=fab["shard_bytes"],
+            rows=leaf_rows(leaves),
+        )
+
+    def trim_to_shards(self, step: int) -> int:
+        """Drop a full checkpoint down to this member's resident shard
+        ranges (own GSPMD slice + K ring-buddy shards) and evict the
+        full copy from the store.  The shard copies are real (not
+        views), so the full leaves free as soon as in-flight references
+        drop — a restore window holding the returned flush checkpoint
+        keeps it alive exactly as long as it is used.  Returns bytes
+        adopted (0 when not shard-only bound or the step is absent).
+        Every member of a collective flush self-adopts its OWN wanted
+        ranges from its transient full copy, so K-replication of a
+        healthy flush costs zero wire — the buddy offer round then
+        declines everything."""
+        if not self.shard_only_active():
+            return 0
+        with self._lock:
+            ckpt = self._checkpoints.get(step)
+        if ckpt is None:
+            return 0
+        from edl_tpu.checkpoint.fabric import adopt_resident
+
+        layout = self._fab_layout(ckpt.leaves)
+        crcs = None
+        cached = ckpt._shard_digests
+        if cached is not None and cached[0] == layout.key():
+            crcs = cached[1]
+        adopted = adopt_resident(
+            self._resident,
+            ckpt.leaves,
+            layout,
+            self._fab["rank"],
+            int(step),
+            crcs=crcs,
+        )
+        with self._lock:
+            if self._checkpoints.get(step) is ckpt:
+                del self._checkpoints[step]
+        from edl_tpu import telemetry
+
+        telemetry.get_registry().gauge("edl_fabric_resident_bytes").set(
+            self._resident.nbytes()
+        )
+        return adopted
+
+    def load_shards_from_disk(self, template_state) -> Optional[dict]:
+        """Shard-only cold start: seed the RESIDENT store with this
+        member's wanted shard ranges from the newest fully-covered
+        shard-spill set — no process materializes full state.  Returns
+        ``{step, generation, bytes, shards}`` or None when the durable
+        dir holds no complete shard set.  The member then enters the
+        fabric agreement as a replica-only holder; the restore engine
+        assembles device slices from resident shards."""
+        if not self.spill_dir or not self.shard_only_active():
+            return None
+        found = newest_covered_shard_step(self.spill_dir)
+        if found is None:
+            return None
+        step, mans = found
+        leaves_abs, _ = jax.tree_util.tree_flatten(template_state)
+        layout = self._fab_layout(leaves_abs)
+        any_man = next(iter(mans.values()))[1]
+        from edl_tpu.checkpoint.fabric import leaf_nbytes
+
+        if int(any_man.get("n_shards", -1)) != len(layout.shards) or [
+            int(b) for b in any_man.get("leaf_nbytes", ())
+        ] != [leaf_nbytes(l) for l in leaves_abs]:
+            raise RuntimeError(
+                f"durable shard spills in {self.spill_dir} do not match "
+                "the model's leaf schema (different model or shard "
+                "granularity?); refusing to silently restart from step 0"
+            )
+        want = set(layout.wanted(self._fab["rank"]))
+        blobs, crcs = load_shard_spill_bytes(self.spill_dir, mans, want=want)
+        adopted = 0
+        for i, arr in blobs.items():
+            s = layout.shards[i]
+            if self._resident.put(
+                int(step), s.leaf, s.offset, s.length, arr, crcs[i]
+            ):
+                adopted += int(arr.nbytes)
+        return {
+            "step": int(step),
+            "generation": int(any_man.get("generation", 0)),
+            "bytes": adopted,
+            "shards": len(blobs),
+        }
+
     def save_async(self, state, generation: int = 0) -> threading.Thread:
         """Snapshot ``state`` (a pytree of jax Arrays) into host DRAM.
 
@@ -648,6 +898,13 @@ class HostDRAMStore:
                 )
                 if self.spill_dir:
                     self._spill(ckpt)
+                if self.shard_only_active():
+                    # Interval saves honor the memory contract too: a
+                    # collective save lands the same step on EVERY
+                    # member, so each self-adopting its wanted ranges
+                    # K-covers the ring with zero wire — then the full
+                    # copy drops.
+                    self.trim_to_shards(ckpt.step)
             except BaseException as e:  # pragma: no cover - defensive
                 with self._lock:
                     self._save_errors.append((save_id, e))
@@ -795,6 +1052,22 @@ class HostDRAMStore:
                         import traceback
 
                         traceback.print_exc()
+                if self.shard_only_active():
+                    # Trim AFTER stage B: the fabric hook joins its
+                    # buddy replication in shard-only mode, so the full
+                    # copy is never dropped before K buddies ack (an
+                    # under-replicated flush keeps its resident shards
+                    # either way — the journal + counter make the K gap
+                    # loud instead of silent).  The resize window's
+                    # reference to the returned checkpoint keeps the
+                    # leaves alive exactly as long as the restore uses
+                    # them.
+                    try:
+                        self.trim_to_shards(ckpt.step)
+                    except Exception:  # pragma: no cover - defensive
+                        import traceback
+
+                        traceback.print_exc()
                 th.edl_seconds = time.perf_counter() - t1
                 with self._lock:
                     self._inflight_steps.discard(step_val)
@@ -937,6 +1210,12 @@ class HostDRAMStore:
 
     # -- disk spill (durability; not on the resize fast path) ---------------
     def _spill(self, ckpt: HostCheckpoint):
+        if self.shard_only_active():
+            # Cluster-memory durability: this rank writes ONLY its
+            # owned shards; the union across ranks is the durable full
+            # state, so spill I/O per member is 1/world of state
+            # instead of world identical full copies.
+            return self._spill_shards(ckpt)
         if self.chaos is not None:
             # chaos[checkpoint.spill]: durable-volume I/O error (full
             # disk, detached PD) — surfaces through _save_errors while
@@ -991,7 +1270,9 @@ class HostDRAMStore:
             names = sorted(
                 f
                 for f in os.listdir(self.spill_dir)
-                if f.endswith(".json") and ".tmp." not in f
+                if f.endswith(".json")
+                and ".tmp." not in f
+                and ".shard-r" not in f
             )
             for name in names[: -self.keep]:
                 base = os.path.join(self.spill_dir, name[: -len(".json")])
@@ -1000,6 +1281,78 @@ class HostDRAMStore:
                         os.unlink(base + suffix)
                     except OSError:
                         pass
+        except OSError:  # pragma: no cover - listdir race
+            pass
+
+    def _spill_shards(self, ckpt: HostCheckpoint) -> None:
+        """Shard-only durable spill: ``ckpt-<step>.shard-r<rank>.npz``
+        holds this rank's OWNED shard bytes (one ``s_<index>`` uint8
+        entry per shard), the manifest records indices, per-shard
+        digests, and the full shard-digest vector (a cold start
+        re-seeds the fabric agreement without a hash pass).  Writes are
+        tmp + atomic rename, same discipline as the full spill."""
+        from edl_tpu.checkpoint.fabric import byte_view
+
+        if self.chaos is not None:
+            # chaos[checkpoint.spill]: same injection point as the full
+            # spill — a durable-volume fault surfaces identically.
+            self.chaos.maybe_raise("checkpoint.spill", OSError)
+        os.makedirs(self.spill_dir, exist_ok=True)
+        fab = dict(self._fab)
+        layout = self._fab_layout(ckpt.leaves)
+        digs = ckpt.shard_digests(layout)
+        owned = layout.owned_by(fab["rank"])
+        with self._lock:
+            self._tmp_counter += 1
+            tag = f"{os.getpid()}-{self._tmp_counter}"
+        path = os.path.join(
+            self.spill_dir,
+            f"ckpt-{ckpt.step:012d}.shard-r{fab['rank']:04d}",
+        )
+        arrays = {}
+        for s in owned:
+            view = byte_view(ckpt.leaves[s.leaf])[
+                s.offset : s.offset + s.length
+            ]
+            arrays[f"s_{s.index}"] = np.frombuffer(view, np.uint8)
+        tmp_npz = f"{path}.{tag}.tmp.npz"
+        np.savez(tmp_npz, **arrays)
+        os.replace(tmp_npz, path + ".npz")
+        manifest = {
+            "shard_only": True,
+            "step": ckpt.step,
+            "generation": ckpt.generation,
+            "created_at": ckpt.created_at,
+            "rank": fab["rank"],
+            "world": fab["world"],
+            "k": fab["k"],
+            "shard_bytes": layout.shard_bytes,
+            "n_leaves": len(ckpt.leaves),
+            "leaf_nbytes": [int(l.nbytes) for l in ckpt.leaves],
+            "n_shards": len(layout.shards),
+            "indices": [int(s.index) for s in owned],
+            "digests": [int(digs[s.index]) for s in owned],
+            "shard_digests": [int(d) for d in digs],
+        }
+        tmp_json = f"{path}.{tag}.tmp.json"
+        with open(tmp_json, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp_json, path + ".json")
+        # Retention by STEP across the whole shard family (each step
+        # has one file pair per rank); best-effort under concurrent
+        # pruning peers, like the full spill's.
+        try:
+            by_step = scan_shard_spills(self.spill_dir)
+            for s in sorted(by_step)[: -self.keep]:
+                for name in by_step[s].values():
+                    base = os.path.join(
+                        self.spill_dir, name[: -len(".json")]
+                    )
+                    for suffix in (".json", ".npz"):
+                        try:
+                            os.unlink(base + suffix)
+                        except OSError:
+                            pass
         except OSError:  # pragma: no cover - listdir race
             pass
 
@@ -1026,7 +1379,9 @@ class HostDRAMStore:
             names = sorted(
                 f
                 for f in os.listdir(self.spill_dir)
-                if f.endswith(".json") and ".tmp." not in f
+                if f.endswith(".json")
+                and ".tmp." not in f
+                and ".shard-r" not in f
             )
             if step is None:
                 intact = [n for n in names if n not in corrupt]
@@ -1038,6 +1393,16 @@ class HostDRAMStore:
                             "(corrupt volume?); refusing to silently "
                             "restart from step 0"
                         )
+                    # No full spill — a shard-only deployment's durable
+                    # dir holds per-rank shard spills instead: assemble
+                    # the union (full-copy consumers of a shard-only
+                    # dir, e.g. a non-shard-only member or the serving
+                    # engine's compat path).
+                    ckpt = self._load_full_from_shard_spills(
+                        template_state, treedef
+                    )
+                    if ckpt is not None:
+                        return ckpt
                     raise FileNotFoundError(
                         f"no checkpoints in {self.spill_dir}"
                     )
@@ -1140,6 +1505,74 @@ class HostDRAMStore:
             corrupt.add(name)
         with self._lock:
             self._checkpoints[ckpt.step] = ckpt
+        return ckpt
+
+    def _load_full_from_shard_spills(
+        self, template_state, treedef
+    ) -> Optional[HostCheckpoint]:
+        """Assemble a FULL checkpoint from a shard-spill union — the
+        compatibility path for consumers that need whole leaves from a
+        shard-only durable dir (each shard read is CRC-gated, so a torn
+        rank spill fails loudly and localized).  Shard-only members
+        never take this path; they seed residency via
+        ``load_shards_from_disk`` instead."""
+        found = newest_covered_shard_step(self.spill_dir)
+        if found is None:
+            return None
+        step, mans = found
+        from edl_tpu.checkpoint.fabric import (
+            ShardLayout,
+            byte_view,
+            leaf_nbytes,
+            leaf_rows,
+        )
+
+        leaves_abs, _ = jax.tree_util.tree_flatten(template_state)
+        any_man = next(iter(mans.values()))[1]
+        if int(any_man.get("n_leaves", -1)) != len(leaves_abs) or [
+            int(b) for b in any_man.get("leaf_nbytes", ())
+        ] != [leaf_nbytes(l) for l in leaves_abs]:
+            raise RuntimeError(
+                f"durable shard spills in {self.spill_dir} do not match "
+                "the template's leaf schema (wrong model?); refusing to "
+                "silently restart from step 0"
+            )
+        layout = ShardLayout.build(
+            [leaf_nbytes(l) for l in leaves_abs],
+            max(1, int(any_man.get("world", 1))),
+            k=int(any_man.get("k", 1)),
+            shard_bytes=int(any_man["shard_bytes"]),
+            rows=leaf_rows(leaves_abs),
+        )
+        blobs, _crcs = load_shard_spill_bytes(self.spill_dir, mans)
+        leaves = [
+            np.empty(tuple(l.shape), np.dtype(l.dtype)) for l in leaves_abs
+        ]
+        for i, arr in blobs.items():
+            s = layout.shards[i]
+            byte_view(leaves[s.leaf])[
+                s.offset : s.offset + s.length
+            ] = memoryview(arr)
+        ckpt = HostCheckpoint(
+            step=int(step),
+            generation=int(any_man.get("generation", 0)),
+            leaves=leaves,
+            treedef=treedef,
+            created_at=float(any_man.get("created_at", 0.0)),
+        )
+        sd = any_man.get("shard_digests")
+        if sd is not None and len(sd) == len(layout.shards):
+            ckpt._shard_digests = (layout.key(), [int(d) for d in sd])
+        ckpt.digest()
+        with self._lock:
+            self._checkpoints[ckpt.step] = ckpt
+        import sys
+
+        print(
+            f"[edl] assembled full checkpoint step {step} from "
+            f"{len(mans)} shard spill(s) in {self.spill_dir}",
+            file=sys.stderr,
+        )
         return ckpt
 
 

@@ -95,6 +95,10 @@ def pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
         # instead of recompiling inside the resize window (the launcher
         # pins jax_compilation_cache_dir at it).
         {"name": "EDL_COMPILE_CACHE_DIR", "value": job.spec.compile_cache_dir},
+        # Shard-only host checkpoints: members hold only their own
+        # GSPMD slice + K buddy shards (cluster-memory state; host DRAM
+        # never caps model size), spills are per-rank shard files.
+        {"name": "EDL_SHARD_ONLY", "value": "1" if job.spec.shard_only else "0"},
         # downward API (ref ``:302-312``)
         {
             "name": "EDL_NAMESPACE",

@@ -46,6 +46,11 @@ def env_config() -> dict:
         "checkpoint_dir": e.get("EDL_CHECKPOINT_DIR", ""),
         # persistent XLA compilation cache volume; "" = no cache
         "compile_cache_dir": e.get("EDL_COMPILE_CACHE_DIR", ""),
+        # shard-only host checkpoints: each member's DRAM holds only
+        # its own GSPMD slice + K ring-buddy shards; spills are
+        # per-rank shard files (ElasticRuntime reads the same env var
+        # directly — carried here so operators see the whole contract)
+        "shard_only": e.get("EDL_SHARD_ONLY", "0") == "1",
         # "fsdp=2,tp=2" (jobparser's EDL_PARALLELISM); "" = pure dp.
         "parallelism": e.get("EDL_PARALLELISM", ""),
         "pod_name": e.get("EDL_POD_NAME", ""),

@@ -346,6 +346,15 @@ class TrainingJobSpec:
     #: zero-stall resize (the AOT prewarmer removes compiles from warm
     #: resizes; this removes them from cold ones); "" = no cache.
     compile_cache_dir: str = ""
+    #: shard-only host checkpoints (EDL_SHARD_ONLY): each dp×fsdp
+    #: member's host DRAM holds only its own GSPMD slice plus K
+    #: ring-buddy shards — cluster memory, not any one host's DRAM,
+    #: bounds model size.  Spills become per-rank shard files whose
+    #: union is the durable checkpoint; restores assemble device
+    #: slices from resident/peer shards with NO process materializing
+    #: full state.  Requires the checkpoint fabric (EDL_FABRIC=1, the
+    #: default); False = classic full-copy host checkpoints.
+    shard_only: bool = False
     #: elastic inference serving attached to this job (None = train
     #: only).  Serving replicas load the newest verified checkpoint
     #: from ``checkpoint_dir`` and hot-swap as training writes fresher
@@ -365,6 +374,7 @@ class TrainingJobSpec:
             compile_cache_dir=str(
                 d.get("compile_cache_dir", d.get("compileCacheDir", "")) or ""
             ),
+            shard_only=bool(d.get("shard_only", d.get("shardOnly", False))),
             image=d.get("image", ""),
             port=int(d.get("port", 0)),
             priority=int(d.get("priority", 0)),

@@ -323,6 +323,21 @@ class ElasticTrainer:
         #: their digest vectors stay cache-key-compatible
         self.fabric_shard_bytes: int = deployment_shard_bytes()
         self.fabric_max_streams: int = 8
+        #: shard-only host residency (EDL_SHARD_ONLY=1): each member
+        #: keeps its own GSPMD slice + K ring-buddy shards resident in
+        #: the fabric's replica store instead of full host checkpoints
+        #: — per-member host DRAM is (1+K)/world of state, so aggregate
+        #: cluster memory (not one host) caps model size.  Flushes trim
+        #: to shards after K buddies ack; spills write only owned
+        #: shards; cold starts seed residency from the shard-spill
+        #: union.  Requires the fabric (the resident store IS the
+        #: fabric's serving source).
+        self.shard_only: bool = (
+            self.fabric_enabled
+            and _os.environ.get("EDL_SHARD_ONLY", "0") == "1"
+        )
+        if self.shard_only:
+            self.store.shard_only = True
         #: persistent shard endpoint + buddy-replica store, created on
         #: the first multiprocess restore (never in local/test runs)
         self._fabric_server = None
@@ -1120,6 +1135,30 @@ class ElasticTrainer:
                 # cost (one of the two r5 hash passes the resize window
                 # silently grew).  Dedup'd flushes go through
                 # _latest_or_disk's verify instead (see flushed_fresh).
+                if self.shard_only:
+                    # A single-process world is a 1-member ring: rank 0
+                    # owns every shard.  Bind residency HERE (the
+                    # multiprocess bind never runs) so flushes/saves at
+                    # this world still trim to shards and spill the
+                    # per-rank shard family — a later grown world (or a
+                    # cold restart) reads one durable format, and a
+                    # full-copy spill never leaks out of a shard-only
+                    # deployment.
+                    if self._fabric_replica_store is None:
+                        from edl_tpu.checkpoint.fabric import (
+                            ShardReplicaStore,
+                        )
+
+                        self._fabric_replica_store = ShardReplicaStore(
+                            keep_steps=2
+                        )
+                    self.store.bind_fabric(
+                        0,
+                        1,
+                        k=self.fabric_replicas,
+                        shard_bytes=self.fabric_shard_bytes,
+                        resident=self._fabric_replica_store,
+                    )
                 ckpt = (
                     flushed_fresh
                     if flushed_fresh is not None
@@ -1249,6 +1288,15 @@ class ElasticTrainer:
         ckpt = self.store.latest_verified()
         if ckpt is not None or not self.store.spill_dir:
             return ckpt
+        if self.shard_only and jax.process_count() > 1:
+            # Shard-only members never assemble full state from disk:
+            # the multiprocess restore seeds the RESIDENT store from
+            # the shard-spill union instead (load_shards_from_disk) and
+            # enters the agreement as a replica-only holder.  A
+            # SINGLE-process world is its own union (rank 0 owns every
+            # shard), so it falls through to the full assembly below —
+            # returning None here would silently restart at step 0.
+            return None
         # treedef template from the model's abstract init: no allocation
         # (this runs inside the resize window).
         template = trainer.abstract_state()
@@ -1297,7 +1345,14 @@ class ElasticTrainer:
         )
 
         if self._fabric_replica_store is None:
-            self._fabric_replica_store = ShardReplicaStore()
+            # Shard-only members keep TWO steps resident: an agreement
+            # that degrades to the next-oldest step must still find
+            # those shards locally — with keep_steps=1, adopting the
+            # newer step would have pruned the very step the degrade
+            # falls back to.
+            self._fabric_replica_store = ShardReplicaStore(
+                keep_steps=2 if self.shard_only else 1
+            )
         if self._fabric_server is None:
 
             def has_bytes(step, leaf, offset, length):
@@ -1392,12 +1447,42 @@ class ElasticTrainer:
                 step=int(ckpt.step),
                 generation=int(ckpt.generation),
             )
+            under = int(summary.get("underreplicated", 0))
+            if under > 0:
+                # EDL_FABRIC_K enforcement: an owned shard that did not
+                # reach every ring buddy is a replication-contract
+                # violation, journaled + counted — not advisory.  The
+                # next flush re-offers; until then the operator can see
+                # exactly which steps run thin.
+                from edl_tpu import telemetry
+
+                telemetry.get_registry().counter(
+                    "edl_fabric_underreplicated_total"
+                ).inc(under)
+                self.recorder.record(
+                    "fabric.underreplicated",
+                    {
+                        "step": int(ckpt.step),
+                        "shards": under,
+                        "k": self.fabric_replicas,
+                        "dropped": summary.get("dropped", 0),
+                    },
+                    step=int(ckpt.step),
+                    generation=int(ckpt.generation),
+                )
 
         th = threading.Thread(
             target=replicate, daemon=True, name="edl-fabric-replicate"
         )
         th.start()
         self._fabric_replication = th
+        if self.shard_only:
+            # Shard-only flushes COMPLETE only once K buddies ack (or
+            # the bounded wait expires and the under-replication is
+            # journaled above): the full copy is trimmed right after
+            # this hook returns, so "durable and fingerprinted before
+            # the next step" now includes the ring holding the shards.
+            th.join(self.transfer_timeout)
 
     def _fabric_offer_owned(
         self,
@@ -1455,19 +1540,34 @@ class ElasticTrainer:
         # crc-rejects any shard whose bytes no longer match the
         # offered digest — receiver-side verification covers rot.
         ckpt = self.store.latest()
-        if ckpt is None:
+        rep = self._fabric_replica_store
+        if ckpt is None and (rep is None or rep.newest_step() < 0):
             return
         try:
-            summary = self._fabric_offer_owned(
-                ckpt,
-                world=None,
-                rank=rank,
-                peers=peers,
-                timeout=min(30.0, self.transfer_timeout),
-                generation=self.generation,
-            )
-            rep = self._fabric_replica_store
-            if rep is not None and rep.newest_step() > int(ckpt.step):
+            if ckpt is not None:
+                summary = self._fabric_offer_owned(
+                    ckpt,
+                    world=None,
+                    rank=rank,
+                    peers=peers,
+                    timeout=min(30.0, self.transfer_timeout),
+                    generation=self.generation,
+                )
+            else:
+                # Shard-only victim: no full checkpoint exists anywhere
+                # on this host — its RESIDENT shards (own + buddy-held)
+                # are its whole contribution, re-homed below.
+                summary = {
+                    "step": rep.newest_step(),
+                    "offered": 0,
+                    "accepted": 0,
+                    "bytes": 0,
+                    "peers": [],
+                    "dropped": 0,
+                    "underreplicated": 0,
+                }
+            ckpt_step = int(ckpt.step) if ckpt is not None else -1
+            if rep is not None and rep.newest_step() > ckpt_step:
                 # Buddy-held shards NEWER than our own checkpoint may
                 # be the only surviving copy of a degraded-flush step:
                 # re-home them downstream under THEIR step.
@@ -1491,7 +1591,7 @@ class ElasticTrainer:
             self.recorder.record(
                 "fabric.inherit",
                 summary,
-                step=int(ckpt.step),
+                step=int(summary.get("step", ckpt_step)),
                 generation=self.generation,
             )
         except Exception:
@@ -1590,6 +1690,41 @@ class ElasticTrainer:
             # store on first use — resolve it before reading the
             # store attribute, or the first restore passes None.
             server = self._ensure_fabric_server()
+            if self.shard_only:
+                # (Re)bind the store's shard residency to THIS world's
+                # topology: boundaries are world-independent, ownership
+                # is not.  Must precede the agreement — flush trimming
+                # and the cold-start seed below both read the binding.
+                self.store.bind_fabric(
+                    fabric_net.rank,
+                    fabric_net.world,
+                    k=self.fabric_replicas,
+                    shard_bytes=self.fabric_shard_bytes,
+                    resident=self._fabric_replica_store,
+                )
+                if (
+                    ckpt is None
+                    and self._fabric_replica_store.newest_step() < 0
+                    and self.store.spill_dir
+                ):
+                    # Shard-only cold start: seed residency with this
+                    # member's wanted ranges from the durable shard
+                    # union — it then advertises as a replica-only
+                    # holder; no process materializes full state.
+                    seeded = self.store.load_shards_from_disk(abstract)
+                    if seeded is not None:
+                        import sys
+
+                        print(
+                            f"[edl] shard-only cold start: seeded "
+                            f"{seeded['shards']} resident shard(s) "
+                            f"({seeded['bytes']} bytes) at step "
+                            f"{seeded['step']} from {self.store.spill_dir}",
+                            file=sys.stderr,
+                        )
+                        self._last_completed_step = max(
+                            self._last_completed_step, seeded["step"]
+                        )
             result = fab.fabric_restore(
                 fabric_net,
                 leaves_abs,
@@ -1659,6 +1794,10 @@ class ElasticTrainer:
                     ckpt.adopt_digests(result.leaf_digests)
                 self.store.put(ckpt)
             state = self.store.restore(ckpt, trainer.mesh, shardings)
+            if self.shard_only:
+                # Back to shard residency the moment the device copy
+                # exists: adopt wanted ranges, drop the full leaves.
+                self.store.trim_to_shards(int(ckpt.step))
             return state, int(ckpt.step), "local", stats_dict
 
         # Delta mode: every leaf was placed (local digest-matched ones
@@ -1693,6 +1832,8 @@ class ElasticTrainer:
             # A fabric assembly without a full-state authority carries
             # no leaf-digest advertisement: put() fingerprints fresh.
             self.store.put(merged)
+        if self.shard_only:
+            self.store.trim_to_shards(int(stats.step))
         moved = stats.bytes_received or stats.bytes_sent
         if stats.mode == "fabric":
             source = "fabric" if moved else "local"

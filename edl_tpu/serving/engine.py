@@ -327,19 +327,74 @@ class InferenceEngine:
 
         return jax.eval_shape(init_fn, jax.random.key(self.seed))
 
+    def _stage_params(self, params_host):
+        """Stage host params onto the serving mesh, each device slice
+        assembled through the checkpoint fabric's row-aligned
+        ``ShardLayout`` (``stage_slice_from_shards``) instead of the
+        retired per-leaf index slicing.  Here the shard source is a
+        view into the full host leaf — zero extra copies, bytes
+        bit-identical to the old ``x[idx]`` path — and the SAME
+        assembler serves the shard-only durable-dir swap
+        (``_install_from_shard_spills``), where shards come from
+        per-rank npz files and no full leaf ever exists.  Non-CPU and
+        cross-process meshes keep ``leaf_placer``'s DMA/collective
+        paths unchanged."""
+        import jax.numpy as jnp
+
+        from edl_tpu.checkpoint import fabric as fab
+
+        place = leaf_placer(self.mesh)
+        multiproc = any(
+            d.process_index != jax.process_index()
+            for d in self.mesh.devices.flat
+        )
+        cpu = all(d.platform == "cpu" for d in self.mesh.devices.flat)
+        p_leaves, p_def = jax.tree_util.tree_flatten(params_host)
+        s_leaves = jax.tree_util.tree_flatten(self._param_shardings)[0]
+        if multiproc or not cpu:
+            placed = [place(x, s) for x, s in zip(p_leaves, s_leaves)]
+            return jax.tree_util.tree_unflatten(p_def, placed)
+        layout = fab.ShardLayout.build(
+            [int(np.asarray(x).nbytes) for x in p_leaves],
+            1,
+            shard_bytes=fab.deployment_shard_bytes(),
+            rows=fab.leaf_rows(p_leaves),
+        )
+        placed = []
+        for i, (x, s) in enumerate(zip(p_leaves, s_leaves)):
+            if isinstance(x, np.ndarray) and not s.is_fully_replicated:
+
+                def src(sh, _x=x):
+                    return fab.byte_view(_x)[
+                        sh.offset : sh.offset + sh.length
+                    ]
+
+                placed.append(
+                    jax.make_array_from_callback(
+                        x.shape,
+                        s,
+                        lambda idx, _i=i, _x=x, _src=src: jnp.array(
+                            fab.stage_slice_from_shards(
+                                layout, _i, _x, idx, _src
+                            )
+                        ),
+                    )
+                )
+            else:
+                placed.append(place(x, s))
+        return jax.tree_util.tree_unflatten(p_def, placed)
+
     def _install(self, ckpt: HostCheckpoint) -> None:
         """Place ``ckpt``'s params on the serving mesh via the model's
         partition rules and publish them as the next weight
         generation.  ONLY the params leave the host — serving never
         pays the optimizer state's placement or memory — and on a tp
-        mesh each device stages only ITS weight shard (``leaf_placer``
-        slices per device), so swap traffic is 1/tp per device."""
+        mesh each device stages only ITS weight shard (row-aligned
+        ``ShardLayout`` slices via ``_stage_params``), so swap traffic
+        is 1/tp per device."""
         state_host = ckpt.unflatten()
         params_host = getattr(state_host, "params", state_host)
-        place = leaf_placer(self.mesh)
-        params = jax.tree_util.tree_map(
-            place, params_host, self._param_shardings
-        )
+        params = self._stage_params(params_host)
         with self._swap_lock:
             gen = (self._weights.generation + 1) if self._weights else 1
             self._weights = _Weights(
@@ -353,12 +408,182 @@ class InferenceEngine:
         self._last_rejected_step = -1
         self._m_weights_step.set(int(ckpt.step))
 
+    def _install_from_shard_spills(
+        self, step: int, mans: Dict[int, tuple], initial: bool = False
+    ) -> bool:
+        """Hot-swap staged straight out of a shard-only durable dir:
+        each device slice is assembled from the covering per-rank
+        shard files (CRC-gated per shard, lazily read), so a tp
+        serving fleet swaps from shard-only training hosts with NO
+        process — trainer or server — materializing full state.  Host
+        traffic here is the params' bytes read shard-by-shard; the
+        optimizer state's shards are never opened."""
+        import os
+        import zlib
+
+        import jax.numpy as jnp
+
+        from edl_tpu.checkpoint import fabric as fab
+
+        template = self._template_state()
+        leaves_abs, treedef = jax.tree_util.tree_flatten(template)
+        any_man = next(iter(mans.values()))[1]
+        if [int(b) for b in any_man.get("leaf_nbytes", ())] != [
+            fab.leaf_nbytes(l) for l in leaves_abs
+        ]:
+            raise RuntimeError(
+                f"shard spills at step {step} do not match the serving "
+                "model's leaf schema (wrong model?)"
+            )
+        layout = fab.ShardLayout.build(
+            [fab.leaf_nbytes(l) for l in leaves_abs],
+            max(1, int(any_man.get("world", 1))),
+            k=int(any_man.get("k", 1)),
+            shard_bytes=int(any_man["shard_bytes"]),
+            rows=fab.leaf_rows(leaves_abs),
+        )
+        if len(layout.shards) != int(any_man.get("n_shards", -1)):
+            raise RuntimeError(
+                f"shard spills at step {step} use a different shard "
+                "granularity than this deployment"
+            )
+        # Which global state-leaf indices are params: flatten a tree of
+        # indices and read its params subtree — no schema guessing.
+        idx_tree = jax.tree_util.tree_unflatten(
+            treedef, list(range(len(leaves_abs)))
+        )
+        params_abs = getattr(template, "params", template)
+        param_idx_tree = getattr(idx_tree, "params", idx_tree)
+        param_idxs = jax.tree_util.tree_leaves(param_idx_tree)
+        p_def = jax.tree_util.tree_flatten(params_abs)[1]
+        s_leaves = jax.tree_util.tree_flatten(self._param_shardings)[0]
+        owner_of: Dict[int, int] = {}
+        digs: Dict[int, int] = {}
+        for rank, (name, man) in mans.items():
+            for i, dg in zip(man.get("indices", ()), man.get("digests", ())):
+                owner_of[int(i)] = rank
+                digs[int(i)] = int(dg)
+        opened: Dict[int, Any] = {}
+
+        def shard_src(sh):
+            rank = owner_of[sh.index]
+            if rank not in opened:
+                name = mans[rank][0]
+                opened[rank] = np.load(
+                    os.path.join(
+                        self.store.spill_dir,
+                        name[: -len(".json")] + ".npz",
+                    )
+                )
+            arr = np.asarray(opened[rank][f"s_{sh.index}"], np.uint8)
+            if zlib.crc32(arr) != digs.get(sh.index):
+                raise RuntimeError(
+                    f"shard {sh.index} at step {step} failed CRC "
+                    "verification (torn shard spill)"
+                )
+            return arr
+
+        try:
+            placed = [
+                jax.make_array_from_callback(
+                    tuple(leaves_abs[gi].shape),
+                    s,
+                    lambda idx, _gi=gi: jnp.array(
+                        fab.stage_slice_from_shards(
+                            layout, _gi, leaves_abs[_gi], idx, shard_src
+                        )
+                    ),
+                )
+                for gi, s in zip(param_idxs, s_leaves)
+            ]
+        finally:
+            for z in opened.values():
+                try:
+                    z.close()
+                except Exception:
+                    pass
+        params = jax.tree_util.tree_unflatten(p_def, placed)
+        # Shard-granular fingerprint (crc32 over the manifest's shard
+        # digest vector): no full-leaf bytes exist to hash.
+        digest = zlib.crc32(
+            np.asarray(
+                any_man.get("shard_digests", []), np.uint32
+            ).tobytes()
+        )
+        with self._swap_lock:
+            gen = (self._weights.generation + 1) if self._weights else 1
+            self._weights = _Weights(
+                generation=gen,
+                step=int(step),
+                digest=int(digest),
+                params=params,
+            )
+        self._last_rejected_step = -1
+        self._m_weights_step.set(int(step))
+        if not initial:
+            self._m_swaps.inc()
+        self.recorder.record(
+            "serve.swap",
+            {
+                "step": int(step),
+                "initial": bool(initial),
+                "source": "shard_spill",
+                "ranks": len(mans),
+            },
+            step=int(step),
+        )
+        return True
+
+    def _newest_full_spill_step(self) -> int:
+        """Newest full-copy spill step in the durable dir (-1 when
+        only shard spills — or nothing — exist)."""
+        import os
+
+        best = -1
+        try:
+            names = os.listdir(self.store.spill_dir)
+        except OSError:
+            return best
+        for name in names:
+            if (
+                name.endswith(".json")
+                and ".tmp." not in name
+                and ".shard-r" not in name
+            ):
+                try:
+                    best = max(
+                        best, int(name[len("ckpt-") : -len(".json")])
+                    )
+                except ValueError:
+                    continue
+        return best
+
     def load(self) -> bool:
         """Initial load: newest verified DRAM checkpoint, falling back
         to the durable spill dir (the launcher's EDL_CHECKPOINT_DIR).
-        Returns False when neither holds a restorable checkpoint."""
+        A shard-only durable dir (per-rank shard spills from a
+        shard-only training fleet) stages straight from the shard
+        files when its newest covered step beats any full spill.
+        Returns False when nothing restorable exists."""
         ckpt = self.store.latest_verified()
         if ckpt is None and self.store.spill_dir:
+            from edl_tpu.checkpoint.hostdram import (
+                newest_covered_shard_step,
+            )
+
+            found = newest_covered_shard_step(self.store.spill_dir)
+            if found is not None and found[0] >= self._newest_full_spill_step():
+                try:
+                    return self._install_from_shard_spills(
+                        found[0], found[1], initial=True
+                    )
+                except Exception:
+                    self._m_swap_rejected.inc()
+                    self.recorder.record(
+                        "serve.swap.rejected",
+                        {"source": "shard_spill", "serving_step": -1},
+                        step=0,
+                    )
             try:
                 ckpt = self.store.load_from_disk(self._template_state())
             except FileNotFoundError:
@@ -406,7 +631,10 @@ class InferenceEngine:
             # run between every micro-batch.
             self._last_spill_poll = now
             try:
-                self._poll_spill_dir(current)
+                if self._poll_spill_dir(current):
+                    # Shard-only spills staged and swapped directly
+                    # (no full-copy DRAM intermediate exists to verify).
+                    return True
             except Exception:
                 self._m_swap_rejected.inc()
                 self.recorder.record(
@@ -444,23 +672,37 @@ class InferenceEngine:
         )
         return True
 
-    def _poll_spill_dir(self, current: int) -> None:
+    def _poll_spill_dir(self, current: int) -> bool:
         """Pull a newer durable spill into the store (so the normal
         DRAM verify/swap path below picks it up).  Manifest scan only —
-        bytes load (and CRC-verify) once per NEW step, not per poll."""
+        bytes load (and CRC-verify) once per NEW step, not per poll.
+        Shard-only spills (per-rank ``ckpt-*.shard-r*`` families from a
+        shard-only training fleet) have no full copy to pull: when the
+        newest FULLY COVERED shard step beats everything else, the swap
+        stages straight from the shard files and returns True."""
         import os
+
+        from edl_tpu.checkpoint.hostdram import newest_covered_shard_step
 
         dram = self.store.latest()
         dram_step = int(dram.step) if dram is not None else -1
         best = -1
         for name in os.listdir(self.store.spill_dir):
-            if name.endswith(".json") and ".tmp." not in name:
+            if (
+                name.endswith(".json")
+                and ".tmp." not in name
+                and ".shard-r" not in name
+            ):
                 try:
                     best = max(best, int(name[len("ckpt-"):-len(".json")]))
                 except ValueError:
                     continue
+        found = newest_covered_shard_step(self.store.spill_dir)
+        if found is not None and found[0] > max(current, dram_step, best):
+            return self._install_from_shard_spills(found[0], found[1])
         if best > max(current, dram_step):
             self.store.load_from_disk(self._template_state(), step=best)
+        return False
 
     # -- compilation --------------------------------------------------------
     def _abstract_batch(self, bucket: int) -> Dict[str, Any]:

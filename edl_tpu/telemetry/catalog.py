@@ -181,6 +181,21 @@ CATALOG = {
         "(offer/accept pushes; declined offers move no bytes).",
         "labels": (),
     },
+    "edl_fabric_underreplicated_total": {
+        "type": "counter",
+        "help": "Flushes whose owned shards did NOT reach every ring "
+        "buddy (EDL_FABRIC_K enforcement: an unreachable or dropped "
+        "buddy leaves the window journaled + counted, never silent).",
+        "labels": (),
+    },
+    "edl_fabric_resident_bytes": {
+        "type": "gauge",
+        "help": "Host bytes resident in this member's shard store "
+        "(own GSPMD slice + K buddy shards under shard-only "
+        "checkpoints — the (1+K)/world memory contract, vs 1.0x "
+        "state for a full host copy).",
+        "labels": (),
+    },
     # -- control plane -------------------------------------------------------
     "edl_retry_attempts_total": {
         "type": "counter",
@@ -704,6 +719,8 @@ KNOWN_EVENT_KINDS = {
     "fabric.pull": "one parallel multi-peer fabric restore summary",
     "fabric.replicate": "stage-B buddy replica offer/push summary",
     "fabric.inherit": "scale-down victim pushed its shard inheritance",
+    "fabric.degrade": "agreement dropped an under-covered step world-wide",
+    "fabric.underreplicated": "a flush's shards did not reach K buddies",
     # control plane (runtime.coordinator)
     "coord.plan": "coordinator plan rebuild (generation bump)",
     "coord.evict": "heartbeat-lease eviction",

@@ -1524,3 +1524,120 @@ def test_multipod_merged_trace_one_id_decision_to_first_step(tmp_path):
             if p.poll() is None:
                 p.kill()
         server.stop()
+
+
+def test_multipod_shard_only_spills_joiner_and_cold_start(tmp_path):
+    """EDL_SHARD_ONLY=1 end to end (ISSUE 19): a 2-pod world runs with
+    shard-only host checkpoints — every durable spill is a per-rank
+    SHARD file (no full-copy manifest ever exists), a third pod joins
+    against peers whose DRAM holds only resident shards, and after a
+    whole-world massacre the replacement pods cold-start from the
+    shard-spill UNION: each seeds only its wanted ranges and the
+    agreement assembles the rest over the fabric — training resumes
+    past the spilled step with NO full checkpoint file anywhere."""
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+
+    ckpt_dir = tmp_path / "durable"
+    coord = LocalCoordinator(
+        target_world=2, max_world=3, heartbeat_timeout=15.0,
+        legal_sizes=[1, 2, 3],
+    )
+    server = CoordinatorServer(coord, host="127.0.0.1", port=0).start()
+    caddr = f"127.0.0.1:{server.port}"
+    names = ("s1", "s2", "s3", "s4", "s5")
+    hist = {w: tmp_path / f"{w}.jsonl" for w in names}
+    procs = []
+    env = {
+        "EDL_CHECKPOINT_DIR": str(ckpt_dir),
+        "EDL_SHARD_ONLY": "1",
+        # Tiny shards so even fit_a_line's state spreads over many
+        # owners (production default is 32MB).
+        "EDL_FABRIC_SHARD_BYTES": "512",
+    }
+
+    def spawn(name, base_port):
+        # gbs=12: divisible by every legal world (1, 2, 3).
+        return _spawn_worker(
+            procs, hist, name, base_port, caddr,
+            checkpoint_interval=3, gbs=12, extra_env=env,
+        )
+
+    try:
+        s1 = spawn("s1", 13300)
+        s2 = spawn("s2", 13360)
+        _wait_for(
+            lambda: len(_read_history(hist["s1"])) >= 8
+            and any(ckpt_dir.glob("ckpt-*.json")),
+            240,
+            "2-pod shard-only world past a durable spill",
+            procs,
+        )
+        # THE spill-plane claim: shard files only, never a full copy.
+        spills = sorted(f.name for f in ckpt_dir.glob("ckpt-*"))
+        assert spills, "nothing spilled"
+        assert all(".shard-r" in n for n in spills), (
+            f"full-copy spill leaked from a shard-only world: {spills}"
+        )
+
+        # A joiner restores from peers that hold shard residency.
+        s3 = spawn("s3", 13420)
+        coord.set_target_world(3)
+        _wait_for(
+            lambda: any(
+                r["world_size"] == 3 for r in _read_history(hist["s3"])
+            ),
+            300, "the 3-pod shard-only world to step", procs,
+        )
+        first_3 = next(
+            rz
+            for rz in _read_resizes(hist["s3"])
+            if rz["world_size"] == 3
+        )
+        assert first_3["restore_source"] in ("fabric", "broadcast"), first_3
+        assert first_3["restored_step"] > 0, first_3
+
+        # Whole-world massacre: no survivors, DRAM everywhere is gone.
+        for p in (s1, s2, s3):
+            p.kill()
+            p.wait(timeout=30)
+        procs.clear()
+        last_before = max(r["step"] for r in _read_history(hist["s1"]))
+        covered = sorted(
+            set(
+                int(f.name[len("ckpt-"):].split(".")[0])
+                for f in ckpt_dir.glob("ckpt-*.json")
+            )
+        )
+        assert covered and covered[-1] > 0, f"nothing spilled: {covered}"
+
+        # Cold start from the shard union: fresh pods, empty DRAM; the
+        # durable dir holds only per-rank shard files written by a
+        # DIFFERENT world size (boundaries are world-independent).
+        coord.set_target_world(2)
+        spawn("s4", 13480)
+        spawn("s5", 13540)
+        _wait_for(
+            lambda: len(_read_history(hist["s4"])) >= 5,
+            240,
+            "shard-only cold-started world stepping",
+            procs,
+        )
+        post = _read_history(hist["s4"])
+        assert min(r["step"] for r in post) >= covered[0], (
+            f"cold start replayed from {min(r['step'] for r in post)}, "
+            f"durable shard union had {covered}"
+        )
+        assert max(r["step"] for r in post) > 0
+        cold = _read_resizes(hist["s4"])[-1]
+        assert cold["restored_step"] >= covered[0] > 0, cold
+        assert all(math.isfinite(r["loss"]) for r in post)
+        # Still no full-copy file after the entire exercise.
+        assert all(
+            ".shard-r" in f.name for f in ckpt_dir.glob("ckpt-*")
+        )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()

@@ -1,0 +1,549 @@
+"""Shard-only host checkpoints (ISSUE 19): cluster-memory state so
+host DRAM never caps model size.
+
+The contract under test: a dp×fsdp member's ``HostDRAMStore`` holds
+only its own GSPMD slice plus K ring-buddy shards — never a full
+leaf, never the full state — and every downstream consumer (flush,
+spill, restore, serving hot swap, tp staging) operates at shard
+granularity:
+
+- flush trims the transient full copy down to resident shards, and
+  spills per-rank shard files whose UNION is the durable checkpoint;
+- ``EDL_FABRIC_K`` is enforced: an under-replicated flush is counted
+  (``edl_fabric_underreplicated_total``) + journaled, and a
+  coverage-below-K agreement degrades loudly to the newest fully
+  covered step (the killed-buddy discipline);
+- a joiner restores with NO member holding full state, wire- and
+  memory-accounted;
+- serving swaps stage device slices straight from shard bytes
+  (``stage_slice_from_shards``), bit-identical to the retired
+  per-leaf ``x[idx]`` staging.
+"""
+
+import os
+import threading
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+from edl_tpu import telemetry
+from edl_tpu.checkpoint import fabric as fab
+from edl_tpu.checkpoint import transfer as tx
+from edl_tpu.checkpoint.hostdram import (
+    HostCheckpoint,
+    HostDRAMStore,
+    newest_covered_shard_step,
+    scan_shard_spills,
+)
+
+
+def source_leaves(seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        rng.randn(64, 32).astype(np.float32),
+        rng.randn(257, 16).astype(np.float32),
+        np.asarray(rng.randint(0, 100), np.int32).reshape(()),
+        rng.randn(4000).astype(np.float64),
+    ]
+
+
+def build_layout(leaves, world, k=1, shard_bytes=1024):
+    return fab.ShardLayout.build(
+        [l.nbytes for l in leaves],
+        world,
+        k=k,
+        shard_bytes=shard_bytes,
+        rows=fab.leaf_rows(leaves),
+    )
+
+
+def shard_bytes_of(layout, leaves, s):
+    return bytes(
+        fab.byte_view(leaves[s.leaf])[s.offset : s.offset + s.length]
+    )
+
+
+def seed_resident(resident, layout, leaves, step, indices):
+    """Adopt ``indices`` into a replica store from source leaves."""
+    for i in indices:
+        s = layout.shards[i]
+        data = np.frombuffer(
+            shard_bytes_of(layout, leaves, s), np.uint8
+        ).copy()
+        resident.put(step, s.leaf, s.offset, s.length, data, zlib.crc32(data))
+
+
+def wanted_nbytes(layout, rank):
+    return sum(layout.shards[s].length for s in layout.wanted(rank))
+
+
+def run_world(member_fns, timeout=60):
+    world = tx.LoopbackWorld(len(member_fns))
+    results = [None] * len(member_fns)
+    errors = [None] * len(member_fns)
+
+    def runner(rank, fn):
+        try:
+            results[rank] = fn(world.fabric(rank))
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errors[rank] = e
+
+    threads = [
+        threading.Thread(target=runner, args=(r, fn), daemon=True)
+        for r, fn in enumerate(member_fns)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+        assert not t.is_alive(), "member thread hung"
+    return results, errors
+
+
+class _St:
+    """Minimal flushable state (flush_sync reads ``.step`` + leaves)."""
+
+    def __init__(self, leaves, step):
+        self.step = step
+        self.leaves = list(leaves)
+
+    def tree_flatten(self):
+        return self.leaves, self.step
+
+    @classmethod
+    def tree_unflatten(cls, step, leaves):
+        return cls(leaves, step)
+
+
+jax.tree_util.register_pytree_node(
+    _St, _St.tree_flatten, _St.tree_unflatten
+)
+
+
+# ---- staging primitive -----------------------------------------------------
+
+
+def test_stage_slice_from_shards_bit_identity():
+    """``stage_slice_from_shards`` == ``x[idx]`` bit-for-bit, for row
+    slices (fsdp), trailing-axis slices (tp columns), combined slices,
+    and whole-leaf (byte-range) shards — the regression gate for
+    retiring the per-leaf index-slice staging."""
+    leaves = source_leaves(7)
+    layout = build_layout(leaves, 4, shard_bytes=1024)
+
+    def src_for(leaf_arr):
+        return lambda sh: fab.byte_view(leaf_arr)[
+            sh.offset : sh.offset + sh.length
+        ]
+
+    cases = [
+        (0, (slice(0, 32), slice(None))),          # fsdp row half
+        (0, (slice(None), slice(0, 16))),          # tp column half
+        (0, (slice(16, 48), slice(16, 32))),       # both axes
+        (1, (slice(0, 129), slice(None))),         # odd row split
+        (1, (slice(129, 257), slice(8, 16))),
+        (2, ()),                                   # 0-d leaf
+        (3, (slice(1000, 3000),)),                 # 1-d row leaf
+    ]
+    for leaf, idx in cases:
+        x = leaves[leaf]
+        got = fab.stage_slice_from_shards(
+            layout, leaf, x, idx, src_for(x)
+        )
+        want = x[idx] if idx != () else x
+        assert got.tobytes() == np.ascontiguousarray(want).tobytes(), (
+            leaf,
+            idx,
+        )
+
+
+# ---- the store: flush trims, spills shard, cold-starts from shards ---------
+
+
+def _shard_only_store(tmp_path, rank, world, k=1, shard_bytes=512):
+    st = HostDRAMStore(spill_dir=str(tmp_path))
+    st.shard_only = True
+    st.bind_fabric(
+        rank,
+        world,
+        k=k,
+        shard_bytes=shard_bytes,
+        resident=fab.ShardReplicaStore(keep_steps=2),
+    )
+    return st
+
+
+def test_shard_only_flush_trims_full_copy_and_spills_shards(tmp_path):
+    """After a world=4 collective flush: no member's DRAM holds the
+    full state (the transient copy is trimmed to resident shards, each
+    bounded by own-slice + K-buddy bytes), the durable dir holds ONLY
+    per-rank shard files, and their union re-assembles bit-identically
+    for a full-copy consumer."""
+    leaves = source_leaves(19)
+    total = sum(l.nbytes for l in leaves)
+    world = 4
+    stores = [
+        _shard_only_store(tmp_path, r, world) for r in range(world)
+    ]
+    layout = stores[0]._fab_layout(leaves)
+    for r, st in enumerate(stores):
+        ckpt, bg = st.flush_sync(_St(leaves, 11), generation=2)
+        if bg is not None:
+            bg.join()
+        # Full copy trimmed: the store no longer serves it ...
+        assert st.latest() is None
+        # ... and residency is the (1 + K)/world contract, not the
+        # state.
+        assert st.resident_nbytes() == wanted_nbytes(layout, r)
+        assert st.resident_nbytes() < total
+
+    names = sorted(os.listdir(tmp_path))
+    assert names, "shard-only flush must spill"
+    assert all(".shard-r" in n for n in names), names
+    assert set(scan_shard_spills(str(tmp_path))) == {11}
+    found = newest_covered_shard_step(str(tmp_path))
+    assert found is not None and found[0] == 11
+    assert sorted(found[1]) == list(range(world))
+
+    # A full-copy consumer (plain store, e.g. pre-shard-only serving)
+    # assembles the union bit-identically.
+    template = _St(
+        [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves], 0
+    )
+    full = HostDRAMStore(spill_dir=str(tmp_path)).load_from_disk(template)
+    assert int(full.step) == 11
+    for got, want in zip(full.leaves, leaves):
+        np.testing.assert_array_equal(got, want)
+    assert full.verify()
+
+
+def test_shard_only_cold_start_seeds_only_wanted(tmp_path):
+    """A shard-only member cold-starting from the durable dir seeds
+    its resident store with EXACTLY its wanted ranges — never the
+    union — so a whole-fleet cold start still holds (1+K)/world of the
+    state per host."""
+    leaves = source_leaves(23)
+    world = 4
+    for r in range(world):
+        st = _shard_only_store(tmp_path, r, world)
+        _, bg = st.flush_sync(_St(leaves, 5), generation=1)
+        if bg is not None:
+            bg.join()
+
+    joiner = _shard_only_store(tmp_path, 2, world)
+    layout = joiner._fab_layout(leaves)
+    template = _St(
+        [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves], 0
+    )
+    seeded = joiner.load_shards_from_disk(template)
+    assert seeded is not None and seeded["step"] == 5
+    assert seeded["bytes"] == wanted_nbytes(layout, 2)
+    assert joiner.resident_nbytes() == wanted_nbytes(layout, 2)
+    assert joiner.latest() is None  # no full state materialized
+    # Wrong-model schema fails loudly, never silently restarts at 0.
+    bad = _St(
+        [jax.ShapeDtypeStruct((3, 3), np.float32)], 0
+    )
+    with pytest.raises(RuntimeError, match="leaf schema|granularity"):
+        joiner.load_shards_from_disk(bad)
+
+
+# ---- collective shard-resident restore -------------------------------------
+
+
+def test_joiner_restore_no_member_holds_full_state():
+    """A fresh joiner restores from shard-only peers: every member
+    ends holding exactly own-slice + K-buddy bytes, the joiner's wire
+    bytes equal its wanted ranges, and NO process ever assembles the
+    full state (resident bytes < total everywhere)."""
+    leaves = source_leaves(29)
+    template = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    total = sum(l.nbytes for l in leaves)
+    W = 5
+    layout = build_layout(leaves, W, shard_bytes=1024)
+    residents = [fab.ShardReplicaStore(keep_steps=2) for _ in range(W)]
+    for r in range(W - 1):  # rank W-1 is the empty joiner
+        seed_resident(residents[r], layout, leaves, 9, layout.wanted(r))
+
+    def member(r):
+        return lambda f: fab.shard_restore(
+            f,
+            template,
+            residents[r],
+            rows=fab.leaf_rows(leaves),
+            k=1,
+            shard_bytes=1024,
+        )
+
+    results, errors = run_world([member(r) for r in range(W)])
+    assert all(e is None for e in errors), errors
+    joiner = results[W - 1]
+    assert joiner.stats.mode == "fabric"
+    assert joiner.stats.step == 9
+    want_b = wanted_nbytes(layout, W - 1)
+    assert joiner.stats.bytes_received == want_b
+    assert 0 < want_b < total
+    for r in range(W):
+        held = residents[r].nbytes()
+        assert held == wanted_nbytes(layout, r)
+        assert held < total, f"rank {r} holds full state"
+        # bit-identity of every resident shard against the source
+        for s_idx in layout.wanted(r):
+            s = layout.shards[s_idx]
+            got = residents[r].get(9, s.leaf, s.offset, s.length)
+            assert bytes(got) == shard_bytes_of(layout, leaves, s)
+    # The union of residents covers every shard (the durability story).
+    covered = set()
+    for r in range(W):
+        covered.update(layout.wanted(r))
+    assert covered == set(range(len(layout.shards)))
+
+
+def test_killed_buddy_degrades_to_newest_covered_step():
+    """Coverage below K degrades LOUDLY and world-consistently: a
+    shard whose every holder died leaves the newest step uncoverable —
+    all members raise, drop that step, and the retry converges on the
+    newest fully covered one (never a silent partial restore)."""
+    leaves = source_leaves(31)
+    template = [jax.ShapeDtypeStruct(l.shape, l.dtype) for l in leaves]
+    W = 2
+    layout = build_layout(leaves, W, shard_bytes=1024)
+    residents = [fab.ShardReplicaStore(keep_steps=2) for _ in range(W)]
+    # Step 7: fully covered between the survivors.
+    for r in range(W):
+        seed_resident(residents[r], layout, leaves, 7, layout.wanted(r))
+    # Step 8: the killed third member was the only holder of rank-0's
+    # last owned shard — survivors hold everything BUT that one.
+    newer = [l + (1 if l.dtype.kind == "f" else 0) for l in leaves]
+    missing = layout.owned_by(0)[-1].index
+    for r in range(W):
+        seed_resident(
+            residents[r],
+            layout,
+            newer,
+            8,
+            [i for i in layout.wanted(r) if i != missing],
+        )
+
+    def member(r):
+        return lambda f: fab.shard_restore(
+            f,
+            template,
+            residents[r],
+            rows=fab.leaf_rows(leaves),
+            k=1,
+            shard_bytes=1024,
+        )
+
+    with telemetry.scoped():
+        _, errors = run_world([member(r) for r in range(W)])
+        # Round 1: every member degrades (no partial winners).
+        assert all(
+            isinstance(e, tx.TransferError) for e in errors
+        ), errors
+        for r in range(W):
+            assert residents[r].newest_step() == 7, "step 8 not dropped"
+        # Round 2 (the caller's hold-and-retry): converges at step 7.
+        results, errors = run_world([member(r) for r in range(W)])
+        assert all(e is None for e in errors), errors
+        for r, res in enumerate(results):
+            assert res.stats.step == 7
+            for s_idx in layout.wanted(r):
+                s = layout.shards[s_idx]
+                got = residents[r].get(7, s.leaf, s.offset, s.length)
+                assert bytes(got) == shard_bytes_of(layout, leaves, s)
+
+
+def test_underreplicated_flush_counted_and_journaled():
+    """EDL_FABRIC_K enforcement at the flush path: an owned shard that
+    cannot reach its ring buddy (dead peer / lost address) is counted
+    into ``edl_fabric_underreplicated_total`` and journaled as
+    ``fabric.underreplicated`` — a replication-contract violation, not
+    an advisory log line."""
+    from edl_tpu.runtime.elastic import ElasticTrainer
+
+    leaves = source_leaves(37)
+    _, treedef = jax.tree_util.tree_flatten(list(leaves))
+    ckpt = HostCheckpoint(
+        step=40, generation=3, leaves=list(leaves), treedef=treedef
+    )
+    with telemetry.scoped():
+        t = object.__new__(ElasticTrainer)
+        t.fabric_replicas = 1
+        t.fabric_shard_bytes = 1024
+        t.transfer_chunk_bytes = 1024
+        t.transfer_timeout = 2.0
+        t.shard_only = False
+        t.store = HostDRAMStore()
+        t.recorder = telemetry.get_recorder()
+        t._fabric_replication = None
+        # Rank 0's buddy (rank 1) is dead (connection refused): every
+        # offer to it must be accounted as under-replication.
+        t._fabric_stage_b(
+            ckpt, world=2, rank=0, peers={1: ("127.0.0.1", 1)}
+        )
+        th = t._fabric_replication
+        assert th is not None
+        th.join(10)
+        assert not th.is_alive()
+        layout = t._fabric_layout(ckpt.leaves, world=2)
+        owned = len(layout.owned_by(0))
+        reg = telemetry.get_registry()
+        got = reg.counter("edl_fabric_underreplicated_total").value()
+        assert got == owned, (got, owned)
+        events = t.recorder.events()
+        under = [
+            e for e in events if e.kind == "fabric.underreplicated"
+        ]
+        assert under, [e.kind for e in events]
+        assert under[-1].data["shards"] == owned
+        assert under[-1].data["k"] == 1
+
+
+# ---- serving: swap + tp staging from shard granularity ---------------------
+
+
+def _line_model_state(g, step):
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.train import TrainState
+
+    model = get_model("fit_a_line")
+    params = {
+        "w": jnp.full((13,), g, jnp.float32),
+        "b": jnp.asarray(g, jnp.float32),
+    }
+    opt = optax.adam(1e-3)
+    return model, opt, TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params=params,
+        opt_state=opt.init(params),
+    )
+
+
+def test_serving_swaps_from_shard_only_spills(tmp_path):
+    """A serving replica pointed at a shard-only durable dir (no full
+    spill anywhere) loads AND hot-swaps by staging device slices
+    straight from the per-rank shard files — params bit-identical, and
+    the optimizer half of the state never read."""
+    from edl_tpu.serving import InferenceEngine
+
+    model, opt, state7 = _line_model_state(1.0, 7)
+
+    def train_flush(state):
+        for rank in range(2):
+            st = _shard_only_store(tmp_path, rank, 2, shard_bytes=64)
+            _, bg = st.flush_sync(state, generation=1)
+            if bg is not None:
+                bg.join()
+
+    train_flush(state7)
+    assert all(
+        ".shard-r" in n for n in os.listdir(tmp_path)
+    ), "precondition: shard-only durable dir"
+
+    eng = InferenceEngine(
+        model,
+        HostDRAMStore(spill_dir=str(tmp_path)),
+        devices=jax.devices()[:1],
+        max_batch=4,
+        optimizer=opt,
+    )
+    eng.spill_poll_interval = 0.0
+    assert eng.load()
+    assert eng.weights_step == 7
+    got = jax.tree_util.tree_leaves(eng._weights.params)
+    want = jax.tree_util.tree_leaves(state7.params)
+    for a, b in zip(got, want):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+    # Nothing new: no swap.
+    assert eng.refresh() is False
+    # Training writes step 9 shard spills -> the poll stages and swaps.
+    _, _, state9 = _line_model_state(2.0, 9)
+    train_flush(state9)
+    assert eng.refresh() is True
+    assert eng.weights_step == 9
+    got = jax.tree_util.tree_leaves(eng._weights.params)
+    want = jax.tree_util.tree_leaves(state9.params)
+    for a, b in zip(got, want):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_serving_rejects_torn_shard_spill(tmp_path):
+    """A bit-rotted shard file fails its per-shard CRC at staging: the
+    swap is REJECTED (counted + journaled) and the engine keeps the
+    old weights."""
+    from edl_tpu.serving import InferenceEngine
+
+    model, opt, state7 = _line_model_state(1.0, 7)
+    for rank in range(2):
+        st = _shard_only_store(tmp_path, rank, 2, shard_bytes=64)
+        _, bg = st.flush_sync(state7, generation=1)
+        if bg is not None:
+            bg.join()
+    eng = InferenceEngine(
+        model,
+        HostDRAMStore(spill_dir=str(tmp_path)),
+        devices=jax.devices()[:1],
+        max_batch=4,
+        optimizer=opt,
+    )
+    eng.spill_poll_interval = 0.0
+    assert eng.load() and eng.weights_step == 7
+
+    _, _, state9 = _line_model_state(2.0, 9)
+    for rank in range(2):
+        st = _shard_only_store(tmp_path, rank, 2, shard_bytes=64)
+        _, bg = st.flush_sync(state9, generation=1)
+        if bg is not None:
+            bg.join()
+    # Rot the step-9 spills: every manifest digest stops matching its
+    # payload (equivalent to torn payload bytes, but deterministic —
+    # a flipped payload byte could land in an opt_state shard the
+    # params-only staging never reads).
+    import json
+
+    for n in os.listdir(tmp_path):
+        if n.startswith("ckpt-000000000009") and n.endswith(".json"):
+            p = os.path.join(tmp_path, n)
+            man = json.load(open(p))
+            man["digests"] = [int(d) ^ 1 for d in man["digests"]]
+            json.dump(man, open(p, "w"))
+
+    rej = eng.telemetry.counter("edl_serve_swap_rejected_total")
+    before = rej.value()
+    assert eng.refresh() is False
+    assert eng.weights_step == 7  # old weights kept
+    assert rej.value() >= before + 1
+
+
+def test_tp_staging_bit_identical_to_index_slices():
+    """The tp=2 hot swap staged via ``stage_slice_from_shards`` (row-
+    aligned ShardLayout slices) places byte-identical per-device
+    shards to the retired ``x[idx]`` staging — verified at the device
+    buffer level for every param leaf."""
+    pytest.importorskip("optax")
+    from tests.test_tp_serving import _build_engine
+
+    _, store, engine = _build_engine("transformer_lm", tp=2)
+    host = store.latest_verified()
+    # Reconstruct the host-side params the swap staged from.
+    state = jax.tree_util.tree_unflatten(host.treedef, host.leaves)
+    host_params = jax.tree_util.tree_leaves(state.params)
+    placed = jax.tree_util.tree_leaves(engine._weights.params)
+    assert len(host_params) == len(placed)
+    checked_sliced = 0
+    for hp, arr in zip(host_params, placed):
+        for sh in arr.addressable_shards:
+            want = np.ascontiguousarray(np.asarray(hp)[sh.index])
+            got = np.asarray(sh.data)
+            assert got.tobytes() == want.tobytes()
+            if want.shape != hp.shape:
+                checked_sliced += 1
+    assert checked_sliced > 0, "tp=2 engine staged no sliced leaf"

@@ -76,6 +76,12 @@ def bench_fleet() -> dict:
     return _bench_fleet()
 
 
+def bench_router() -> dict:
+    from bench_lib.router import bench_router as _bench_router
+
+    return _bench_router()
+
+
 def bench_steady_state(steps: int = 30) -> dict:
     from bench_lib.steady_state import bench_steady_state as _bench_ss
 
@@ -92,6 +98,12 @@ def bench_shard_only_restore() -> dict:
     from bench_lib.restore import run_shard_only
 
     return run_shard_only()
+
+
+def bench_shard_only_restore_k2() -> dict:
+    from bench_lib.restore import run_shard_only
+
+    return run_shard_only(k=2)
 
 
 def bench_scale_down() -> dict:
@@ -158,14 +170,29 @@ def main():
     shard_only = _attempt(
         bench_shard_only_restore, "restore_paths.shard_only", retries=0
     )
+    shard_only_k2 = _attempt(
+        bench_shard_only_restore_k2,
+        "restore_paths.shard_only_k2",
+        retries=0,
+    )
     if isinstance(restore, dict):
         # shard_only rides inside restore_paths in the round record
         # (it is a restore-path figure), but is attempted separately so
-        # a failure in one half does not drop the other.
+        # a failure in one half does not drop the other.  The K=2 run
+        # (ISSUE 20 satellite: K>1 rings measured, not just
+        # layout-tested) rides beside the K=1 figure.
         restore = dict(restore)
         restore["shard_only"] = shard_only
+        restore["shard_only_k2"] = shard_only_k2
     scale_down = _attempt(bench_scale_down, "scale_down", retries=0)
     serving = _attempt(bench_serving, "serving", retries=0)
+    router = _attempt(bench_router, "serving.router", retries=0)
+    if isinstance(serving, dict):
+        # the front door rides inside the serving section (it IS a
+        # serving figure), attempted separately so one half failing
+        # does not drop the other.
+        serving = dict(serving)
+        serving["router"] = router
     fleet = _attempt(bench_fleet, "fleet", retries=0)
     if "error" in r:
         # The headline section itself died: emit an explicit error record

@@ -613,6 +613,55 @@ def _shard_only_child(spec_json: str):
     for r in range(W):
         covered.update(layout.wanted(r))
 
+    # --- replication ack (the K-ring durability figure, ISSUE 20
+    # satellite closing PR 19's residue): rank 0 offers its owned
+    # shards to every ring buddy over real FabricServers and the
+    # replication round ACKS — replicate_to_buddies returns with
+    # underreplicated == 0, meaning each owned shard reached all K
+    # buddies.  The wall time of that round is the ack latency a
+    # collective flush's stage-B hook pays before step 10 counts as
+    # K-replicated.
+    ck = HostCheckpoint(
+        step=10, generation=1, leaves=list(leaves), treedef=treedef
+    )
+    digs = ck.shard_digests(layout)
+    buddy_reps = {r: fab.ShardReplicaStore() for r in range(1, W)}
+    buddy_srvs = {
+        r: fab.FabricServer(
+            lambda *a: None,
+            ingest=fab.ReplicaIngest(
+                buddy_reps[r], lambda *a: False
+            ),
+        ).start()
+        for r in range(1, W)
+    }
+    try:
+        peer_addrs = {
+            r: ("127.0.0.1", buddy_srvs[r].port) for r in range(1, W)
+        }
+
+        def shard_source(s):
+            view = fab.byte_view(leaves[s.leaf])
+            return view[s.offset : s.offset + s.length], digs[s.index]
+
+        t0 = time.perf_counter()
+        rep_summary = fab.replicate_to_buddies(
+            layout, 0, 10, 1, peer_addrs, shard_source
+        )
+        replicate_ack_s = time.perf_counter() - t0
+    finally:
+        for srv in buddy_srvs.values():
+            srv.stop()
+    replication = {
+        "k": K,
+        "offered": rep_summary["offered"],
+        "accepted": rep_summary["accepted"],
+        "bytes_mb": round(rep_summary["bytes"] / 1e6, 1),
+        "dropped": rep_summary["dropped"],
+        "underreplicated": rep_summary["underreplicated"],
+        "replicate_ack_ms": round(replicate_ack_s * 1000.0, 1),
+    }
+
     print(
         json.dumps(
             {
@@ -633,6 +682,7 @@ def _shard_only_child(spec_json: str):
                 "bit_identical": bool(bit_identical),
                 "union_covers_all_shards": covered
                 == set(range(len(layout.shards))),
+                "replication": replication,
                 "shard_only_restore_s": round(shard_s, 4),
                 "full_copy_restore_s": round(full_s, 4),
             }

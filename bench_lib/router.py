@@ -1,0 +1,290 @@
+"""Bench section ``serving.router`` (ISSUE 20): the fleet front door
+holds client-visible p95 flat through the exact churn the serving
+plane absorbs underneath it.
+
+Open-loop ``/predict`` load (bench_lib.load's arrival discipline; the
+submits ride a thread pool so a slow answer never throttles the
+arrival process) flows through an in-process ``RequestRouter`` over
+THREE real HTTP serving replicas.  A baseline window measures the
+clean-fleet client p95; then the same load runs while the fleet takes,
+in order:
+
+- a **rolling drain** — the scale-down actuator's shape: drain intent
+  to the router first (steer-before-503), then the replica's graceful
+  drain, then a pre-warmed replacement joins the plan;
+- a **hot swap** — a new checkpoint step lands in the shared store and
+  every replica re-binds weights under load;
+- one **abrupt kill** — a replica's HTTP front dies with requests in
+  flight (no drain, no deregistration: the router's passive health
+  must eject it off consecutive failures).
+
+Gated: client-visible failures == 0 (every request answers through
+the front door), churn-window p95 <= 2x the baseline p95, and 0
+steady-state XLA compiles (routing and failover never touch the
+compile path).  The seeded router chaos soak (the same helper the
+EDL_STRESS lane reruns) runs twice and its recorder digests + stage
+logs must be bit-identical — the determinism claim as a bench figure.
+"""
+
+from __future__ import annotations
+
+
+def bench_router() -> dict:
+    import threading
+    import time
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from bench_lib.load import arrival_offsets, run_open_loop
+    from edl_tpu import telemetry
+    from edl_tpu.checkpoint import HostDRAMStore
+    from edl_tpu.models.base import get_model
+    from edl_tpu.runtime.coordinator import LocalCoordinator
+    from edl_tpu.runtime.train import TrainState
+    from edl_tpu.serving import (
+        ContinuousBatcher,
+        InferenceEngine,
+        RequestRouter,
+        ServingReplica,
+        ServingServer,
+    )
+
+    model = get_model("fit_a_line")
+    params = model.init_params(jax.random.key(0))
+    opt = optax.adam(1e-3)
+
+    def state_at(step: int) -> TrainState:
+        return TrainState(
+            step=jnp.asarray(step, jnp.int32),
+            params=params,
+            opt_state=opt.init(params),
+        )
+
+    store = HostDRAMStore()
+    store.save_async(state_at(1))
+    store.wait()
+    coord = LocalCoordinator(
+        target_world=8, max_world=8, heartbeat_timeout=1e9
+    )
+
+    def _engine():
+        e = InferenceEngine(
+            model, store, devices=jax.devices()[:1], max_batch=8
+        )
+        e.load()
+        e.warm()
+        return e
+
+    def _replica(engine, rid):
+        batcher = ContinuousBatcher(
+            engine, queue_limit=8192, default_deadline_s=60.0
+        )
+        server = ServingServer(batcher, host="127.0.0.1")
+        return ServingReplica(
+            engine,
+            batcher=batcher,
+            server=server,
+            coordinator=coord,
+            replica_id=rid,
+            address=f"127.0.0.1:{server.port}",
+            heartbeat_interval=60.0,
+            telemetry_interval=1e9,
+        ).start()
+
+    # All four engines warm BEFORE the compile seam goes in: the
+    # rolling replacement enters rotation pre-warmed (the /prewarm
+    # contract), so its join must not count as a steady-state compile.
+    engines = [_engine() for _ in range(4)]
+    replicas = [
+        _replica(engines[i], f"bench-rt-{i}") for i in range(3)
+    ]
+    router = RequestRouter(coord, retry_budget_s=20.0)
+    router.sync()
+    router.probe_all()
+
+    maintain_stop = threading.Event()
+
+    def _maintain():
+        while not maintain_stop.is_set():
+            try:
+                router.sync()
+                router.probe_all()
+            except Exception:
+                pass
+            maintain_stop.wait(0.05)
+
+    maintainer = threading.Thread(target=_maintain, daemon=True)
+    maintainer.start()
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 13).astype(np.float32)
+
+    import jax._src.compiler as _compiler
+
+    m_compiles = telemetry.get_registry().counter(
+        "edl_xla_compiles_total"
+    )
+    compiles_before = m_compiles.value()
+    _real_bc = _compiler.backend_compile
+
+    def _counting_bc(*args, **kwargs):
+        m_compiles.inc()
+        return _real_bc(*args, **kwargs)
+
+    _compiler.backend_compile = _counting_bc
+    pool = ThreadPoolExecutor(max_workers=64)
+    failures = []
+
+    def _phase(rate_rps: float, n: int) -> dict:
+        """One open-loop window through the front door; every request
+        either answers or lands in ``failures`` (the gated count)."""
+        latencies = []
+        lock = threading.Lock()
+
+        def one(i: int) -> None:
+            row = xs[i % len(xs)][None]
+            t0 = time.perf_counter()
+            try:
+                out = router.predict({"inputs": {"x": row.tolist()}})
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+                assert "pred" in out["outputs"]
+            except Exception as e:  # noqa: BLE001 - the gated signal
+                with lock:
+                    failures.append(f"{type(e).__name__}: {e}")
+
+        futures, lstats = run_open_loop(
+            lambda i: pool.submit(one, i),
+            arrival_offsets(rate_rps, n),
+        )
+        for f in futures:
+            f.result(timeout=120)
+        ordered = sorted(latencies)
+        return {
+            "n": n,
+            "offered_rps": rate_rps,
+            "scheduler_lag_max_s": lstats["scheduler_lag_max_s"],
+            "answered": len(latencies),
+            "p50_ms": round(
+                ordered[len(ordered) // 2] * 1000.0, 3
+            ) if ordered else None,
+            "p95_ms": round(
+                ordered[int(len(ordered) * 0.95)] * 1000.0, 3
+            ) if ordered else None,
+        }
+
+    churn_log = []
+    try:
+        # warm the request path (first request may lazily touch
+        # serialization paths; it is not part of either window)
+        router.predict({"inputs": {"x": xs[:1].tolist()}})
+
+        baseline = _phase(200.0, 300)
+
+        # -- churn window: the same load while the fleet rolls --------
+        churn_done = threading.Event()
+
+        def _churn():
+            try:
+                # rolling drain of replica 0: intent -> graceful
+                # drain -> replacement joins pre-warmed
+                time.sleep(0.3)
+                victim = replicas[0]
+                router.mark_draining(
+                    [victim.replica_id], trace="bench-roll"
+                )
+                r = victim.drain(budget_s=30.0)
+                churn_log.append(
+                    ("drain", bool(r["drained"]),
+                     round(r["seconds"] * 1000.0, 1))
+                )
+                victim.stop()
+                replicas.append(_replica(engines[3], "bench-rt-3"))
+                churn_log.append(("replace", "bench-rt-3"))
+                # hot swap: every replica re-binds the new step
+                time.sleep(0.5)
+                gen0 = engines[1].weights_generation
+                store.save_async(state_at(100))
+                store.wait()
+                t_swap = time.perf_counter()
+                while engines[1].weights_generation == gen0:
+                    if time.perf_counter() - t_swap > 30:
+                        break
+                    time.sleep(0.002)
+                churn_log.append(
+                    ("swap", engines[1].weights_step,
+                     round((time.perf_counter() - t_swap) * 1000.0, 1))
+                )
+                # abrupt kill: replica 1's front dies mid-flight; the
+                # router's passive health must absorb + eject it
+                time.sleep(0.3)
+                replicas[1].server.stop()
+                churn_log.append(("kill", replicas[1].replica_id))
+            finally:
+                churn_done.set()
+
+        churn_thread = threading.Thread(target=_churn, daemon=True)
+        churn_thread.start()
+        during = _phase(200.0, 600)
+        churn_thread.join(timeout=60)
+        assert churn_done.is_set(), "churn script never finished"
+
+        steady_compiles = int(m_compiles.value() - compiles_before)
+        client_failures = len(failures)
+        assert client_failures == 0, (
+            f"{client_failures} client-visible failures through the "
+            f"router: {failures[:3]}"
+        )
+        assert steady_compiles == 0, (
+            f"{steady_compiles} XLA compiles on the routed request path"
+        )
+        p95_ratio = (
+            round(during["p95_ms"] / baseline["p95_ms"], 3)
+            if during["p95_ms"] and baseline["p95_ms"]
+            else None
+        )
+        table = router.routing_table()
+        killed = next(
+            r for r in table["replicas"]
+            if r["replica"] == "bench-rt-1"
+        )
+    finally:
+        maintain_stop.set()
+        _compiler.backend_compile = _real_bc
+        pool.shutdown(wait=False)
+        for rep in replicas:
+            try:
+                rep.stop()
+            except Exception:
+                pass
+
+    # -- the seeded chaos soak, twice: determinism as a figure --------
+    from tests.test_router import _run_router_soak
+
+    d1, log1 = _run_router_soak(7)
+    d2, log2 = _run_router_soak(7)
+    soak = {
+        "seed": 7,
+        "digest": d1,
+        "stages": [entry[0] for entry in log1],
+        "bit_identical": bool(d1 == d2 and log1 == log2),
+    }
+    assert soak["bit_identical"], "router soak diverged across reruns"
+
+    return {
+        "model": "fit_a_line",
+        "fleet": 3,
+        "baseline": baseline,
+        "during_churn": during,
+        "p95_ratio": p95_ratio,
+        "client_failures": client_failures,
+        "steady_state_xla_compiles": steady_compiles,
+        "churn_events": [list(e) for e in churn_log],
+        "killed_replica_state": killed["health"],
+        "soak": soak,
+    }

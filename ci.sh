@@ -26,7 +26,7 @@ python tools/lint.py
 # thresholds, over the committed BENCH snapshot (or a fresh record
 # via EDL_BENCH_RECORD=path).  Milliseconds; a violated baseline
 # fails before the suite spends its budget.
-python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r14.json}" \
+python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r15.json}" \
   --thresholds bench_thresholds.json
 
 # Stress lane (EDL_STRESS=1): rerun the multipod elastic scale-down
@@ -40,7 +40,11 @@ python tools/check_bench.py "${EDL_BENCH_RECORD:-BENCH_r14.json}" \
 # watchdog races are exactly the class a single green run can hide.
 # Since ISSUE 16 the live-KV MIGRATION soak (kill/torn/exhausted/swap
 # chaos mid-push, every fallback rung exercised, bit-identical
-# journals per seed) joins it for the same reason.
+# journals per seed) joins it for the same reason.  Since ISSUE 20 the
+# ROUTER chaos soak (refused backends, failing probes, mid-stream
+# cuts, drain steers — eject/readmit/redrive all exercised,
+# bit-identical journals per seed) reruns in the loop too: the front
+# door's retry/eject ladder is timing-adjacent by construction.
 if [ "${EDL_STRESS:-0}" = "1" ]; then
   N="${EDL_STRESS_N:-5}"
   # Post-mortem wiring: each iteration leaves a metrics snapshot +
@@ -52,8 +56,8 @@ if [ "${EDL_STRESS:-0}" = "1" ]; then
     echo "[stress] multipod scale-down iteration $i/$N"
     if ! timeout -k 10 870 python -m pytest \
       tests/test_multipod.py tests/test_serving_chaos.py \
-      tests/test_serving_migrate.py -x -q \
-      -k "elastic_1_2_1 or delayed_poll or serving_chaos or migration_soak" \
+      tests/test_serving_migrate.py tests/test_router.py -x -q \
+      -k "elastic_1_2_1 or delayed_poll or serving_chaos or migration_soak or router_chaos_soak" \
       -p no:cacheprovider "$@"; then
       echo "[stress] FAILED iteration $i/$N"
       events="${EDL_METRICS_ARTIFACT%.prom}.events.jsonl"

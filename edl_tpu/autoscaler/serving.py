@@ -36,6 +36,7 @@ def post_drain(
     budget_s: float,
     timeout: Optional[float] = None,
     migrate_to: Optional[str] = None,
+    trace: Optional[str] = None,
 ) -> dict:
     """POST /drain to one serving replica and block for its ack (the
     reply carries ``drained``).  The scale-down actuators call this
@@ -52,6 +53,11 @@ def post_drain(
     body = {"budget_ms": int(budget_s * 1000.0), "wait": True}
     if migrate_to:
         body["migrate_to"] = migrate_to
+    if trace:
+        # the decision's causal-trace id: the victim journals its
+        # drain under it, so decision -> route steer -> drain ack
+        # reads as ONE chain in the merged timeline
+        body["trace"] = trace
     req = urllib.request.Request(
         address.rstrip("/") + "/drain",
         data=json.dumps(body).encode(),
@@ -87,6 +93,7 @@ class ServingLane:
         on_scale=None,
         ttft_high_s: Optional[float] = None,
         victim_drain_timeout: float = 10.0,
+        router=None,
     ):
         if min_replicas < 1 or max_replicas < min_replicas:
             raise ValueError(
@@ -112,6 +119,13 @@ class ServingLane:
         #: this long to finish its in-flight generations before the
         #: lane gives up for this tick and retries next tick
         self.victim_drain_timeout = victim_drain_timeout
+        #: the fleet front door (ISSUE 20): a RequestRouter-shaped
+        #: object (``mark_draining(ids, trace=)``) or a routerd
+        #: ``host:port`` string.  The lane publishes drain INTENTS to
+        #: it BEFORE POSTing /drain to the victims, so new admissions
+        #: steer off a victim before it can 503 a single one — the
+        #: drain ack then implies the router stopped sending first.
+        self.router = router
         self._low_ticks = 0
         #: cumulative rejected-request count at the previous tick: the
         #: overload signal is the per-tick DELTA, not the lifetime
@@ -285,7 +299,42 @@ class ServingLane:
         ) or self.min_replicas
 
     # -- graceful scale-down (ISSUE 15) --------------------------------------
-    def drain_victims(self, current: int, proposed: int) -> dict:
+    def _publish_drain_intent(
+        self, victim_ids: List[str], trace: str
+    ) -> None:
+        """Tell the router who is leaving, before anyone tells the
+        victims.  Best-effort on the ROUTER side (a dark router must
+        not block a scale-down — the victims' own 503s are the
+        fallback steer signal), but ordered strictly BEFORE the
+        drains so the victim-ack implies steering already happened."""
+        if self.router is None or not victim_ids:
+            return
+        try:
+            if isinstance(self.router, str):
+                import json as _json
+                import urllib.request as _rq
+
+                addr = self.router
+                if "://" not in addr:
+                    addr = f"http://{addr}"
+                req = _rq.Request(
+                    addr.rstrip("/") + "/drain_intent",
+                    data=_json.dumps(
+                        {"replicas": victim_ids, "trace": trace}
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with _rq.urlopen(req, timeout=5.0):
+                    pass
+            else:
+                self.router.mark_draining(victim_ids, trace=trace or None)
+        except Exception:
+            pass
+
+    def drain_victims(
+        self, current: int, proposed: int, trace: str = ""
+    ) -> dict:
         """Drain-victim-ack-then-patch: before a scale-down's retarget
         (and long before its Deployment patch), POST /drain to every
         victim replica and wait for the ack — so the patch can never
@@ -319,7 +368,11 @@ class ServingLane:
         )
         if migrate_to:
             info["migrate_to"] = migrate_to
-        for rid, addr in list(zip(members, addresses))[proposed:]:
+        victims = list(zip(members, addresses))[proposed:]
+        # Front-door ordering (ISSUE 20): the router hears the drain
+        # intent before any victim hears the drain.
+        self._publish_drain_intent([rid for rid, _ in victims], trace)
+        for rid, addr in victims:
             entry = {"replica": rid, "address": addr, "acked": True}
             if addr:
                 try:
@@ -327,6 +380,7 @@ class ServingLane:
                         addr,
                         self.victim_drain_timeout,
                         migrate_to=migrate_to,
+                        trace=trace or None,
                     )
                     entry["acked"] = bool(r.get("drained"))
                     if "migrate" in r:
@@ -380,7 +434,9 @@ class ServingLane:
                 # the budget -> no actuation this tick (the started
                 # drain keeps running; next tick retries and patches).
                 try:
-                    drain = self.drain_victims(current, proposed)
+                    drain = self.drain_victims(
+                        current, proposed, trace=trace_id
+                    )
                 except Exception as e:
                     # A safety interlock fails CLOSED: if the drain
                     # handshake itself broke (plan fetch raised, a

@@ -132,6 +132,21 @@ KNOWN_POINTS = (
                                  # colliding: the stored (h_prev,
                                  # tokens) verification must reject
                                  # the entry (miss, never wrong K/V)
+    # (8e) fleet front door request router (ISSUE 20)
+    "route.backend.refused",     # the router's next proxy attempt is
+                                 # treated as connection-refused
+                                 # without contacting the backend (the
+                                 # passive-health / retry-absorption
+                                 # path under test control)
+    "route.probe.fail",          # the next active /healthz probe of an
+                                 # ejected replica is forced to fail
+                                 # (it must STAY ejected until a real
+                                 # probe succeeds)
+    "route.stream.cut",          # the router tears down one relayed
+                                 # /generate stream after its next
+                                 # token line (the replica-kill shape
+                                 # from the router's seat: re-drive on
+                                 # a survivor, no token dup/drop)
 )
 
 

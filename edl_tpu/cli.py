@@ -504,6 +504,45 @@ def cmd_metrics(args) -> int:
         req = counters_all.get("edl_serve_requests_total") or {}
         for key in sorted(req):
             print(f"  requests{{{key}}}{'':<10} {req[key]:g}")
+    if any(
+        name.startswith("edl_route_")
+        for section in (gauges_all, counters_all)
+        for name in section
+    ):
+        # Front-door summary (ISSUE 20): the fault-masking the router
+        # did on the fleet's behalf — backends by health state, request
+        # outcomes, steers off draining replicas, per-attempt failures
+        # absorbed, the eject/readmit ledger, and stream re-drives.
+        print("router")
+        backends = gauges_all.get("edl_route_backends") or {}
+        for key in sorted(backends):
+            print(f"  backends{{{key}}}{'':<8} {backends[key]:g}")
+        rreq = counters_all.get("edl_route_requests_total") or {}
+        for key in sorted(rreq):
+            print(f"  requests{{{key}}}{'':<8} {rreq[key]:g}")
+        rsteer = counters_all.get("edl_route_steers_total") or {}
+        if rsteer:
+            print(f"  {'steers_total':<24} {sum(rsteer.values()):g}")
+        rretry = counters_all.get("edl_route_retries_total") or {}
+        if rretry:
+            print(
+                f"  {'retries_absorbed':<24} {sum(rretry.values()):g}"
+            )
+            for key in sorted(rretry):
+                print(f"  retries{{{key}}}{'':<9} {rretry[key]:g}")
+        for cname, tag in (
+            ("edl_route_ejections_total", "ejections_total"),
+            ("edl_route_readmits_total", "readmits_total"),
+        ):
+            c = counters_all.get(cname) or {}
+            if c:
+                print(f"  {tag:<24} {sum(c.values()):g}")
+        rdrv = counters_all.get("edl_route_redrives_total") or {}
+        for key in sorted(rdrv):
+            print(f"  redrives{{{key}}}{'':<8} {rdrv[key]:g}")
+        raff = counters_all.get("edl_route_affinity_total") or {}
+        for key in sorted(raff):
+            print(f"  affinity{{{key}}}{'':<8} {raff[key]:g}")
     counters = counters_all
     if counters:
         print("counters (merged across trainers)")
@@ -531,6 +570,55 @@ def cmd_metrics(args) -> int:
                 f"  step={ev.get('step'):<7} gen={ev.get('generation'):<4} "
                 f"{ev.get('kind'):<20} {data}"
             )
+    return 0
+
+
+def cmd_route(args) -> int:
+    """Print a routerd's live routing table (`edl route <host:port>`):
+    every backend the front door knows, its health state
+    (healthy/draining/ejected), the live load score admissions are
+    spread by, and the vitals behind it — the operator's answer to
+    \"where is my traffic going and why\"."""
+    import urllib.request
+
+    addr = args.url if "//" in args.url else f"http://{args.url}"
+    with urllib.request.urlopen(
+        f"{addr}/routes", timeout=args.timeout
+    ) as resp:
+        table = json.loads(resp.read())
+    if args.json:
+        print(json.dumps(table, indent=2))
+        return 0
+    print("router")
+    print(f"  {'plan_generation':<24} {table.get('plan_generation')}")
+    p95 = table.get("ttft_p95_s")
+    print(
+        f"  {'fleet_ttft_p95':<24} "
+        f"{f'{p95 * 1000:.1f} ms' if p95 is not None else 'n/a'}"
+    )
+    print(
+        f"  {'affinity_entries':<24} {table.get('affinity_entries', 0)}"
+    )
+    replicas = table.get("replicas") or []
+    if not replicas:
+        print("  (no backends)")
+        return 0
+    print(
+        f"  {'replica':<12} {'address':<22} {'health':<9} "
+        f"{'score':>7} {'queue':>6} {'kv':>6} {'fails':>6} gen"
+    )
+    for r in sorted(replicas, key=lambda x: x.get("score") or 0.0):
+        kv = r.get("kv_occupancy") or 0.0
+        print(
+            f"  {r.get('replica', '?'):<12} "
+            f"{r.get('address', '?'):<22} "
+            f"{r.get('health', '?'):<9} "
+            f"{r.get('score', 0.0):>7.2f} "
+            f"{r.get('queue_depth', 0):>6g} "
+            f"{kv:>6.2f} "
+            f"{r.get('consecutive_failures', 0):>6g} "
+            f"{'yes' if r.get('can_generate') else 'no'}"
+        )
     return 0
 
 
@@ -997,6 +1085,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--timeout", type=float, default=5.0)
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "route",
+        help="print a routerd's live routing table (backends, health, "
+        "load scores)",
+    )
+    s.add_argument("url", help="router address (host:port)")
+    s.add_argument("--json", action="store_true", help="dump raw JSON")
+    s.add_argument("--timeout", type=float, default=5.0)
+    s.set_defaults(fn=cmd_route)
 
     s = sub.add_parser(
         "fleet",

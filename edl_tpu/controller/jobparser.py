@@ -404,6 +404,39 @@ def parse_to_coordinator(job: TrainingJob) -> List[Dict[str, Any]]:
 SERVE_DRAIN_MS = 30000
 SERVE_TERMINATION_GRACE_S = 45
 
+#: the fleet front door's listen port and routing knobs (the
+#: ``EDL_ROUTE_*`` contract ``edl_tpu.serving.router.main`` reads):
+#: per-request retry budget, active-probe cadence, and the
+#: consecutive-failure count that ejects a replica from rotation
+ROUTE_PORT = 7190
+ROUTE_RETRY_BUDGET_MS = 10000
+ROUTE_PROBE_MS = 500
+ROUTE_EJECT_AFTER = 3
+
+
+def router_pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
+    """Router pod environment: the ``EDL_ROUTE_*`` contract
+    (``edl_tpu.serving.router.main`` reads it) plus the serving
+    coordinator address the router feeds from — plan membership,
+    merged telemetry, and drain flight events all come from there."""
+    return [
+        {"name": "EDL_JOB_NAME", "value": job.name},
+        {
+            "name": "EDL_COORDINATOR_ADDR",
+            "value": f"{job.serving_coordinator_name()}:{job.spec.port}",
+        },
+        {"name": "EDL_ROUTE_PORT", "value": str(ROUTE_PORT)},
+        {
+            "name": "EDL_ROUTE_RETRY_BUDGET_MS",
+            "value": str(ROUTE_RETRY_BUDGET_MS),
+        },
+        {"name": "EDL_ROUTE_PROBE_MS", "value": str(ROUTE_PROBE_MS)},
+        {
+            "name": "EDL_ROUTE_EJECT_AFTER",
+            "value": str(ROUTE_EJECT_AFTER),
+        },
+    ]
+
 
 def serving_pod_env(job: TrainingJob) -> List[Dict[str, Any]]:
     """Serving-replica pod environment: the ``EDL_SERVE_*`` contract
@@ -574,7 +607,55 @@ def parse_to_serving_manifests(job: TrainingJob) -> List[Dict[str, Any]]:
             "ports": [{"name": "predict", "port": sv.port}],
         },
     }
-    return [coord, coord_svc, deployment, front]
+    # The fleet front door (ISSUE 20): a routerd Deployment-of-1 + the
+    # Service clients actually point at.  Replicas keep their own
+    # Service (the router dials them by plan address, and the lane's
+    # kube glue still needs it), but the published entry point is the
+    # router — it steers around drains, absorbs replica churn, and
+    # re-drives cut streams so clients never see the 503s beneath it.
+    router_labels = {OWNER_LABEL: job.name, ROLE_LABEL: "router"}
+    router = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": meta(job.router_name(), router_labels),
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": dict(router_labels)},
+            "template": {
+                "metadata": {"labels": dict(router_labels)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "router",
+                            "image": job.spec.image,
+                            "command": [
+                                "python",
+                                "-m",
+                                "edl_tpu.serving.router",
+                            ],
+                            "env": router_pod_env(job),
+                            "ports": [
+                                {
+                                    "name": "route",
+                                    "containerPort": ROUTE_PORT,
+                                }
+                            ],
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    router_svc = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta(job.router_name(), router_labels),
+        "spec": {
+            "selector": dict(router_labels),
+            "ports": [{"name": "route", "port": ROUTE_PORT}],
+        },
+    }
+    return [coord, coord_svc, deployment, front, router, router_svc]
 
 
 class JobParser:

@@ -98,6 +98,14 @@ def env_config() -> dict:
         "serve_max_batch": int(e.get("EDL_SERVE_MAX_BATCH", "64")),
         "serve_queue_limit": int(e.get("EDL_SERVE_QUEUE_LIMIT", "256")),
         "serve_deadline_ms": int(e.get("EDL_SERVE_DEADLINE_MS", "2000")),
+        # Router pod contract (edl_tpu.serving.router.main reads these;
+        # jobparser's router Deployment sets them).
+        "route_port": int(e.get("EDL_ROUTE_PORT", "7190")),
+        "route_retry_budget_ms": float(
+            e.get("EDL_ROUTE_RETRY_BUDGET_MS", "10000")
+        ),
+        "route_probe_ms": float(e.get("EDL_ROUTE_PROBE_MS", "500")),
+        "route_eject_after": int(e.get("EDL_ROUTE_EJECT_AFTER", "3")),
         # Multi-host slice placement: replica index from the per-replica
         # Job's env; host index from the Indexed Job's completion index
         # (k8s injects JOB_COMPLETION_INDEX; EDL_HOST_INDEX overrides

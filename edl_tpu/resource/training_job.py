@@ -495,6 +495,11 @@ class TrainingJob:
         plan's rank order)."""
         return f"{self.name}-serve-coordinator"
 
+    def router_name(self) -> str:
+        """The fleet front door (routerd) Deployment/Service:
+        ``<job>-router`` — what clients actually point at."""
+        return f"{self.name}-router"
+
     # -- validation + defaulting (ref DefaultJobParser.Validate,
     #    pkg/jobparser.go:47-71) --------------------------------------------
     def validate(self) -> "TrainingJob":

@@ -24,6 +24,16 @@ token budget riding beside the decode step (Sarathi-Serve's
 stall-free batching), so an admission never stalls the running
 batch's token cadence.
 
+The fleet front door (``edl_tpu.serving.router``, ISSUE 20) hides all
+of that churn from clients: a coordinator-fed ``RequestRouter`` (and
+its ``routerd`` HTTP front) spreads admissions by live queue depth /
+KV occupancy, steers new work off draining replicas before the 503,
+absorbs 503/429/connection-refused under a per-request retry budget
+(``RetryingClient``, the shared client-side fallback library),
+ejects failing replicas on passive health and re-admits them by
+active probe, and re-drives a cut /generate stream on a survivor
+without duplicating or dropping a token.
+
 Drains and preemptions MIGRATE live sequences instead of waiting
 (``edl_tpu.serving.migrate``): filled KV blocks + cursor move to a
 survivor over a fabric-style chunked-TCP push and decode resumes
@@ -50,7 +60,20 @@ from edl_tpu.serving.engine import (
     NotReadyError,
     PromptTooLongError,
 )
+from edl_tpu.serving.client import (
+    HTTPTarget,
+    RetryBudgetExhausted,
+    RetryingClient,
+    UpstreamClientError,
+    http_call,
+)
 from edl_tpu.serving.prefix import PrefixCache, chain_hashes
+from edl_tpu.serving.router import (
+    ReplicaView,
+    RequestRouter,
+    RouterServer,
+    route_run,
+)
 from edl_tpu.serving.migrate import (
     MigrationError,
     MigrationReceiver,
@@ -73,16 +96,25 @@ __all__ = [
     "MigrationError",
     "MigrationReceiver",
     "MigrationRefusedError",
+    "HTTPTarget",
     "NotReadyError",
     "PrefixCache",
     "PromptTooLongError",
     "QueueFullError",
+    "ReplicaView",
+    "RequestRouter",
+    "RetryBudgetExhausted",
+    "RetryingClient",
+    "RouterServer",
     "ServingReplica",
     "ServingServer",
     "Ticket",
     "TokenContinuousBatcher",
     "TornMigrationError",
+    "UpstreamClientError",
     "chain_hashes",
+    "http_call",
     "migrate_out",
+    "route_run",
     "serve_run",
 ]

@@ -1033,11 +1033,13 @@ class TokenContinuousBatcher:
                 t._reject(e)
                 continue
             t.chunks += 1
-            self._join_decode(t, first, plen)
+            self._join_decode(t, first, plen, weights)
             joined += 1
         return joined
 
-    def _join_decode(self, t: GenerateTicket, first: int, plen: int) -> None:
+    def _join_decode(
+        self, t: GenerateTicket, first: int, plen: int, weights
+    ) -> None:
         """The TTFT moment: a fully-prefilled sequence emits its first
         token and joins the running decode batch.  Shared by monolithic
         join and the final chunk of a chunked prefill — TTFT is
@@ -1058,7 +1060,16 @@ class TokenContinuousBatcher:
         t.last_token = first
         t.last_time = now
         t.tokens.append(first)
-        t._event({"token": first, "i": 0})
+        # The FIRST token of a (re)started sequence names the weights
+        # that produced it: a stream relay (the router's /generate
+        # re-drive) decides resume-vs-restart off this stamp — the
+        # generation-purity rule made visible at the stream surface.
+        t._event({
+            "token": first,
+            "i": 0,
+            "weights_step": weights.step,
+            "weights_generation": weights.generation,
+        })
         self._m_tokens.inc()
         self._active.append(t)
         if self._seq_finished(t):
@@ -1214,7 +1225,7 @@ class TokenContinuousBatcher:
                     # them alive while it decodes; at refcount 0 they
                     # park on the pool's cached LRU for reuse.
                     self.prefix.publish(t.prompt, t.blocks)
-                self._join_decode(t, first, plen)
+                self._join_decode(t, first, plen, weights)
         return dispatched
 
     def _seq_finished(self, t: GenerateTicket) -> bool:
@@ -1293,7 +1304,13 @@ class TokenContinuousBatcher:
             t.tokens.append(tok)
             self._m_intertoken.observe(now - t.last_time)
             t.last_time = now
-            t._event({"token": tok, "i": len(t.tokens) - 1})
+            ev = {"token": tok, "i": len(t.tokens) - 1}
+            if ev["i"] == 0:
+                # chunked admissions emit their first token here, not
+                # in _admit — same purity stamp (see _admit)
+                ev["weights_step"] = weights.step
+                ev["weights_generation"] = weights.generation
+            t._event(ev)
             if self._seq_finished(t):
                 self._finish(t)
         return len(ready)

@@ -114,6 +114,16 @@ class ServingServer:
                         "weights_generation": engine.weights_generation,
                         "warm_buckets": list(engine.warm_buckets),
                         "queue_depth": self.server_batcher.depth,
+                        # backpressure surface (ISSUE 20): how close
+                        # admission is to the 429 wall — the router's
+                        # least-loaded scoring reads this, clients
+                        # should not
+                        "queue_limit": self.server_batcher.queue_limit,
+                        "saturation": round(
+                            self.server_batcher.depth
+                            / max(1, self.server_batcher.queue_limit),
+                            4,
+                        ),
                         # drain posture: admission state + what is
                         # still in flight (the scale-down victim-ack
                         # signal a poller can watch)
@@ -139,6 +149,10 @@ class ServingServer:
                             "block_tokens": engine.block_tokens,
                             "active_sequences": gen.active_count,
                             "decode_queue_depth": gen.depth,
+                            "queue_limit": gen.queue_limit,
+                            "saturation": round(
+                                gen.depth / max(1, gen.queue_limit), 4
+                            ),
                             "kv_occupancy": round(
                                 engine.pool.occupancy(), 4
                             ),
@@ -449,12 +463,15 @@ class ServingServer:
                 )
                 wait = bool(req.get("wait", True))
                 migrate_to = req.get("migrate_to") or None
+                trace = req.get("trace") or None
                 rep = self_server.replica
                 if rep is not None:
                     if wait:
                         self._reply(
                             rep.drain(
-                                budget_s=budget_s, migrate_to=migrate_to
+                                budget_s=budget_s,
+                                migrate_to=migrate_to,
+                                trace=trace,
                             )
                         )
                     else:
@@ -463,6 +480,7 @@ class ServingServer:
                             kwargs={
                                 "budget_s": budget_s,
                                 "migrate_to": migrate_to,
+                                "trace": trace,
                             },
                             daemon=True,
                             name="edl-serve-drain",
@@ -591,6 +609,9 @@ class ServingReplica:
         #: admission stays closed, membership KEPT, retryable)
         self._drain_lock = threading.Lock()
         self._drain_state: Optional[str] = None
+        #: causal-trace id the drain journals under (the actuator's
+        #: decision trace, when the POST /drain body carried one)
+        self._drain_trace: Optional[str] = None
         self._drain_evt: Optional[threading.Event] = None
         self._drain_result: Optional[dict] = None
         #: per-sequence drain progress (ISSUE 16 satellite): the first
@@ -704,6 +725,7 @@ class ServingReplica:
         self,
         budget_s: Optional[float] = None,
         migrate_to: Optional[str] = None,
+        trace: Optional[str] = None,
     ) -> dict:
         """The graceful-shutdown contract, in order: (1) close
         admission — later requests get 503 + Retry-After (distinct
@@ -757,12 +779,18 @@ class ServingReplica:
             # its result) or came up incomplete (retry as the owner)
         t0 = time.monotonic()
         self._g_draining.set(1, replica=self.replica_id)
+        if trace:
+            # the actuator's decision trace (ServingLane run_once →
+            # router steer → this drain): one causal chain in the
+            # merged journal (ISSUE 20 satellite)
+            self._drain_trace = trace
         if first:
             # counters/journal count DRAINS, not retry attempts
             self._m_drains.inc()
             self.recorder.record(
                 "serve.drain",
                 {"replica": self.replica_id, "phase": "start"},
+                trace=self._drain_trace,
             )
         self.batcher.close_admission()
         if self.gen_batcher is not None:
@@ -858,6 +886,7 @@ class ServingReplica:
                     "migrated": self._drain_migrated,
                 },
                 timing={"seconds": round(dt, 6), "in_flight": leftover},
+                trace=self._drain_trace,
             )
         result = {
             "draining": True,

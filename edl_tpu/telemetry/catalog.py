@@ -640,6 +640,67 @@ CATALOG = {
         ),
         "labels": (),
     },
+    # -- fleet front door: fault-masking request router (ISSUE 20) ----------
+    "edl_route_requests_total": {
+        "type": "counter",
+        "help": "Requests the router resolved, by outcome: ok (served, "
+        "possibly after absorbed retries), exhausted (per-request "
+        "retry budget spent -> typed RetryBudgetExhausted), error "
+        "(non-retryable upstream reply passed through).",
+        "labels": ("outcome",),
+    },
+    "edl_route_retries_total": {
+        "type": "counter",
+        "help": "Per-attempt failures the router absorbed invisibly "
+        "(the client never saw them), by what the backend said: "
+        "queue_full (429 back-off-here), draining (503 go-elsewhere), "
+        "refused (connection refused/reset = dead replica), error "
+        "(other 5xx/transport failure).",
+        "labels": ("reason",),
+    },
+    "edl_route_steers_total": {
+        "type": "counter",
+        "help": "Admissions steered off a draining replica BEFORE it "
+        "could 503 them (the router consumed the drain intent / "
+        "healthz draining bit first).",
+        "labels": (),
+    },
+    "edl_route_ejections_total": {
+        "type": "counter",
+        "help": "Replicas ejected from rotation on consecutive-failure "
+        "passive health (re-admission is by active probe only).",
+        "labels": (),
+    },
+    "edl_route_readmits_total": {
+        "type": "counter",
+        "help": "Ejected replicas re-admitted after an active /healthz "
+        "probe came back ok and not draining.",
+        "labels": (),
+    },
+    "edl_route_redrives_total": {
+        "type": "counter",
+        "help": "In-flight /generate streams cut by a replica failure "
+        "and re-driven against a survivor: resume (same weights step "
+        "-> greedy continuation from the emitted prefix, no token "
+        "duplicated or dropped) or restart (weights skew -> restart "
+        "event voids prior tokens, the batcher's hot-swap contract).",
+        "labels": ("outcome",),
+    },
+    "edl_route_affinity_total": {
+        "type": "counter",
+        "help": "Prefix-affinity consults on /generate admissions: hit "
+        "(routed to the replica already holding the prompt's cached "
+        "prefix blocks) or miss (no affinity known, or the affine "
+        "replica was unroutable/overloaded — advisory only, never "
+        "correctness-bearing).",
+        "labels": ("outcome",),
+    },
+    "edl_route_backends": {
+        "type": "gauge",
+        "help": "Routable-backend census by health state (healthy / "
+        "draining / ejected), from the router's last plan sync.",
+        "labels": ("state",),
+    },
     # -- multi-job fleet market (edl_tpu.fleet) ------------------------------
     "edl_fleet_chips_total": {
         "type": "gauge",
@@ -751,6 +812,12 @@ KNOWN_EVENT_KINDS = {
     "serve.watchdog": "a serving dispatch missed the watchdog deadline",
     "serve.migrate": "a live KV sequence moved (or fell back) at drain",
     "serve.prefix": "the KV prefix cache invalidated / rejected / evicted",
+    # fleet front door: fault-masking request router (ISSUE 20)
+    "route.steer": "new work steered off a draining replica pre-503",
+    "route.eject": "a replica left rotation on passive health",
+    "route.readmit": "an active probe re-admitted an ejected replica",
+    "route.redrive": "a cut /generate stream re-driven on a survivor",
+    "route.exhausted": "a request spent its whole retry budget",
     # recorder-internal default for ingested events missing a kind
     "event": "unclassified ingested event",
 }

@@ -1,0 +1,1132 @@
+"""ISSUE 20 acceptance: the fleet front door.
+
+Three layers of coverage:
+
+- ``RetryingClient`` unit contract (fake targets, fake clock): 429
+  backs off HERE honoring Retry-After, 503 goes ELSEWHERE, refused is
+  dead, 4xx is never retried, and exhaustion is TYPED — ``saturated``
+  only when the last full pass over the fleet was queue-full end to
+  end.
+- ``RequestRouter`` logic against scriptable stub backends (no JAX):
+  least-loaded pick, steer-before-503 from all three drain signals
+  (intent, journal, healthz bit), passive eject + active-probe-only
+  readmit, saturated-503 vs broken-502 at the ``RouterServer`` front,
+  advisory prefix affinity.
+- Live JAX fleets: /predict through a real fit fleet, mid-stream
+  /generate re-drive (RESUME on a matching purity stamp, RESTART on
+  skew), the drain-ordering guarantee (victim ack implies the router
+  already steered — one trace id across ServingLane decision ->
+  route.steer -> serve.drain ack), and the seeded router chaos soak
+  whose recorder digest and structured log are bit-identical across
+  same-seed reruns.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu import telemetry
+from edl_tpu.chaos.schedule import FaultEvent, FaultSchedule
+from edl_tpu.checkpoint import HostDRAMStore
+from edl_tpu.models.base import get_model
+from edl_tpu.runtime.coordinator import LocalCoordinator
+from edl_tpu.runtime.train import TrainState
+from edl_tpu.serving import (
+    ContinuousBatcher,
+    DecodeEngine,
+    DrainingError,
+    InferenceEngine,
+    QueueFullError,
+    RequestRouter,
+    RetryBudgetExhausted,
+    RetryingClient,
+    RouterServer,
+    ServingReplica,
+    ServingServer,
+    UpstreamClientError,
+)
+from tests.test_decode_serving import _reference_decode
+
+_OPT = optax.adam(1e-3)
+
+
+def _line_state(g: float) -> TrainState:
+    params = {
+        "w": jnp.full((13,), g, jnp.float32),
+        "b": jnp.asarray(g, jnp.float32),
+    }
+    return TrainState(
+        step=jnp.asarray(int(g), jnp.int32),
+        params=params,
+        opt_state=_OPT.init(params),
+    )
+
+
+def _lm_state(model, step: int, seed: int) -> TrainState:
+    p = model.init_params(jax.random.key(seed))
+    return TrainState(
+        step=jnp.asarray(step, jnp.int32),
+        params=p,
+        opt_state=_OPT.init(p),
+    )
+
+
+# -- RetryingClient: the shared client-side fallback contract -----------------
+
+
+class _FakeWire:
+    """Deterministic clock+sleep pair for retry-loop unit tests."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, d):
+        self.sleeps.append(round(d, 6))
+        self.t += d
+
+
+class _Scripted:
+    """A target that raises/returns from a per-call script."""
+
+    def __init__(self, name, script):
+        self.name = name
+        self.script = list(script)
+        self.calls = 0
+
+    def __call__(self, request):
+        self.calls += 1
+        step = self.script.pop(0) if self.script else self.script_tail
+        if isinstance(step, BaseException):
+            raise step
+        return step
+
+    script_tail = None
+
+    def __repr__(self):
+        return self.name
+
+
+def test_retrying_client_queue_full_backs_off_here():
+    wire = _FakeWire()
+    outcomes = []
+    target = _Scripted(
+        "full",
+        [
+            QueueFullError("full", retry_after=0.07),
+            QueueFullError("full", retry_after=0.07),
+            {"ok": True},
+        ],
+    )
+    client = RetryingClient(
+        [target],
+        budget_s=5.0,
+        sleep=wire.sleep,
+        clock=wire.clock,
+        on_attempt=lambda t, o, e: outcomes.append((t.name, o)),
+    )
+    assert client.call({})["ok"]
+    # all three attempts hit the SAME target, honoring its Retry-After
+    assert target.calls == 3
+    assert wire.sleeps == [0.07, 0.07]
+    assert outcomes == [
+        ("full", "queue_full"),
+        ("full", "queue_full"),
+        ("full", "ok"),
+    ]
+
+
+def test_retrying_client_draining_goes_elsewhere():
+    wire = _FakeWire()
+    outcomes = []
+    a = _Scripted("a", [DrainingError("leaving", retry_after=0.5)])
+    b = _Scripted("b", [{"served_by": "b"}])
+    client = RetryingClient(
+        [a, b],
+        budget_s=5.0,
+        sleep=wire.sleep,
+        clock=wire.clock,
+        on_attempt=lambda t, o, e: outcomes.append((t.name, o)),
+    )
+    assert client.call({})["served_by"] == "b"
+    # ONE attempt on the draining target — no back-off-here burn
+    assert a.calls == 1 and b.calls == 1
+    assert wire.sleeps == []
+    assert outcomes == [("a", "draining"), ("b", "ok")]
+
+
+def test_retrying_client_refused_goes_elsewhere():
+    wire = _FakeWire()
+    a = _Scripted("a", [ConnectionError("refused")])
+    b = _Scripted("b", [{"served_by": "b"}])
+    client = RetryingClient(
+        [a, b], budget_s=5.0, sleep=wire.sleep, clock=wire.clock
+    )
+    assert client.call({})["served_by"] == "b"
+    assert a.calls == 1
+
+
+def test_retrying_client_client_error_never_retried():
+    wire = _FakeWire()
+    a = _Scripted("a", [UpstreamClientError(400, {"error": "bad prompt"})])
+    b = _Scripted("b", [{"served_by": "b"}])
+    client = RetryingClient(
+        [a, b], budget_s=5.0, sleep=wire.sleep, clock=wire.clock
+    )
+    with pytest.raises(UpstreamClientError) as ei:
+        client.call({})
+    assert ei.value.status == 400
+    assert b.calls == 0  # every replica would say the same thing
+
+
+def test_retrying_client_saturated_exhaustion_is_typed():
+    wire = _FakeWire()
+
+    # every attempt everywhere is queue-full: the fleet is BUSY
+    def full(req):
+        raise QueueFullError("full", retry_after=0.2)
+
+    client = RetryingClient(
+        [full, full],
+        budget_s=2.0,
+        attempts=12,
+        sleep=wire.sleep,
+        clock=wire.clock,
+    )
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        client.call({})
+    assert ei.value.saturated
+    assert ei.value.retry_after >= 0.2  # the largest backend hint
+    assert ei.value.attempts > 0
+
+
+def test_retrying_client_broken_fleet_is_not_saturated():
+    wire = _FakeWire()
+
+    def dead(req):
+        raise ConnectionError("refused")
+
+    client = RetryingClient(
+        [dead], budget_s=1.0, attempts=6, sleep=wire.sleep,
+        clock=wire.clock,
+    )
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        client.call({})
+    assert not ei.value.saturated  # gone, not busy: no Retry-After lie
+
+
+def test_retrying_client_empty_fleet_exhausts_immediately():
+    client = RetryingClient([], budget_s=1.0)
+    with pytest.raises(RetryBudgetExhausted) as ei:
+        client.call({})
+    assert not ei.value.saturated
+
+
+# -- stub backends: router logic without a JAX engine -------------------------
+
+
+class _StubReplica:
+    """A scriptable fake serving replica: /healthz vitals plus
+    /predict and /generate behaviors, for status-code choreography
+    tests that need no real engine.  ``predict``/``generate`` return
+    (code, body) or (code, body, headers)."""
+
+    def __init__(self, rid, healthz=None, predict=None, generate=None):
+        self.rid = rid
+        self.healthz = healthz or {}
+        self.predict = predict or (
+            lambda req: (200, {"outputs": {"y": [1.0]}, "weights_step": 1})
+        )
+        self.generate = generate
+        self.hits = []
+        self._srv = None
+        self._bind(0)
+
+    def _handler(self):
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code, body, headers=()):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    h = outer.healthz() if callable(outer.healthz) else (
+                        dict(outer.healthz)
+                    )
+                    base = {
+                        "ok": True,
+                        "weights_step": 1,
+                        "weights_generation": 0,
+                        "queue_depth": 0,
+                        "queue_limit": 8,
+                        "saturation": 0.0,
+                        "in_flight": 0,
+                        "draining": False,
+                    }
+                    base.update(h)
+                    self._reply(200 if base.get("ok") else 503, base)
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                outer.hits.append((self.path, req))
+                if self.path == "/predict":
+                    out = outer.predict(req)
+                elif self.path == "/generate" and outer.generate:
+                    out = outer.generate(req)
+                else:
+                    out = (404, {"error": "not found"})
+                self._reply(out[0], out[1], out[2] if len(out) > 2 else ())
+
+        return H
+
+    def _bind(self, port):
+        self._srv = ThreadingHTTPServer(("127.0.0.1", port), self._handler())
+        self.port = self._srv.server_address[1]
+        self.address = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+
+    def stop(self):
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+    def restart(self):
+        """Come back on the SAME address (the restarted-pod shape)."""
+        self.stop()
+        self._bind(self.port)
+
+
+class _Plan:
+    def __init__(self, members, addresses, generation=1):
+        self.generation = generation
+        self.members = tuple(members)
+        self.addresses = tuple(addresses)
+
+
+class _StubCoord:
+    """Plan + telemetry double for router-logic tests."""
+
+    def __init__(self, stubs, events=None, gauges=None):
+        self._stubs = list(stubs)
+        self.events = list(events or [])
+        self.gauges = gauges or {}
+
+    def plan(self):
+        return _Plan(
+            [s.rid for s in self._stubs],
+            [s.address for s in self._stubs],
+        )
+
+    def telemetry(self):
+        return {
+            "merged": {
+                "counters": {},
+                "gauges": self.gauges,
+                "histograms": {},
+            },
+            "events": list(self.events),
+        }
+
+
+def test_router_least_loaded_pick():
+    with telemetry.scoped():
+        stubs = [_StubReplica(f"ll-{i}") for i in range(3)]
+        try:
+            router = RequestRouter(_StubCoord(stubs))
+            router.sync()
+            router.probe_all()
+            with router._lock:
+                router._replicas["ll-0"].queue_depth = 6.0
+                router._replicas["ll-1"].queue_depth = 1.0
+                router._replicas["ll-2"].kv_occupancy = 0.9  # *4.0 = 3.6
+            order = [v.replica_id for v in router._order()]
+            assert order == ["ll-1", "ll-2", "ll-0"]
+            out = router.predict({"inputs": {}})
+            assert out["weights_step"] == 1
+            # the admission landed on the least-loaded stub
+            assert [len(s.hits) for s in stubs] == [0, 1, 0]
+        finally:
+            for s in stubs:
+                s.stop()
+
+
+def test_router_drain_intent_steers_before_the_503():
+    """mark_draining (the lane's intent publication) removes the
+    victim from rotation IMMEDIATELY — it never has to 503 anyone —
+    and journals route.steer under the decision trace."""
+    with telemetry.scoped() as (_, rec):
+        stubs = [_StubReplica("st-0"), _StubReplica("st-1")]
+        try:
+            router = RequestRouter(_StubCoord(stubs))
+            router.sync()
+            router.probe_all()
+            router.mark_draining(["st-0"], trace="tr-drain")
+            for _ in range(4):
+                router.predict({"inputs": {}})
+            # the victim saw ZERO admissions after the intent
+            assert len(stubs[0].hits) == 0
+            assert len(stubs[1].hits) == 4
+            steers = [e for e in rec.events() if e.kind == "route.steer"]
+            assert steers and steers[0].data == {
+                "replica": "st-0", "source": "intent",
+            }
+            assert steers[0].trace == "tr-drain"
+            table = router.routing_table()
+            health = {r["replica"]: r["health"] for r in table["replicas"]}
+            assert health == {"st-0": "draining", "st-1": "healthy"}
+        finally:
+            for s in stubs:
+                s.stop()
+
+
+def test_router_journal_drain_events_steer_once():
+    """serve.drain flight events in the coordinator's merged journal
+    are the router's second steer signal (kubelet preStop drains no
+    intent ever announced) — consumed by seq watermark, so a replayed
+    tail steers exactly once."""
+    with telemetry.scoped() as (_, rec):
+        stubs = [_StubReplica("jd-0"), _StubReplica("jd-1")]
+        try:
+            coord = _StubCoord(stubs)
+            router = RequestRouter(coord)
+            router.sync()
+            coord.events = [
+                {
+                    "seq": 7,
+                    "kind": "serve.drain",
+                    "data": {"replica": "jd-1", "phase": "start"},
+                    "trace": "tr-journal",
+                }
+            ]
+            router.sync()
+            router.sync()  # same tail again: watermark dedupes
+            steers = [e for e in rec.events() if e.kind == "route.steer"]
+            assert len(steers) == 1
+            assert steers[0].data == {
+                "replica": "jd-1", "source": "journal",
+            }
+            assert steers[0].trace == "tr-journal"
+            assert [v.replica_id for v in router._routable()] == ["jd-0"]
+        finally:
+            for s in stubs:
+                s.stop()
+
+
+def test_router_healthz_draining_bit_steers():
+    with telemetry.scoped() as (_, rec):
+        stub = _StubReplica("hz-0", healthz={"draining": True})
+        other = _StubReplica("hz-1")
+        try:
+            router = RequestRouter(_StubCoord([stub, other]))
+            router.sync()
+            router.probe_all()
+            assert [v.replica_id for v in router._routable()] == ["hz-1"]
+            steers = [e for e in rec.events() if e.kind == "route.steer"]
+            assert steers[0].data == {
+                "replica": "hz-0", "source": "healthz",
+            }
+        finally:
+            stub.stop()
+            other.stop()
+
+
+def test_router_passive_eject_and_probe_only_readmit():
+    """Consecutive refused attempts eject; a good REQUEST cannot
+    resurrect the replica — only a clean active /healthz probe can."""
+    with telemetry.scoped() as (_, rec):
+        dead = _StubReplica("ej-0")
+        live = _StubReplica("ej-1")
+        try:
+            router = RequestRouter(_StubCoord([dead, live]), eject_after=3)
+            router.sync()
+            router.probe_all()
+            dead.stop()  # abrupt kill: connection refused from now on
+            for _ in range(3):
+                # each predict tries ej-0 first (tied score, lower id),
+                # absorbs the refusal, and is served by ej-1
+                out = router.predict({"inputs": {}})
+                assert out["weights_step"] == 1
+            ejects = [e for e in rec.events() if e.kind == "route.eject"]
+            assert ejects and ejects[0].data == {
+                "replica": "ej-0", "consecutive_failures": 3,
+            }
+            assert [v.replica_id for v in router._routable()] == ["ej-1"]
+            # 4th request: the ejected replica is not even attempted
+            router.predict({"inputs": {}})
+            assert len(live.hits) == 4
+            # a failing active probe keeps it ejected...
+            router.probe("ej-0")
+            health = {
+                r["replica"]: r["health"]
+                for r in router.routing_table()["replicas"]
+            }
+            assert health["ej-0"] == "ejected"
+            # ...and a clean one re-admits (the restarted-pod shape)
+            dead.restart()
+            assert router.probe("ej-0")
+            health = {
+                r["replica"]: r["health"]
+                for r in router.routing_table()["replicas"]
+            }
+            assert health["ej-0"] == "healthy"
+            readmits = [
+                e for e in rec.events() if e.kind == "route.readmit"
+            ]
+            assert readmits and readmits[0].data == {"replica": "ej-0"}
+        finally:
+            dead.stop()
+            live.stop()
+
+
+def test_router_server_saturated_503_vs_broken_502():
+    """The front door's exhaustion typing: a BUSY fleet answers 503 +
+    Retry-After (come back), a GONE fleet answers 502 (no promises)."""
+    with telemetry.scoped():
+        full = _StubReplica(
+            "sat-0",
+            predict=lambda req: (
+                429,
+                {"error": "queue full", "retry_after_s": 0.01},
+                [("Retry-After", "0.010")],
+            ),
+        )
+        try:
+            router = RequestRouter(
+                _StubCoord([full]),
+                retry_budget_s=0.4,
+                attempts=6,
+                base_backoff_s=0.005,
+                max_backoff_s=0.02,
+            )
+            router.sync()
+            router.probe_all()
+            server = RouterServer(
+                router, host="127.0.0.1", sync_interval_s=30.0
+            ).start()
+            base = f"http://127.0.0.1:{server.port}"
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    f"{base}{path}",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                return urllib.request.urlopen(req, timeout=15)
+
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    post("/predict", {"inputs": {}})
+                assert ei.value.code == 503
+                assert ei.value.headers.get("Retry-After") is not None
+                body = json.loads(ei.value.read())
+                assert body["saturated"] is True
+                assert body["retry_after_s"] >= 0.01
+
+                # now the fleet is GONE, not busy
+                full.stop()
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    post("/predict", {"inputs": {}})
+                assert ei.value.code == 502
+                assert ei.value.headers.get("Retry-After") is None
+
+                # routerd healthz goes unready with zero healthy backends
+                with router._lock:
+                    router._replicas["sat-0"].health = "ejected"
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(f"{base}/healthz", timeout=5)
+                assert ei.value.code == 503
+            finally:
+                server.stop()
+        finally:
+            full.stop()
+
+
+def test_router_prefix_affinity_is_advisory():
+    """Shared-prefix sessions are steered to the replica already
+    holding their cached blocks — but ONLY while that replica's load
+    stays within the advisory bound."""
+    with telemetry.scoped():
+        decode_hz = {
+            "decode": {
+                "block_tokens": 8,
+                "decode_queue_depth": 0,
+                "kv_occupancy": 0.0,
+            }
+        }
+        stubs = [
+            _StubReplica(
+                f"aff-{i}",
+                healthz=dict(decode_hz),
+                generate=lambda req: (
+                    200,
+                    {"tokens": [1, 2], "weights_step": 1},
+                ),
+            )
+            for i in range(2)
+        ]
+        try:
+            router = RequestRouter(_StubCoord(stubs))
+            router.sync()
+            router.probe_all()
+            prompt = list(range(1, 17))  # two full 8-token blocks
+            out = router.generate(
+                {"inputs": {"tokens": prompt}, "max_new_tokens": 2}
+            )
+            assert out["tokens"] == [1, 2]
+            # tied scores pick aff-0; its blocks are now remembered
+            assert len(stubs[0].hits) == 1
+            hashes = router._chain_hashes({"inputs": {"tokens": prompt}})
+            assert len(hashes) == 2
+            # load aff-0 within the advisory bound: still promoted
+            with router._lock:
+                router._replicas["aff-0"].queue_depth = 3.0
+            order = router._order(generate=True, hashes=hashes)
+            assert order[0].replica_id == "aff-0"
+            # beyond the bound: affinity yields to load (advisory ONLY)
+            with router._lock:
+                router._replicas["aff-0"].queue_depth = 10.0
+            order = router._order(generate=True, hashes=hashes)
+            assert order[0].replica_id == "aff-1"
+        finally:
+            for s in stubs:
+                s.stop()
+
+
+# -- live fleets --------------------------------------------------------------
+
+
+def _fit_replica(coord, store, rid):
+    engine = InferenceEngine(
+        get_model("fit_a_line"),
+        store,
+        devices=jax.devices()[:1],
+        max_batch=4,
+    )
+    batcher = ContinuousBatcher(engine)
+    server = ServingServer(batcher, host="127.0.0.1")
+    return ServingReplica(
+        engine,
+        batcher=batcher,
+        server=server,
+        coordinator=coord,
+        replica_id=rid,
+        address=f"127.0.0.1:{server.port}",
+        heartbeat_interval=0.05,
+        telemetry_interval=1e9,
+    ).start()
+
+
+def _lm_replica(coord, engine, rid):
+    batcher = ContinuousBatcher(engine)
+    server = ServingServer(batcher, host="127.0.0.1")
+    return ServingReplica(
+        engine,
+        batcher=batcher,
+        server=server,
+        coordinator=coord,
+        replica_id=rid,
+        address=f"127.0.0.1:{server.port}",
+        heartbeat_interval=0.05,
+        telemetry_interval=1e9,
+    ).start()
+
+
+@pytest.fixture(scope="module")
+def lm_engines():
+    """Three warmed tiny-LM decode engines: two on the SAME weights
+    (step 1 — the resume pair) and one a step ahead (step 2 — the
+    purity-skew survivor).  Warm once; tests build fresh batchers and
+    replicas around them."""
+    model = get_model("transformer_lm", tiny=True)
+    s1 = HostDRAMStore()
+    s1.save_async(_lm_state(model, 1, 1), generation=0)
+    s1.wait()
+    s2 = HostDRAMStore()
+    s2.save_async(_lm_state(model, 2, 2), generation=0)
+    s2.wait()
+    engines = []
+    for store in (s1, s1, s2):
+        e = DecodeEngine(
+            model,
+            store,
+            devices=jax.devices()[:1],
+            max_batch=1,
+            max_seqs=4,
+            block_tokens=16,
+        )
+        assert e.load()
+        e.warm()
+        engines.append(e)
+    params = {
+        1: _lm_state(model, 1, 1).params,
+        2: _lm_state(model, 2, 2).params,
+    }
+    return model, params, engines
+
+
+def test_router_predict_through_live_fleet():
+    with telemetry.scoped():
+        store = HostDRAMStore()
+        store.save_async(_line_state(1.0), generation=0)
+        store.wait()
+        coord = LocalCoordinator(
+            target_world=2, max_world=4, heartbeat_timeout=1e9
+        )
+        reps = [_fit_replica(coord, store, f"lv-{i}") for i in range(2)]
+        try:
+            router = RequestRouter(coord)
+            router.sync()
+            router.probe_all()
+            assert router.plan_generation == coord.generation()
+            x = np.ones((2, 13), np.float32)
+            out = router.predict({"inputs": {"x": x.tolist()}})
+            np.testing.assert_allclose(
+                out["outputs"]["pred"],
+                1.0 * (x.sum(axis=1) + 1.0),
+                rtol=1e-4,
+            )
+            assert out["weights_step"] == 1
+        finally:
+            for r in reps:
+                r.stop()
+
+
+def test_router_stream_redrive_resumes_without_dup_or_drop(lm_engines):
+    """A mid-stream cut re-drives on a survivor serving the SAME
+    weights step: the client stream carries every reference token
+    exactly once, indices globally contiguous, no restart line."""
+    model, params, (ea, eb, _) = lm_engines
+    with telemetry.scoped() as (_, rec):
+        coord = LocalCoordinator(
+            target_world=2, max_world=4, heartbeat_timeout=1e9
+        )
+        ra = _lm_replica(coord, ea, "lm-a")
+        rb = _lm_replica(coord, eb, "lm-b")
+        try:
+            chaos = FaultSchedule(0, [FaultEvent(0, "route.stream.cut")])
+            chaos.advance(0)
+            router = RequestRouter(coord, chaos=chaos)
+            router.sync()
+            router.probe_all()
+            rng = np.random.RandomState(3)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :8].tolist()
+            ref = _reference_decode(model, params[1], prompt, 6, ea)
+            events = []
+            router.generate_stream(
+                {"inputs": {"tokens": prompt}, "max_new_tokens": 6},
+                events.append,
+            )
+            done = events[-1]
+            assert done.get("done") and done["tokens"] == ref
+            assert done["redriven"] == 1
+            toks = [e for e in events if "token" in e]
+            assert [e["i"] for e in toks] == list(range(6))
+            assert [e["token"] for e in toks] == ref
+            assert not any(e.get("restart") for e in events)
+            redrives = [
+                e.data["outcome"]
+                for e in rec.events()
+                if e.kind == "route.redrive"
+            ]
+            assert redrives == ["resume"]
+        finally:
+            ra.stop()
+            rb.stop()
+
+
+def test_router_stream_redrive_restarts_on_purity_skew(lm_engines):
+    """When the only survivor serves a DIFFERENT weights step, the
+    resumed leg's first-token stamp mismatches and the router
+    abandons it BEFORE forwarding a token: the client sees one
+    restart line (prior tokens void — the batcher's own hot-swap
+    contract) and then the survivor's pure sequence."""
+    model, params, (ea, _, ec) = lm_engines
+    with telemetry.scoped() as (_, rec):
+        coord = LocalCoordinator(
+            target_world=2, max_world=4, heartbeat_timeout=1e9
+        )
+        ra = _lm_replica(coord, ea, "lm-a")  # step 1: first pick
+        rc = _lm_replica(coord, ec, "lm-c")  # step 2: the survivor
+        try:
+            chaos = FaultSchedule(0, [FaultEvent(0, "route.stream.cut")])
+            chaos.advance(0)
+            router = RequestRouter(coord, chaos=chaos)
+            router.sync()
+            router.probe_all()
+            rng = np.random.RandomState(4)
+            prompt = model.synth_batch(rng, 1)["tokens"][0, :8].tolist()
+            ref2 = _reference_decode(model, params[2], prompt, 6, ec)
+            events = []
+            killed = []
+
+            def emit(ev):
+                events.append(ev)
+                if "token" in ev and not killed:
+                    # the kill lands right after the first token; the
+                    # chaos cut then tears THIS stream and every later
+                    # attempt on lm-a is connection-refused
+                    ra.server.stop()
+                    killed.append(True)
+
+            router.generate_stream(
+                {"inputs": {"tokens": prompt}, "max_new_tokens": 6},
+                emit,
+            )
+            done = events[-1]
+            assert done.get("done") and done["tokens"] == ref2
+            restarts = [e for e in events if e.get("restart")]
+            assert len(restarts) == 1 and restarts[0]["redrive"] is True
+            # after the restart the indices renumber from 0 and every
+            # token is the step-2 reference — nothing mixed in
+            after = events[events.index(restarts[0]) + 1:]
+            toks = [e for e in after if "token" in e]
+            assert [e["i"] for e in toks] == list(range(6))
+            assert [e["token"] for e in toks] == ref2
+            assert toks[0]["weights_step"] == 2
+            redrives = [
+                e.data["outcome"]
+                for e in rec.events()
+                if e.kind == "route.redrive"
+            ]
+            assert redrives == ["resume", "restart"]
+        finally:
+            ra.stop()
+            rc.stop()
+
+
+def test_drain_victim_ack_implies_router_already_steering():
+    """ISSUE 20 satellite: the scale-down ordering guarantee, read off
+    the merged flight journal as ONE trace — ServingLane decision ->
+    route.steer (intent) -> serve.drain ack.  The steer's seq strictly
+    precedes the drain's, so by the time a victim acks, the router had
+    already stopped admitting to it."""
+    from edl_tpu.autoscaler.serving import ServingLane
+
+    with telemetry.scoped() as (_, rec):
+        store = HostDRAMStore()
+        store.save_async(_line_state(1.0), generation=0)
+        store.wait()
+        coord = LocalCoordinator(
+            target_world=2, max_world=4, heartbeat_timeout=1e9
+        )
+        reps = [_fit_replica(coord, store, f"fd-{i}") for i in range(2)]
+        try:
+            router = RequestRouter(coord)
+            router.sync()
+            router.probe_all()
+            victim = list(coord.plan().members)[-1]
+            lane = ServingLane(
+                coord,
+                router=router,
+                min_replicas=1,
+                max_replicas=4,
+                hold_ticks=1,
+                victim_drain_timeout=10.0,
+            )
+            entry = lane.run_once()
+            assert entry["actuated"] and entry["drain"]["acked"]
+            tid = entry["trace_id"]
+            assert tid
+            evs = rec.events()
+            steers = [
+                e for e in evs
+                if e.kind == "route.steer"
+                and e.data.get("replica") == victim
+            ]
+            starts = [
+                e for e in evs
+                if e.kind == "serve.drain"
+                and e.data.get("replica") == victim
+                and e.data.get("phase") == "start"
+            ]
+            acks = [
+                e for e in evs
+                if e.kind == "serve.drain"
+                and e.data.get("replica") == victim
+                and e.data.get("phase") == "done"
+            ]
+            assert steers and starts and acks
+            # one causal chain: decision, steer and ack share the trace
+            assert steers[0].trace == tid
+            assert starts[0].trace == tid
+            assert acks[0].trace == tid
+            # and the ordering: steered BEFORE the victim even began
+            assert steers[0].seq < starts[0].seq < acks[0].seq
+            # the router had stopped admitting to the victim
+            assert victim not in [
+                v.replica_id for v in router._routable()
+            ]
+        finally:
+            for r in reps:
+                r.stop()
+
+
+# -- the seeded router chaos soak ---------------------------------------------
+
+
+def _router_soak_events():
+    return [
+        FaultEvent(1, "route.backend.refused"),
+        FaultEvent(2, "route.probe.fail"),
+        FaultEvent(3, "route.probe.fail"),
+        FaultEvent(5, "route.stream.cut"),
+    ]
+
+
+def _run_router_soak(seed: int):
+    """One soak through the front door: a backend refusal absorbed, a
+    probe-failure eject + probe readmit, a mid-stream cut re-driven,
+    a drain steer — under live traffic, zero client-visible failures.
+    Returns (digest, log): both must be bit-identical across
+    same-seed runs."""
+    with telemetry.scoped() as (_, rec):
+        schedule = FaultSchedule(seed, _router_soak_events())
+        log = []
+        store = HostDRAMStore()
+        store.save_async(_line_state(1.0), generation=0)
+        store.wait()
+        coord = LocalCoordinator(
+            target_world=8, max_world=8, heartbeat_timeout=1e9
+        )
+        fit = [_fit_replica(coord, store, f"rt-{i}") for i in range(2)]
+        lm = get_model("transformer_lm", tiny=True)
+        dstore = HostDRAMStore()
+        dstore.save_async(_lm_state(lm, 1, 1), generation=0)
+        dstore.wait()
+        dengine = DecodeEngine(
+            lm,
+            dstore,
+            devices=jax.devices()[:1],
+            max_batch=1,
+            max_seqs=4,
+            block_tokens=16,
+        )
+        drep = _lm_replica(coord, dengine, "rt-d")
+        try:
+            router = RequestRouter(
+                coord,
+                chaos=schedule,
+                eject_after=2,
+                retry_budget_s=8.0,
+                base_backoff_s=0.01,
+                max_backoff_s=0.05,
+            )
+            router.sync()
+            router.probe_all()
+            x = np.ones((1, 13), np.float32).tolist()
+            rng = np.random.RandomState(seed)
+            prompt = lm.synth_batch(rng, 1)["tokens"][0, :8].tolist()
+
+            def predict():
+                out = router.predict({"inputs": {"x": x}})
+                assert abs(out["outputs"]["pred"][0] - 14.0) < 1e-2
+                return out
+
+            def stream():
+                events = []
+                router.generate_stream(
+                    {"inputs": {"tokens": prompt}, "max_new_tokens": 5},
+                    events.append,
+                )
+                done = events[-1]
+                assert done.get("done")
+                return done
+
+            def health(rid):
+                return {
+                    r["replica"]: r["health"]
+                    for r in router.routing_table()["replicas"]
+                }[rid]
+
+            # round 0: clean traffic, both planes
+            for _ in range(3):
+                predict()
+            base_tokens = stream()["tokens"]
+            log.append(("clean", 3, tuple(base_tokens)))
+
+            # step 1: one backend refusal, absorbed invisibly
+            schedule.advance(1)
+            predict()
+            log.append(("refusal_absorbed", True))
+
+            # steps 2-3: consecutive probe failures eject rt-0; the
+            # fleet keeps serving; ONLY a clean probe re-admits
+            schedule.advance(2)
+            router.probe("rt-0")
+            schedule.advance(3)
+            router.probe("rt-0")
+            log.append(("ejected", health("rt-0")))
+            predict()
+            router.probe("rt-0")  # chaos spent: the probe is clean
+            log.append(("readmitted", health("rt-0")))
+
+            # step 5: the stream cut — re-driven, tokens identical to
+            # the uncut run (generation purity across the re-drive)
+            schedule.advance(5)
+            done = stream()
+            log.append(
+                (
+                    "redrive",
+                    done["redriven"],
+                    tuple(done["tokens"]) == tuple(base_tokens),
+                )
+            )
+
+            # a drain steer: the victim takes no further admissions
+            router.mark_draining(["rt-1"], trace="soak-drain")
+            predict()
+            log.append(("steered", health("rt-1")))
+            return rec.digest(), log
+        finally:
+            for r in fit:
+                r.stop()
+            drep.stop()
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_router_chaos_soak_bit_identical(seed):
+    d1, log1 = _run_router_soak(seed)
+    d2, log2 = _run_router_soak(seed)
+    assert log1 == log2
+    assert d1 == d2
+    # and the soak actually saw what it claims
+    stages = [entry[0] for entry in log1]
+    assert stages == [
+        "clean",
+        "refusal_absorbed",
+        "ejected",
+        "readmitted",
+        "redrive",
+        "steered",
+    ]
+    assert log1[2][1] == "ejected"
+    assert log1[3][1] == "healthy"
+    assert log1[4][1] == 1 and log1[4][2] is True
+    assert log1[5][1] == "draining"
+
+
+# -- operator surfaces: edl route + edl metrics router section ----------------
+
+
+def test_route_cli_prints_routing_table(capsys):
+    """ISSUE 20 satellite: `edl route <addr>` prints the live routing
+    table — every backend, its health, and the load score admissions
+    are spread by."""
+    from edl_tpu.cli import main
+
+    with telemetry.scoped():
+        stubs = [
+            _StubReplica("rc-0"),
+            _StubReplica("rc-1", healthz={"draining": True}),
+        ]
+        rs = None
+        try:
+            router = RequestRouter(_StubCoord(stubs))
+            router.sync()
+            router.probe_all()
+            rs = RouterServer(
+                router, host="127.0.0.1", port=0, sync_interval_s=1e9
+            ).start()
+            assert main(["route", f"127.0.0.1:{rs.port}"]) == 0
+            out = capsys.readouterr().out
+            assert "rc-0" in out and "rc-1" in out
+            assert "healthy" in out and "draining" in out
+            assert stubs[0].address in out
+            assert "plan_generation" in out
+            # --json round-trips the raw table
+            assert main(
+                ["route", f"127.0.0.1:{rs.port}", "--json"]
+            ) == 0
+            table = json.loads(capsys.readouterr().out)
+            assert {r["replica"] for r in table["replicas"]} == {
+                "rc-0",
+                "rc-1",
+            }
+        finally:
+            if rs is not None:
+                rs.stop()
+            for s in stubs:
+                s.stop()
+
+
+def test_metrics_cli_prints_router_section(capsys):
+    """ISSUE 20 satellite: the routerd ships its registry to the
+    coordinator as source \"router\" (RouterServer._report_telemetry),
+    and `edl metrics` renders the front-door section — backends by
+    state, request outcomes, steers, retries absorbed, ejections."""
+    from edl_tpu.cli import main
+    from edl_tpu.runtime.coord_service import CoordinatorServer
+
+    with telemetry.scoped():
+        stubs = [_StubReplica(f"mc-{i}") for i in range(2)]
+        rs = None
+        cs = None
+        try:
+            coord = LocalCoordinator(
+                target_world=2, max_world=4, heartbeat_timeout=1e9
+            )
+            for s in stubs:
+                coord.register(s.rid, address=s.address)
+            router = RequestRouter(coord)
+            rs = RouterServer(
+                router, host="127.0.0.1", port=0, sync_interval_s=1e9
+            )
+            router.sync()
+            router.probe_all()
+            router.predict({"inputs": {}})
+            router.mark_draining(["mc-1"], trace="tr-metrics-cli")
+            router.predict({"inputs": {}})
+            # eject mc-1 by passive failures while it is down
+            stubs[1].stop()
+            with router._lock:
+                v = router._replicas["mc-1"]
+                v.health = "healthy"
+            for _ in range(3):
+                router.probe("mc-1")
+            rs._report_telemetry()
+            cs = CoordinatorServer(
+                coord, host="127.0.0.1", port=0
+            ).start(evict=False)
+            assert main(["metrics", f"127.0.0.1:{cs.port}"]) == 0
+            out = capsys.readouterr().out
+            assert "router" in out
+            assert "backends{state=healthy}" in out
+            assert "requests{outcome=ok}" in out
+            assert "steers_total" in out
+            assert "ejections_total" in out
+        finally:
+            if cs is not None:
+                cs.stop()
+            if rs is not None:
+                rs.stop()
+            for s in stubs:
+                s.stop()

@@ -934,6 +934,8 @@ def test_serving_manifests_render_fleet_and_env_contract():
         ("Service", "serve-demo-serve-coordinator"),
         ("Deployment", "serve-demo-serve"),
         ("Service", "serve-demo-serve"),
+        ("Deployment", "serve-demo-router"),
+        ("Service", "serve-demo-router"),
     ]
     dep = objs[2]
     assert dep["spec"]["replicas"] == 2
@@ -950,6 +952,21 @@ def test_serving_manifests_render_fleet_and_env_contract():
     cmd = objs[0]["spec"]["template"]["spec"]["containers"][0]["command"]
     assert cmd[cmd.index("--min-world") + 1] == "2"
     assert cmd[cmd.index("--max-world") + 1] == "5"
+    # the front door (ISSUE 20): routerd rides the same serving
+    # coordinator, configured by the EDL_ROUTE_* contract
+    rcontainer = objs[4]["spec"]["template"]["spec"]["containers"][0]
+    assert rcontainer["command"] == [
+        "python", "-m", "edl_tpu.serving.router",
+    ]
+    renv = {e["name"]: e.get("value") for e in rcontainer["env"]}
+    assert renv["EDL_COORDINATOR_ADDR"].startswith(
+        "serve-demo-serve-coordinator:"
+    )
+    assert renv["EDL_ROUTE_PORT"] == "7190"
+    assert renv["EDL_ROUTE_RETRY_BUDGET_MS"] == "10000"
+    assert renv["EDL_ROUTE_PROBE_MS"] == "500"
+    assert renv["EDL_ROUTE_EJECT_AFTER"] == "3"
+    assert objs[5]["spec"]["ports"] == [{"name": "route", "port": 7190}]
     # a train-only job renders NO serving objects
     job.spec.serving = None
     assert parse_to_serving_manifests(job) == []

@@ -47,6 +47,7 @@ from edl_tpu.runtime.train import TrainState
 from edl_tpu.serving import (
     DecodeEngine,
     InferenceEngine,
+    RetryingClient,
     ServingReplica,
 )
 from tests.test_decode_serving import _reference_decode
@@ -155,16 +156,16 @@ def _run_serving_soak(seed: int):
         x0 = np.ones((1, 13), np.float32)
 
         def call(order, x):
-            """The client retry contract: submit against replicas in
-            ``order`` until one serves (drain/kill failures route to
-            the next)."""
-            last = None
-            for b in list(order) * 2:
-                try:
-                    return b.batcher.submit({"x": x}).result(timeout=15)
-                except BaseException as e:
-                    last = e
-            raise last
+            """The client retry contract — the shared library now
+            (ISSUE 20): queue-full backs off HERE, drain/kill
+            failures route to the next replica."""
+            return RetryingClient(
+                list(order),
+                submit=lambda b, req: (
+                    b.batcher.submit(req).result(timeout=15)
+                ),
+                budget_s=15.0,
+            ).call({"x": x})
 
         def check(out, x, g):
             np.testing.assert_allclose(
